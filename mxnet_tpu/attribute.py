@@ -1,0 +1,36 @@
+"""AttrScope (reference: ``python/mxnet/attribute.py``).
+
+In the reference, ``with mx.AttrScope(ctx_group='dev1'):`` annotates symbol
+nodes for manual model parallelism (`group2ctx` binding). On TPU the analog
+is a *sharding hint* scope consumed by ``mxnet_tpu.parallel`` — ops created
+inside the scope carry a logical-axis annotation instead of a device id.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current_attrs"]
+
+
+class AttrScope:
+    _tls = threading.local()
+
+    def __init__(self, **attrs):
+        self._attrs = attrs
+
+    def __enter__(self):
+        stack = getattr(AttrScope._tls, "stack", None)
+        if stack is None:
+            stack = AttrScope._tls.stack = []
+        merged = dict(stack[-1]) if stack else {}
+        merged.update(self._attrs)
+        stack.append(merged)
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._tls.stack.pop()
+
+
+def current_attrs() -> dict:
+    stack = getattr(AttrScope._tls, "stack", None)
+    return dict(stack[-1]) if stack else {}

@@ -1,0 +1,216 @@
+"""Imperative autograd: ``record()`` / ``backward()`` over ``jax.vjp``.
+
+Reference: ``src/imperative/imperative.cc`` + ``python/mxnet/autograd.py`` —
+a mutation tape whose backward builds an nnvm gradient graph and executes it
+through the dependency engine. The TPU design records a lightweight *replay
+tape* instead: each recorded op stores (pure-fn, inputs, kwargs); ``backward``
+replays the subgraph as one pure function and differentiates it with
+``jax.vjp``, so the whole backward is a single XLA program — no engine, no
+per-op gradient kernels.
+
+Stochastic ops (Dropout etc.) materialise their PRNG key at record time, so
+the vjp replay sees identical randomness — the reference gets this from
+saved cuDNN dropout masks.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode",
+    "is_recording", "is_training", "backward", "grad",
+    "mark_variables", "get_symbol",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _State()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+class _RecordScope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        self._saved = (_STATE.recording, _STATE.training)
+        if self._rec is not None:
+            _STATE.recording = self._rec
+        if self._train is not None:
+            _STATE.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.recording, _STATE.training = self._saved
+
+
+def record(train_mode: bool = True):
+    """``with autograd.record():`` — start taping ops (and set train mode)."""
+    return _RecordScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordScope(None, True)
+
+
+def predict_mode():
+    return _RecordScope(None, False)
+
+
+class TapeNode:
+    """One recorded op application."""
+
+    __slots__ = ("op", "kwargs", "inputs", "nout", "name")
+
+    def __init__(self, op, kwargs, inputs, nout, name=""):
+        self.op = op  # pure fn(*raw, **kwargs)
+        self.kwargs = kwargs
+        self.inputs = inputs  # list of NDArray (refs retained for replay)
+        self.nout = nout
+        self.name = name
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """MXNet API: make arrays differentiable leaves with preallocated grads."""
+    if not isinstance(variables, (list, tuple)):
+        variables, gradients = [variables], [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad_req = req
+        v._grad = g
+
+
+def _collect(heads):
+    """Topo-collect tape nodes + leaf NDArrays reachable from heads."""
+    nodes, leaves, seen_nodes, seen_leaves = [], [], set(), set()
+
+    def visit_array(arr):
+        tape = getattr(arr, "_tape", None)
+        if tape is not None:
+            visit_node(tape[0])
+        if getattr(arr, "_grad_req", "null") != "null" and id(arr) not in seen_leaves:
+            seen_leaves.add(id(arr))
+            leaves.append(arr)
+
+    def visit_node(node):
+        if id(node) in seen_nodes:
+            return
+        seen_nodes.add(id(node))
+        for x in node.inputs:
+            visit_array(x)
+        nodes.append(node)
+
+    for h in heads:
+        visit_array(h)
+    return nodes, leaves
+
+
+def _build_replay(heads, leaves):
+    """Return f(leaf_values) -> head_values, replaying the tape purely."""
+    leaf_pos = {id(a): i for i, a in enumerate(leaves)}
+
+    def run(leaf_vals):
+        memo = {}
+
+        def value_of(arr):
+            key = id(arr)
+            if key in leaf_pos:
+                return leaf_vals[leaf_pos[key]]
+            tape = getattr(arr, "_tape", None)
+            if tape is None:
+                return jax.lax.stop_gradient(arr._data)
+            node, idx = tape
+            if id(node) not in memo:
+                raw = [value_of(x) for x in node.inputs]
+                out = node.op(*raw, **node.kwargs)
+                memo[id(node)] = out if isinstance(out, tuple) else (out,)
+            return memo[id(node)][idx]
+
+        return tuple(value_of(h) for h in heads)
+
+    return run
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of ``heads`` w.r.t. all attached-grad leaves."""
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    nodes, leaves = _collect(heads)
+    if not leaves:
+        raise ValueError("backward: no arrays with attach_grad() are reachable "
+                         "from the given heads")
+    replay = _build_replay(heads, leaves)
+    leaf_vals = tuple(l._data for l in leaves)
+    _, vjp_fn = jax.vjp(replay, leaf_vals)
+    if head_grads is None:
+        cts = tuple(jnp.ones_like(h._data) for h in heads)
+    else:
+        cts = tuple(
+            jnp.ones_like(h._data) if g is None else (g._data if hasattr(g, "_data") else jnp.asarray(g))
+            for h, g in zip(heads, head_grads)
+        )
+    (grads,) = vjp_fn(cts)
+    for leaf, g in zip(leaves, grads):
+        req = getattr(leaf, "_grad_req", "write")
+        if req == "null":
+            continue
+        if leaf._grad is None or req == "write":
+            if leaf._grad is None:
+                leaf._grad = leaf._empty_like()
+            leaf._grad._data = g.astype(leaf.dtype)
+        elif req == "add":
+            leaf._grad._data = leaf._grad._data + g.astype(leaf.dtype)
+    if not retain_graph:
+        for n in nodes:
+            n.inputs = []
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Functional gradient API (``autograd.grad``). Returns grads as NDArrays."""
+    if create_graph:
+        raise NotImplementedError("create_graph=True (higher-order imperative grad) "
+                                  "is not supported; use hybridize + jax.grad composition")
+    single = not isinstance(heads, (list, tuple))
+    if single:
+        heads = [heads]
+    if not isinstance(variables, (list, tuple)):
+        variables = [variables]
+    replay = _build_replay(heads, list(variables))
+    leaf_vals = tuple(v._data for v in variables)
+    _, vjp_fn = jax.vjp(replay, leaf_vals)
+    if head_grads is None:
+        cts = tuple(jnp.ones_like(h._data) for h in heads)
+    else:
+        cts = tuple(g._data for g in head_grads)
+    (grads,) = vjp_fn(cts)
+    from . import ndarray as nd
+
+    return [nd.NDArray(g) for g in grads]
+
+
+def get_symbol(x):
+    raise NotImplementedError("autograd.get_symbol: use mxnet_tpu.symbol tracing instead")

@@ -1,0 +1,70 @@
+"""Core error model and dtype utilities.
+
+TPU-native re-design of the MXNet 1.x base layer. The reference funnels every
+error through a flat C ABI (``src/c_api/c_api_error.cc``, ``MXGetLastError``);
+here Python *is* the ABI, so ``MXNetError`` is a plain exception hierarchy.
+Dtype handling replaces mshadow's ``MSHADOW_TYPE_SWITCH`` macros
+(``3rdparty/mshadow/mshadow/base.h``) with numpy/jax dtype canonicalisation.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["MXNetError", "NotSupportedForTPUError", "dtype_np", "dtype_name"]
+
+
+class MXNetError(RuntimeError):
+    """Root error type (analog of ``dmlc::Error`` surfaced via MXGetLastError)."""
+
+
+class NotSupportedForTPUError(MXNetError):
+    """Raised for reference capabilities intentionally absent on TPU.
+
+    The reference's CUDA-only surfaces (e.g. NVRTC pointwise fusion,
+    ``src/operator/fusion/fused_op.cc``) are subsumed by XLA; anything a user
+    can reach that has no TPU analog raises this with an explanation instead
+    of silently misbehaving.
+    """
+
+
+# MXNet 1.x type-flag table (include/mxnet/base.h / mshadow kFloat32 etc.).
+# Kept so .params serialization and dtype= string args stay compatible.
+_DTYPE_TO_FLAG = {
+    "float32": 0,
+    "float64": 1,
+    "float16": 2,
+    "uint8": 3,
+    "int32": 4,
+    "int8": 5,
+    "int64": 6,
+    "bool": 7,
+    "bfloat16": 12,
+}
+_FLAG_TO_DTYPE = {v: k for k, v in _DTYPE_TO_FLAG.items()}
+
+
+def dtype_np(dtype):
+    """Canonicalise a user dtype spec to a numpy/ml_dtypes dtype object."""
+    if dtype is None:
+        return _np.dtype("float32")
+    if isinstance(dtype, int):
+        dtype = _FLAG_TO_DTYPE[dtype]
+    if dtype is bool:
+        return _np.dtype("bool")
+    name = dtype if isinstance(dtype, str) else _np.dtype(dtype).name
+    if name == "bfloat16" or getattr(dtype, "__name__", "") == "bfloat16":
+        import ml_dtypes
+
+        return _np.dtype(ml_dtypes.bfloat16)
+    return _np.dtype(name)
+
+
+def dtype_name(dtype) -> str:
+    """Stable string name for a dtype (bfloat16-aware)."""
+    d = dtype_np(dtype)
+    return d.name if d.name != "void" else str(d)
+
+
+def dtype_flag(dtype) -> int:
+    """MXNet serialization type flag for ``dtype`` (for .params compat)."""
+    return _DTYPE_TO_FLAG[dtype_name(dtype)]

@@ -1,0 +1,119 @@
+"""DataLoader (reference: ``python/mxnet/gluon/data/dataloader.py``).
+
+The reference forks worker *processes* that serialize NDArrays through
+shared memory (``ConnectionWrapper``/``worker_loop``). TPU hosts feed one
+logical device mesh, so the design here is: workers produce **numpy** batches
+(cheap to pickle / zero device state), and the loader moves only the final
+batch to device — optionally double-buffered (``prefetch``) so H2D overlaps
+compute, which is what the reference's ``PrefetcherIter`` did.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+
+import numpy as np
+
+from ...ndarray import NDArray, array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples -> one numpy batch (nested tuples preserved)."""
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn(list(x)) for x in zip(*data))
+    first = data[0]
+    if isinstance(first, NDArray):
+        return np.stack([d.asnumpy() for d in data])
+    return np.stack([np.asarray(d) for d in data])
+
+
+def _to_device(batch, pin=False):
+    if isinstance(batch, tuple):
+        return tuple(_to_device(b) for b in batch)
+    return array(batch)
+
+
+_worker_dataset = None
+
+
+def _worker_init(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples, batchify_fn):
+    return batchify_fn([_worker_dataset[i] for i in samples])
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None, thread_pool=False):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with explicit sampler")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None else 2 * self._num_workers)
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.pool import ThreadPool
+
+                self._pool = ThreadPool(self._num_workers,
+                                        initializer=_worker_init, initargs=(dataset,))
+            else:
+                ctx = mp.get_context("fork")
+                self._pool = ctx.Pool(self._num_workers,
+                                      initializer=_worker_init, initargs=(dataset,))
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __iter__(self):
+        if self._pool is None:
+            prev = None  # 1-deep device prefetch: overlap H2D with consumption
+            for samples in self._batch_sampler:
+                batch = self._batchify_fn([self._dataset[i] for i in samples])
+                cur = _to_device(batch)
+                if prev is not None:
+                    yield prev
+                prev = cur
+            if prev is not None:
+                yield prev
+            return
+
+        # async pool pipeline with bounded in-flight requests
+        import collections
+
+        queue = collections.deque()
+        it = iter(self._batch_sampler)
+
+        def issue():
+            try:
+                samples = next(it)
+            except StopIteration:
+                return False
+            queue.append(self._pool.apply_async(_worker_fn, (samples, self._batchify_fn)))
+            return True
+
+        for _ in range(self._prefetch or 1):
+            if not issue():
+                break
+        while queue:
+            batch = queue.popleft().get()
+            issue()
+            yield _to_device(batch)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

@@ -1,0 +1,101 @@
+"""Vision transforms (reference: ``python/mxnet/gluon/data/vision/transforms.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import HybridSequential
+from ....ndarray import NDArray
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "RandomResizedCrop",
+           "Resize", "CenterCrop", "RandomFlipLeftRight"]
+
+
+class Compose(HybridSequential):
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def hybrid_forward(self, F, x):
+        x = F.cast(x, dtype="float32") / 255.0
+        if x.ndim == 3:
+            return x.transpose((2, 0, 1))
+        return x.transpose((0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean, self._std = mean, std
+
+    def hybrid_forward(self, F, x):
+        mean = jnp.asarray(self._mean, jnp.float32).reshape(-1, 1, 1)
+        std = jnp.asarray(self._std, jnp.float32).reshape(-1, 1, 1)
+        return (x - NDArray(mean)) / NDArray(std)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        import jax
+
+        h, w = self._size
+        if x.ndim == 3:
+            out = jax.image.resize(x._data.astype(jnp.float32), (h, w, x.shape[2]), "linear")
+        else:
+            out = jax.image.resize(x._data.astype(jnp.float32), (x.shape[0], h, w, x.shape[3]), "linear")
+        return NDArray(out.astype(x._data.dtype))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        ch, cw = self._size
+        h, w = x.shape[-3], x.shape[-2]
+        y0, x0 = (h - ch) // 2, (w - cw) // 2
+        return x[..., y0:y0 + ch, x0:x0 + cw, :]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3), interpolation=1):
+        super().__init__()
+        self._resize = Resize(size)
+
+    def forward(self, x):
+        import numpy as np
+
+        h, w = x.shape[-3], x.shape[-2]
+        ch = np.random.randint(h // 2, h + 1)
+        cw = np.random.randint(w // 2, w + 1)
+        y0 = np.random.randint(0, h - ch + 1)
+        x0 = np.random.randint(0, w - cw + 1)
+        return self._resize(x[..., y0:y0 + ch, x0:x0 + cw, :])
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        import numpy as np
+
+        if np.random.rand() < 0.5:
+            return NDArray(jnp.flip(x._data, axis=-2))
+        return x

@@ -1,0 +1,157 @@
+"""Loss blocks (reference: ``python/mxnet/gluon/loss.py``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss",
+           "SoftmaxCrossEntropyLoss", "SoftmaxCELoss", "KLDivLoss", "HuberLoss",
+           "HingeLoss", "CosineEmbeddingLoss"]
+
+
+def _apply_weighting(F, loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(F, x, y):
+    return x.reshape(y.shape)
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight, batch_axis, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        loss = F.square(label.reshape(pred.shape) - pred)
+        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
+        return loss.reshape((loss.shape[0], -1)).mean(axis=1)
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        loss = F.abs(label.reshape(pred.shape) - pred)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.reshape((loss.shape[0], -1)).mean(axis=1)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None, pos_weight=None):
+        label = label.reshape(pred.shape)
+        if not self._from_sigmoid:
+            # log-sum-exp stable bce on logits
+            max_val = F.maximum(-pred, 0.0 * pred)
+            loss = pred - pred * label + max_val + F.log(F.exp(-max_val) + F.exp(-pred - max_val))
+            if pos_weight is not None:
+                loss = loss + (pos_weight - 1) * label * (
+                    max_val + F.log(F.exp(-max_val) + F.exp(-pred - max_val)))
+        else:
+            eps = 1e-12
+            if pos_weight is None:
+                loss = -(F.log(pred + eps) * label + F.log(1 - pred + eps) * (1 - label))
+            else:
+                loss = -(F.log(pred + eps) * label * pos_weight
+                         + F.log(1 - pred + eps) * (1 - label))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.reshape((loss.shape[0], -1)).mean(axis=1)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Reference semantics: sparse labels by default, optional dense
+    (one-hot/soft) labels, from_logits, axis."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False, weight=None,
+                 batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -F.pick(pred, label, axis=self._axis, keepdims=False)
+        else:
+            label = label.reshape(pred.shape)
+            loss = -(pred * label).sum(axis=self._axis)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        if loss.ndim <= 1:
+            return loss
+        return loss.reshape((loss.shape[0], -1)).mean(axis=1)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred, axis=self._axis)
+        loss = label * (F.log(label + 1e-12) - pred)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.reshape((loss.shape[0], -1)).mean(axis=1)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        loss = F.abs(label.reshape(pred.shape) - pred)
+        loss = F.where(loss > self._rho,
+                       loss - 0.5 * self._rho,
+                       (0.5 / self._rho) * F.square(loss))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.reshape((loss.shape[0], -1)).mean(axis=1)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        loss = F.relu(self._margin - pred * label.reshape(pred.shape))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.reshape((loss.shape[0], -1)).mean(axis=1)
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
+        sim = (input1 * input2).sum(axis=1) / (
+            F.sqrt(F.square(input1).sum(axis=1)) * F.sqrt(F.square(input2).sum(axis=1)) + 1e-12)
+        label = label.reshape(sim.shape)
+        loss = F.where(label == 1, 1 - sim, F.relu(sim - self._margin))
+        return _apply_weighting(F, loss, self._weight, sample_weight)

@@ -1,0 +1,23 @@
+"""Vision zoo (reference: ``python/mxnet/gluon/model_zoo/vision/``)."""
+from .resnet import (  # noqa: F401
+    ResNetV1, ResNetV2, resnet18_v1, resnet34_v1, resnet50_v1, resnet101_v1,
+    resnet152_v1, resnet18_v2, resnet34_v2, resnet50_v2, resnet101_v2,
+    resnet152_v2, get_resnet,
+)
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .lenet import LeNet, lenet  # noqa: F401
+
+_models = {
+    "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1, "resnet50_v1": resnet50_v1,
+    "resnet101_v1": resnet101_v1, "resnet152_v1": resnet152_v1,
+    "resnet18_v2": resnet18_v2, "resnet34_v2": resnet34_v2, "resnet50_v2": resnet50_v2,
+    "resnet101_v2": resnet101_v2, "resnet152_v2": resnet152_v2,
+    "alexnet": alexnet, "lenet": lenet,
+}
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    if name not in _models:
+        raise ValueError(f"model {name!r} not in zoo; available: {sorted(_models)}")
+    return _models[name](**kwargs)

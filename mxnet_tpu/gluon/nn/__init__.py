@@ -1,0 +1,11 @@
+"""gluon.nn layer library (reference: ``python/mxnet/gluon/nn/``)."""
+from .basic_layers import (  # noqa: F401
+    Sequential, HybridSequential, Dense, Dropout, BatchNorm, LayerNorm,
+    InstanceNorm, Embedding, Flatten, Lambda, HybridLambda, Activation,
+    LeakyReLU, PReLU, ELU, SELU, Swish, GELU,
+)
+from .conv_layers import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv2DTranspose,
+    MaxPool1D, MaxPool2D, AvgPool1D, AvgPool2D,
+    GlobalMaxPool2D, GlobalAvgPool2D, GlobalAvgPool1D,
+)

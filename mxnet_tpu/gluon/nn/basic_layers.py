@@ -1,0 +1,319 @@
+"""Basic layers (reference: ``python/mxnet/gluon/nn/basic_layers.py``).
+
+Layers follow the reference's ``hybrid_forward(F, x, **params)`` protocol:
+``F`` is the functional namespace (``mx.nd`` here — also valid under jit
+tracing, which is how hybridization gets one code path for eager and
+compiled execution).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import autograd as _ag
+from ...base import dtype_np
+from ..block import Block, HybridBlock, record_state_update
+from ..parameter import Parameter
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "LayerNorm", "InstanceNorm", "Embedding", "Flatten", "Lambda",
+           "HybridLambda", "Activation", "LeakyReLU", "PReLU", "ELU", "SELU",
+           "Swish", "GELU"]
+
+
+class Sequential(Block):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for b in self._children.values():
+            x = b(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class HybridSequential(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for b in self._children.values():
+            x = b(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """FullyConnected layer (reference op: ``src/operator/nn/fully_connected.cc``)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None, bias_initializer="zeros",
+                 in_units=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._flatten = flatten
+        self._act = activation
+        with self.name_scope():
+            self.weight = self.params.get("weight", shape=(units, in_units),
+                                          dtype=dtype, init=weight_initializer,
+                                          allow_deferred_init=True)
+            self.bias = (self.params.get("bias", shape=(units,), dtype=dtype,
+                                         init=bias_initializer,
+                                         allow_deferred_init=True)
+                         if use_bias else None)
+
+    def infer_shape(self, x, *args):
+        in_units = int(jnp.prod(jnp.asarray(x.shape[1:]))) if self._flatten else x.shape[-1]
+        self.weight.shape = (self._units, in_units)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.FullyConnected(x, weight, bias, num_hidden=self._units,
+                               no_bias=bias is None, flatten=self._flatten)
+        if self._act:
+            out = F.Activation(out, act_type=self._act)
+        return out
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def hybrid_forward(self, F, x):
+        return F.Dropout(x, p=self._rate, axes=self._axes,
+                         training=_ag.is_training())
+
+
+class BatchNorm(HybridBlock):
+    """Reference: ``src/operator/nn/batch_norm.cc``. Moving stats update is
+    functional (state tape) instead of in-kernel aux mutation."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._momentum = momentum
+        self._eps = epsilon
+        self._center, self._scale = center, scale
+        self._use_global_stats = use_global_stats
+        with self.name_scope():
+            self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                         init=gamma_initializer, allow_deferred_init=True,
+                                         differentiable=scale)
+            self.beta = self.params.get("beta", shape=(in_channels,),
+                                        init=beta_initializer, allow_deferred_init=True,
+                                        differentiable=center)
+            self.running_mean = self.params.get("running_mean", shape=(in_channels,),
+                                                init=running_mean_initializer,
+                                                allow_deferred_init=True,
+                                                differentiable=False)
+            self.running_var = self.params.get("running_var", shape=(in_channels,),
+                                               init=running_variance_initializer,
+                                               allow_deferred_init=True,
+                                               differentiable=False)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p.shape = (c,)
+
+    def cast(self, dtype):
+        # moving stats stay f32 regardless of compute dtype (reference keeps
+        # aux states in f32 under AMP too)
+        super().cast(dtype)
+        self.running_mean.cast("float32")
+        self.running_var.cast("float32")
+        self.gamma.cast("float32")
+        self.beta.cast("float32")
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        training = _ag.is_training() and not self._use_global_stats
+        out, mean, var = F.BatchNorm(x, gamma, beta, running_mean, running_var,
+                                     eps=self._eps, momentum=self._momentum,
+                                     axis=self._axis, training=training,
+                                     use_global_stats=self._use_global_stats)
+        if training:
+            m = self._momentum
+            new_mean = m * running_mean._data + (1 - m) * mean._data
+            new_var = m * running_var._data + (1 - m) * var._data
+            record_state_update(self.running_mean, new_mean)
+            record_state_update(self.running_var, new_var)
+        return out
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._eps = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                         init=gamma_initializer, allow_deferred_init=True)
+            self.beta = self.params.get("beta", shape=(in_channels,),
+                                        init=beta_initializer, allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._eps)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._eps = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                         init=gamma_initializer, allow_deferred_init=True)
+            self.beta = self.params.get("beta", shape=(in_channels,),
+                                        init=beta_initializer, allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (x.shape[1],)
+        self.beta.shape = (x.shape[1],)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.InstanceNorm(x, gamma, beta, eps=self._eps)
+
+
+class Embedding(HybridBlock):
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_dim, self._output_dim = input_dim, output_dim
+        with self.name_scope():
+            self.weight = self.params.get("weight", shape=(input_dim, output_dim),
+                                          dtype=dtype, init=weight_initializer)
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, input_dim=self._input_dim,
+                           output_dim=self._output_dim)
+
+
+class Flatten(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.flatten(x)
+
+
+class Lambda(Block):
+    def __init__(self, function, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._fn = function
+
+    def forward(self, *args):
+        from ... import ndarray as nd
+
+        fn = getattr(nd, self._fn) if isinstance(self._fn, str) else self._fn
+        return fn(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._fn = function
+
+    def hybrid_forward(self, F, *args):
+        fn = getattr(F, self._fn) if isinstance(self._fn, str) else self._fn
+        if isinstance(self._fn, str):
+            return fn(*args)
+        return fn(F, *args)
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, prefix=None, params=None):
+        self._act = activation  # before super().__init__ — _alias() needs it
+        super().__init__(prefix=prefix, params=params)
+
+    def _alias(self):
+        return self._act if isinstance(self._act, str) else "activation"
+
+    def hybrid_forward(self, F, x):
+        return F.Activation(x, act_type=self._act)
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=None, in_channels=1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        from ... import initializer
+
+        with self.name_scope():
+            self.alpha = self.params.get("alpha", shape=(in_channels,),
+                                         init=alpha_initializer or initializer.Constant(0.25))
+
+    def hybrid_forward(self, F, x, alpha):
+        return F.LeakyReLU(x, gamma=alpha, act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="selu")
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._beta = beta
+
+    def hybrid_forward(self, F, x):
+        return x * F.sigmoid(self._beta * x)
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._approx = approximation
+
+    def hybrid_forward(self, F, x):
+        return F.Activation(x, act_type="gelu" if self._approx == "erf" else "tanh_gelu")

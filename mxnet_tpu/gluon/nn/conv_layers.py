@@ -1,0 +1,166 @@
+"""Convolution / pooling layers (reference: ``python/mxnet/gluon/nn/conv_layers.py``)."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose",
+           "MaxPool1D", "MaxPool2D", "AvgPool1D", "AvgPool2D",
+           "GlobalMaxPool2D", "GlobalAvgPool2D", "GlobalAvgPool1D"]
+
+
+def _tuple(v, n):
+    return tuple(v) if isinstance(v, (tuple, list)) else (int(v),) * n
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation, groups,
+                 use_bias, in_channels, activation, weight_initializer,
+                 bias_initializer, ndim, op_name="Convolution", adj=None,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = _tuple(kernel_size, ndim)
+        self._strides = _tuple(strides, ndim)
+        self._padding = _tuple(padding, ndim)
+        self._dilation = _tuple(dilation, ndim)
+        self._groups = groups
+        self._act = activation
+        self._op_name = op_name
+        self._adj = adj
+        self._ndim = ndim
+        with self.name_scope():
+            if op_name == "Deconvolution":
+                wshape = (in_channels, channels // groups) + self._kernel
+            else:
+                wshape = (channels, in_channels // groups if in_channels else 0) + self._kernel
+            self.weight = self.params.get("weight", shape=wshape,
+                                          init=weight_initializer, allow_deferred_init=True)
+            self.bias = (self.params.get("bias", shape=(channels,),
+                                         init=bias_initializer, allow_deferred_init=True)
+                         if use_bias else None)
+
+    def infer_shape(self, x, *args):
+        c_in = x.shape[1]
+        if self._op_name == "Deconvolution":
+            self.weight.shape = (c_in, self._channels // self._groups) + self._kernel
+        else:
+            self.weight.shape = (self._channels, c_in // self._groups) + self._kernel
+        if self.bias is not None:
+            self.bias.shape = (self._channels,)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        kw = dict(kernel=self._kernel, stride=self._strides, dilate=self._dilation,
+                  pad=self._padding, num_filter=self._channels, num_group=self._groups,
+                  no_bias=bias is None)
+        if self._op_name == "Deconvolution":
+            kw["adj"] = self._adj or (0,) * self._ndim
+            kw.pop("dilate")
+            out = F.Deconvolution(x, weight, bias, **kw)
+        else:
+            out = F.Convolution(x, weight, bias, **kw)
+        if self._act:
+            out = F.Activation(out, act_type=self._act)
+        return out
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0, dilation=1,
+                 groups=1, layout="NCW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros", in_channels=0,
+                 prefix=None, params=None):
+        super().__init__(channels, kernel_size, strides, padding, dilation, groups,
+                         use_bias, in_channels, activation, weight_initializer,
+                         bias_initializer, 1, prefix=prefix, params=params)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(channels, kernel_size, strides, padding, dilation, groups,
+                         use_bias, in_channels, activation, weight_initializer,
+                         bias_initializer, 2, prefix=prefix, params=params)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1), padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", activation=None,
+                 use_bias=True, weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(channels, kernel_size, strides, padding, dilation, groups,
+                         use_bias, in_channels, activation, weight_initializer,
+                         bias_initializer, 3, prefix=prefix, params=params)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1, layout="NCHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0, prefix=None, params=None):
+        super().__init__(channels, kernel_size, strides, padding, dilation, groups,
+                         use_bias, in_channels, activation, weight_initializer,
+                         bias_initializer, 2, op_name="Deconvolution",
+                         adj=_tuple(output_padding, 2), prefix=prefix, params=params)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, global_pool, pool_type,
+                 count_include_pad=True, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._kw = dict(kernel=pool_size, stride=strides or pool_size, pad=padding,
+                        global_pool=global_pool, pool_type=pool_type,
+                        count_include_pad=count_include_pad)
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x, **self._kw)
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW", **kw):
+        super().__init__((1, pool_size), (1, strides or pool_size), (0, padding),
+                         False, "max", **kw)
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x.expand_dims(2), **self._kw).squeeze(axis=2)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW", **kw):
+        super().__init__(pool_size, strides, padding, False, "max", **kw)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 count_include_pad=True, **kw):
+        super().__init__((1, pool_size), (1, strides or pool_size), (0, padding),
+                         False, "avg", count_include_pad, **kw)
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x.expand_dims(2), **self._kw).squeeze(axis=2)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0, layout="NCHW",
+                 count_include_pad=True, **kw):
+        super().__init__(pool_size, strides, padding, False, "avg",
+                         count_include_pad, **kw)
+
+
+class GlobalMaxPool2D(_Pool):
+    def __init__(self, layout="NCHW", **kw):
+        super().__init__((1, 1), None, 0, True, "max", **kw)
+
+
+class GlobalAvgPool2D(_Pool):
+    def __init__(self, layout="NCHW", **kw):
+        super().__init__((1, 1), None, 0, True, "avg", **kw)
+
+
+class GlobalAvgPool1D(_Pool):
+    def __init__(self, layout="NCW", **kw):
+        super().__init__((1, 1), None, 0, True, "avg", **kw)
+
+    def hybrid_forward(self, F, x):
+        return F.Pooling(x.expand_dims(2), **self._kw).squeeze(axis=2)

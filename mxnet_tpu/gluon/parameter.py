@@ -1,0 +1,277 @@
+"""Parameter / ParameterDict (reference: ``python/mxnet/gluon/parameter.py``).
+
+Deferred shape inference is kept: a Parameter created with 0-dims allocates at
+first forward. What is *dropped* is per-context replica management
+(``Parameter._init_impl`` keeping one copy per GPU) — a jax.Array is a single
+logical tensor whose sharding across TPU chips is decided by GSPMD, so
+``data()`` returns the one logical value on every device.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import initializer as init_mod
+from ..base import MXNetError, dtype_np
+from ..ndarray import NDArray
+from .. import random as _rng
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.grad_req = grad_req if differentiable else "null"
+        self.allow_deferred_init = allow_deferred_init
+        self._var = None
+        self._nd: Optional[NDArray] = None
+        self._deferred_init = None
+        # sharding hint consumed by mxnet_tpu.parallel (logical axis names per dim)
+        self.sharding_axes = None
+
+    # -- init ---------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None, force_reinit=False):
+        if self._nd is not None and not force_reinit:
+            return
+        default_init = default_init or init_mod.Uniform()
+        ini = self.init or init or default_init
+        if isinstance(ini, str):
+            ini = init_mod.create(ini)
+        if self.shape is None or any(s == 0 for s in self.shape):
+            if not self.allow_deferred_init:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} has unknown shape {self.shape} and "
+                    "allow_deferred_init=False")
+            self._deferred_init = (ini, ctx)
+            return
+        self._finish_init(ini, ctx)
+
+    def _finish_init(self, ini, ctx):
+        key = _rng.next_key()
+        data = ini.init_for_name(self.name, self.shape, self.dtype, key)
+        self._nd = NDArray(jnp.asarray(data, dtype_np(self.dtype)), ctx=ctx)
+        self._apply_grad_req()
+        self._deferred_init = None
+
+    def _finish_deferred_init(self, inferred_shape):
+        if self._deferred_init is None:
+            raise DeferredInitializationError(
+                f"Parameter {self.name} used before initialization; call "
+                ".initialize() first")
+        shape = tuple(
+            i if s == 0 or s is None else s
+            for s, i in zip(self.shape or inferred_shape, inferred_shape)
+        )
+        self.shape = shape
+        ini, ctx = self._deferred_init
+        self._finish_init(ini, ctx)
+
+    def _apply_grad_req(self):
+        if self.grad_req != "null":
+            self._nd._grad_req = self.grad_req
+            if self._nd._grad is None:
+                self._nd._grad = NDArray(jnp.zeros_like(self._nd._data))
+
+    # -- access -------------------------------------------------------------
+    def data(self, ctx=None) -> NDArray:
+        if self._nd is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} deferred-initialized; run a forward "
+                    "pass to infer its shape")
+            raise MXNetError(f"Parameter {self.name} not initialized")
+        return self._nd
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        d = self.data()
+        if d._grad is None:
+            raise MXNetError(f"Parameter {self.name} has grad_req='null'")
+        return d._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        return [self.data().context]
+
+    def zero_grad(self):
+        d = self.data()
+        if d._grad is not None:
+            d._grad._data = jnp.zeros_like(d._data)
+
+    def set_data(self, data):
+        raw = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        if self._nd is None:
+            self.shape = tuple(raw.shape)
+            self._nd = NDArray(raw.astype(dtype_np(self.dtype)))
+            self._apply_grad_req()
+        else:
+            self._nd._data = raw.astype(self._nd._data.dtype)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._nd is not None:
+            self._nd._data = self._nd._data.astype(dtype_np(dtype))
+            if self._nd._grad is not None:
+                self._nd._grad._data = self._nd._grad._data.astype(dtype_np(dtype))
+
+    def reset_ctx(self, ctx):
+        pass  # placement is GSPMD's job
+
+    def var(self):
+        from .. import symbol
+
+        if self._var is None:
+            self._var = symbol.var(self.name, shape=self.shape, dtype=self.dtype)
+        return self._var
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+
+class Constant(Parameter):
+    """Non-differentiable parameter with a fixed value."""
+
+    def __init__(self, name, value):
+        value = jnp.asarray(value._data if isinstance(value, NDArray) else value)
+        self.value = value
+
+        class _CInit(init_mod.Initializer):
+            def init_for_name(self, _name, _shape, _dtype, _key):
+                return value
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype.name, init=_CInit())
+
+
+class ParameterDict:
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def get(self, name, **kwargs):
+        """Create-or-retrieve (the layer-side param declaration API)."""
+        name = self._prefix + name
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared:
+            self._params[name] = self._shared[name]
+            return self._params[name]
+        p = Parameter(name, **kwargs)
+        self._params[name] = p
+        return p
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = Constant(name, value)
+        return self._params[name]
+
+    def update(self, other):
+        for k, v in other.items():
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        for p in self.values():
+            p.initialize(init=init, ctx=ctx, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            if p._nd is not None and p.grad_req != "null":
+                p.zero_grad()
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def reset_ctx(self, ctx):
+        pass
+
+    def cast(self, dtype):
+        for p in self.values():
+            p.cast(dtype)
+
+    # -- pytree bridge (used by parallel.train_step / checkpointing) ---------
+    def to_pytree(self):
+        return {k: p.data()._data for k, p in self.items() if p._nd is not None}
+
+    def load_pytree(self, tree):
+        for k, v in tree.items():
+            self._params[k].set_data(v)
+
+    # -- serialization -------------------------------------------------------
+    def save(self, filename, strip_prefix=""):
+        from ..serialization import save_ndarrays
+
+        d = {}
+        for name, p in self.items():
+            if p._nd is None:
+                continue
+            key = name[len(strip_prefix):] if name.startswith(strip_prefix) else name
+            d[key] = p.data()
+        save_ndarrays(filename, d)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..serialization import load_ndarrays
+
+        loaded = load_ndarrays(filename)
+        loaded = {restore_prefix + k.removeprefix("arg:").removeprefix("aux:"): v
+                  for k, v in loaded.items()}
+        for name, p in self.items():
+            if name in loaded:
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise MXNetError(f"Parameter {name} missing in file {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise MXNetError(f"File {filename} has unknown parameters {sorted(extra)[:5]}")
+
+    def __repr__(self):
+        lines = "\n".join(f"  {p!r}" for p in self.values())
+        return f"ParameterDict (\n{lines}\n)"

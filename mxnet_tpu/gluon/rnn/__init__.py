@@ -1,0 +1,3 @@
+"""gluon.rnn (reference: ``python/mxnet/gluon/rnn/``)."""
+from .rnn_layer import RNN, LSTM, GRU  # noqa: F401
+from .rnn_cell import RNNCell, LSTMCell, GRUCell, SequentialRNNCell  # noqa: F401

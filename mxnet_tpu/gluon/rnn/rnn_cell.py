@@ -1,0 +1,115 @@
+"""Unfused RNN cells (reference: ``python/mxnet/gluon/rnn/rnn_cell.py``)."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+
+__all__ = ["RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell"]
+
+
+class _BaseCell(HybridBlock):
+    def __init__(self, hidden_size, input_size=0, ngates=1, prefix=None, params=None,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self._ng = ngates
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(ngates * hidden_size, input_size),
+                                              init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(ngates * hidden_size, hidden_size),
+                                              init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(ngates * hidden_size,),
+                                            init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(ngates * hidden_size,),
+                                            init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._ng * self._hidden_size, x.shape[-1])
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as nd
+
+        n = 2 if isinstance(self, LSTMCell) else 1
+        return [nd.zeros((batch_size, self._hidden_size)) for _ in range(n)]
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC", merge_outputs=None,
+               valid_length=None):
+        from ... import ndarray as nd
+
+        axis = layout.find("T")
+        states = begin_state or self.begin_state(inputs.shape[1 - axis if axis == 0 else 0])
+        outputs = []
+        for t in range(length):
+            x_t = inputs.slice_axis(axis=axis, begin=t, end=t + 1).squeeze(axis=axis)
+            out, states = self(x_t, states)
+            outputs.append(out)
+        if merge_outputs or merge_outputs is None:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+
+class RNNCell(_BaseCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(hidden_size, input_size, 1, **kwargs)
+        self._activation = activation
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias, h2h_bias):
+        h = states[0] if isinstance(states, (list, tuple)) else states
+        out = F.Activation(
+            F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=self._hidden_size)
+            + F.FullyConnected(h, h2h_weight, h2h_bias, num_hidden=self._hidden_size),
+            act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(_BaseCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, input_size, 4, **kwargs)
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias, h2h_bias):
+        h, c = states
+        gates = (F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=4 * self._hidden_size)
+                 + F.FullyConnected(h, h2h_weight, h2h_bias, num_hidden=4 * self._hidden_size))
+        i, f, g, o = F.split(gates, num_outputs=4, axis=-1)
+        c_new = F.sigmoid(f) * c + F.sigmoid(i) * F.tanh(g)
+        h_new = F.sigmoid(o) * F.tanh(c_new)
+        return h_new, [h_new, c_new]
+
+
+class GRUCell(_BaseCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, input_size, 3, **kwargs)
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias, h2h_bias):
+        h = states[0] if isinstance(states, (list, tuple)) else states
+        xz = F.FullyConnected(x, i2h_weight, i2h_bias, num_hidden=3 * self._hidden_size)
+        hz = F.FullyConnected(h, h2h_weight, h2h_bias, num_hidden=3 * self._hidden_size)
+        xr, xu, xn = F.split(xz, num_outputs=3, axis=-1)
+        hr, hu, hn = F.split(hz, num_outputs=3, axis=-1)
+        r = F.sigmoid(xr + hr)
+        u = F.sigmoid(xu + hu)
+        n = F.tanh(xn + r * hn)
+        h_new = (1 - u) * n + u * h
+        return h_new, [h_new]
+
+
+class SequentialRNNCell(_BaseCell):
+    def __init__(self, prefix=None, params=None):
+        HybridBlock.__init__(self, prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        states = []
+        for c in self._children.values():
+            states.append(c.begin_state(batch_size, **kwargs))
+        return states
+
+    def hybrid_forward(self, F, x, states):
+        next_states = []
+        for cell, s in zip(self._children.values(), states):
+            x, ns = cell(x, s)
+            next_states.append(ns)
+        return x, next_states

@@ -1,0 +1,112 @@
+"""Fused RNN layers (reference: ``python/mxnet/gluon/rnn/rnn_layer.py``).
+
+The reference binds cuDNN's fused RNN descriptors; the TPU build lowers to a
+``lax.scan`` over fused-gate cells (``mxnet_tpu.ops.nn.rnn``) with the same
+cuDNN-compatible flat parameter vector, so checkpoints interoperate.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers=1, layout="TNC", dropout=0.0,
+                 bidirectional=False, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout}")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        ng = _GATES[mode]
+        with self.name_scope():
+            # flat cuDNN-layout parameter (reference rnn-inl.h param layout)
+            self.parameters = self.params.get(
+                "rnn_param", shape=(self._param_size(input_size) if input_size else 0,),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+        self._ng = ng
+
+    def _param_size(self, input_size):
+        ng = _GATES[self._mode]
+        h, d, L = self._hidden_size, self._dir, self._num_layers
+        size = 0
+        for layer in range(L):
+            in_dim = input_size if layer == 0 else h * d
+            size += d * (ng * h * in_dim + ng * h * h)  # weights
+        size += L * d * 2 * ng * h  # biases
+        return size
+
+    def infer_shape(self, x, *args):
+        in_size = x.shape[-1]
+        self._input_size = in_size
+        self.parameters.shape = (self._param_size(in_size),)
+
+    def state_info(self, batch_size=0):
+        if self._mode == "lstm":
+            return [{"shape": (self._num_layers * self._dir, batch_size, self._hidden_size)}] * 2
+        return [{"shape": (self._num_layers * self._dir, batch_size, self._hidden_size)}]
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        from ... import ndarray as nd
+
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        if self._mode == "lstm":
+            return [nd.zeros(shape), nd.zeros(shape)]
+        return [nd.zeros(shape)]
+
+    def hybrid_forward(self, F, x, *states, **params):
+        parameters = params["parameters"]
+        from ... import autograd as _ag
+
+        ntc = self._layout == "NTC"
+        if ntc:
+            x = x.swapaxes(0, 1)
+        if not states:
+            states = self.begin_state(x.shape[1])
+            skip_states = True
+        else:
+            if len(states) == 1 and isinstance(states[0], (list, tuple)):
+                states = list(states[0])
+            skip_states = False
+        h0 = states[0]
+        c0 = states[1] if len(states) > 1 else None
+        out, h_n, c_n = F.RNN(x, parameters, h0, c0,
+                              state_size=self._hidden_size,
+                              num_layers=self._num_layers, mode=self._mode,
+                              bidirectional=self._dir == 2, p=self._dropout,
+                              training=_ag.is_training())
+        if ntc:
+            out = out.swapaxes(0, 1)
+        if skip_states:
+            return out
+        if self._mode == "lstm":
+            return out, [h_n, c_n]
+        return out, [h_n]
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu", **kwargs):
+        super().__init__(f"rnn_{activation}", hidden_size, num_layers, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, **kwargs)

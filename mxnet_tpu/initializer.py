@@ -1,0 +1,155 @@
+"""Initializer registry (reference: ``python/mxnet/initializer.py``).
+
+Initializers are pure: ``init_array(name, shape, dtype, key)`` returns a jax
+array. Name-based dispatch (`.*weight` → init, `.*bias` → zero, etc.) matches
+the reference's ``InitDesc`` pattern matching.
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import jax
+import jax.numpy as jnp
+
+from .base import dtype_np
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "registry", "create"]
+
+
+class Initializer:
+    def init_array(self, shape, dtype, key):
+        raise NotImplementedError
+
+    # dispatch mimicking reference InitDesc attr handling
+    def __call__(self, desc, arr=None):
+        from .ndarray import NDArray
+
+        name = desc if isinstance(desc, str) else getattr(desc, "name", str(desc))
+        key = jax.random.key(abs(hash(name)) % (2 ** 31))
+        data = self.init_for_name(name, arr.shape, arr.dtype, key)
+        arr._data = jnp.asarray(data, arr._data.dtype)
+
+    def init_for_name(self, name, shape, dtype, key):
+        if name.endswith("bias") or name.endswith("beta") or name.endswith("running_mean"):
+            return jnp.zeros(shape, dtype_np(dtype))
+        if name.endswith("gamma") or name.endswith("running_var"):
+            return jnp.ones(shape, dtype_np(dtype))
+        return self.init_array(shape, dtype, key)
+
+
+class Zero(Initializer):
+    def init_array(self, shape, dtype, key):
+        return jnp.zeros(shape, dtype_np(dtype))
+
+
+class One(Initializer):
+    def init_array(self, shape, dtype, key):
+        return jnp.ones(shape, dtype_np(dtype))
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def init_array(self, shape, dtype, key):
+        return jnp.full(shape, self.value, dtype_np(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        self.scale = scale
+
+    def init_array(self, shape, dtype, key):
+        return jax.random.uniform(key, shape, jnp.float32, -self.scale, self.scale).astype(dtype_np(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        self.sigma = sigma
+
+    def init_array(self, shape, dtype, key):
+        return (jax.random.normal(key, shape, jnp.float32) * self.sigma).astype(dtype_np(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        self.scale = scale
+
+    def init_array(self, shape, dtype, key):
+        flat = (shape[0], int(jnp.prod(jnp.array(shape[1:])))) if len(shape) > 1 else (shape[0], 1)
+        a = jax.random.normal(key, flat, jnp.float32)
+        q, r = jnp.linalg.qr(a if flat[0] >= flat[1] else a.T)
+        q = q if flat[0] >= flat[1] else q.T
+        q = q * jnp.sign(jnp.diagonal(r))[None, :q.shape[1]]
+        return (self.scale * q.reshape(shape)).astype(dtype_np(dtype))
+
+
+def _fan(shape):
+    if len(shape) < 2:
+        return shape[0] if shape else 1, shape[0] if shape else 1
+    receptive = 1
+    for s in shape[2:]:
+        receptive *= s
+    fan_in = shape[1] * receptive
+    fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        self.rnd_type, self.factor_type, self.magnitude = rnd_type, factor_type, float(magnitude)
+
+    def init_array(self, shape, dtype, key):
+        fan_in, fan_out = _fan(shape)
+        factor = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in, "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / max(factor, 1.0))
+        if self.rnd_type == "uniform":
+            out = jax.random.uniform(key, shape, jnp.float32, -scale, scale)
+        else:
+            out = jax.random.normal(key, shape, jnp.float32) * scale
+        return out.astype(dtype_np(dtype))
+
+
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+
+
+class Bilinear(Initializer):
+    def init_array(self, shape, dtype, key):
+        import numpy as np
+
+        weight = np.zeros(shape, dtype="float32")
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight, dtype_np(dtype))
+
+
+class LSTMBias(Initializer):
+    def __init__(self, forget_bias=1.0):
+        self.forget_bias = forget_bias
+
+    def init_array(self, shape, dtype, key):
+        b = jnp.zeros(shape, jnp.float32)
+        n = shape[0] // 4
+        return b.at[n:2 * n].set(self.forget_bias).astype(dtype_np(dtype))
+
+
+registry = {
+    "zeros": Zero, "zero": Zero, "ones": One, "one": One, "constant": Constant,
+    "uniform": Uniform, "normal": Normal, "gaussian": Normal, "orthogonal": Orthogonal,
+    "xavier": Xavier, "msra_prelu": MSRAPrelu, "bilinear": Bilinear, "lstmbias": LSTMBias,
+}
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    return registry[name.lower()](**kwargs)

@@ -1,0 +1,4 @@
+"""Data iterators (reference: ``src/io/`` + ``python/mxnet/io/``)."""
+from .io import DataIter, DataBatch, DataDesc, NDArrayIter, ResizeIter, PrefetchingIter  # noqa: F401
+from . import recordio  # noqa: F401
+from .recordio import MXRecordIO, IndexedRecordIO  # noqa: F401

@@ -1,0 +1,174 @@
+"""RecordIO (reference: dmlc-core recordio + ``python/mxnet/recordio.py``).
+
+Binary-compatible with the dmlc RecordIO on-disk format: each record is
+``[kMagic u32][lrec u32][payload][pad to 4B]`` where lrec encodes
+``cflag`` (top 3 bits, for multi-chunk records) and length (lower 29).
+``IRHeader`` packing matches ``python/mxnet/recordio.py`` so ``.rec`` image
+packs built by the reference's ``tools/im2rec.py`` load unchanged.
+
+A C++ reader with the same format lives in ``native/`` (built optionally);
+this pure-Python version is the always-available fallback.
+"""
+from __future__ import annotations
+
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["MXRecordIO", "IndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_KMAGIC = 0xCED7230A
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+
+
+class MXRecordIO:
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self._f = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self._f = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError(f"invalid flag {self.flag}")
+
+    def close(self):
+        self._f.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self):
+        self._f.seek(0)
+
+    def tell(self):
+        return self._f.tell()
+
+    def write(self, buf: bytes):
+        assert self.writable
+        lrec = len(buf)  # single-chunk record: cflag=0
+        self._f.write(struct.pack("<II", _KMAGIC, lrec))
+        self._f.write(buf)
+        pad = (-len(buf)) % 4
+        if pad:
+            self._f.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        hdr = self._f.read(8)
+        if len(hdr) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", hdr)
+        if magic != _KMAGIC:
+            raise MXNetError("corrupt RecordIO: bad magic")
+        cflag = lrec >> 29
+        length = lrec & ((1 << 29) - 1)
+        buf = self._f.read(length)
+        self._f.read((-length) % 4)
+        if cflag != 0:
+            # multi-chunk record: keep reading continuation chunks
+            parts = [buf]
+            while cflag in (1, 2):
+                magic, lrec = struct.unpack("<II", self._f.read(8))
+                cflag = lrec >> 29
+                length = lrec & ((1 << 29) - 1)
+                parts.append(self._f.read(length))
+                self._f.read((-length) % 4)
+                if cflag == 3:
+                    break
+            buf = b"".join(parts)
+        return buf
+
+
+class IndexedRecordIO(MXRecordIO):
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if flag == "r":
+            with open(idx_path) as f:
+                for line in f:
+                    k, v = line.strip().split("\t")
+                    k = key_type(k)
+                    self.idx[k] = int(v)
+                    self.keys.append(k)
+
+    def close(self):
+        super().close()
+        if self.writable and self.idx:
+            with open(self.idx_path, "w") as f:
+                for k in self.keys:
+                    f.write(f"{k}\t{self.idx[k]}\n")
+            self.idx = {}
+
+    def read_idx(self, idx):
+        self._f.seek(self.idx[idx])
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack("<IfQQ", 0, float(header.label), header.id, header.id2)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        hdr = struct.pack("<IfQQ", label.size, 0.0, header.id, header.id2) + label.tobytes()
+    return hdr + s
+
+
+def unpack(s: bytes):
+    flag, label, id_, id2 = struct.unpack("<IfQQ", s[:24])
+    s = s[24:]
+    if flag > 0:
+        label = np.frombuffer(s[:flag * 4], dtype=np.float32)
+        s = s[flag * 4:]
+    return IRHeader(flag, label, id_, id2), s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack a raw HWC uint8 array. Without OpenCV, stores lossless npy bytes
+    (readers detect the format by magic)."""
+    import io as _io
+
+    buf = _io.BytesIO()
+    np.save(buf, np.asarray(img, dtype=np.uint8))
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=-1):
+    header, img_bytes = unpack(s)
+    import io as _io
+
+    if img_bytes[:6] == b"\x93NUMPY":
+        img = np.load(_io.BytesIO(img_bytes))
+    else:
+        try:
+            import PIL.Image
+
+            img = np.asarray(PIL.Image.open(_io.BytesIO(img_bytes)))
+        except Exception as e:
+            raise MXNetError("cannot decode image payload (no OpenCV/PIL jpeg "
+                             "decoder available)") from e
+    return header, img

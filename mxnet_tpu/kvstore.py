@@ -1,0 +1,161 @@
+"""KVStore facade (reference: ``src/kvstore/`` + ``python/mxnet/kvstore/``).
+
+Design stance (SURVEY §5.8): the *compiler is the communication library*.
+  - ``local`` / ``device``: single-controller — a jax.Array is one logical
+    tensor across all chips of the mesh, so push/pull reduce to in-place
+    accumulate and copy; cross-chip reduction happens inside compiled
+    programs as GSPMD-inserted all-reduces over ICI (not here).
+  - ``dist_sync`` / ``dist_async``: multi-process — push performs a psum
+    across ``jax.distributed`` processes via a tiny compiled collective
+    (DCN), replacing ps-lite's ZMQ parameter server; there is no server
+    role — state stays sharded with the workers.
+  - ``nccl``: alias of ``device`` (no NCCL anywhere in this build).
+
+``Trainer`` is the blessed path; raw KVStore is kept correct but simple.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+class KVStore:
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store: Dict = {}
+        self._updater = None
+        self._optimizer = None
+        self.is_distributed = kv_type.startswith("dist")
+        self._num_workers = 1
+        if self.is_distributed:
+            self._num_workers = jax.process_count()
+
+    # -- core API ------------------------------------------------------------
+    def init(self, key, value):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = NDArray(jnp.asarray(v._data))
+
+    def push(self, key, value, priority=0):
+        keys, values = self._normalize(key, value)
+        for k, v in zip(keys, values):
+            if isinstance(v, (list, tuple)):
+                # multi-device push: the reference reduced replicas here; a
+                # jax.Array is already one logical value, so sum the list.
+                agg = v[0]._data
+                for x in v[1:]:
+                    agg = agg + x._data
+            else:
+                agg = v._data
+            if self.is_distributed:
+                agg = _dcn_psum(agg)
+            if self._updater is not None:
+                grad = NDArray(agg)
+                self._updater(k, grad, self._store[k])
+            else:
+                self._store[k] = NDArray(agg if k not in self._store or self.type != "dist_async"
+                                         else self._store[k]._data + agg)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._normalize(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized in kvstore")
+            val = self._store[k]
+            if isinstance(o, (list, tuple)):
+                for x in o:
+                    x._data = val._data
+            else:
+                o._data = val._data
+        return None
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise MXNetError("row_sparse storage is not supported on TPU (SURVEY §2.2); "
+                         "use dense parameters")
+
+    def set_gradient_compression(self, compression_params):
+        # 2-bit push compression targeted PCIe/ethernet; ICI/DCN collectives
+        # don't need it. Accepted and ignored for script compat.
+        self._compression = dict(compression_params)
+
+    def set_optimizer(self, optimizer):
+        from .optimizer import get_updater
+
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    @property
+    def rank(self):
+        return jax.process_index() if self.is_distributed else 0
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def barrier(self):
+        if self.is_distributed:
+            _dcn_psum(jnp.zeros(()))
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    @staticmethod
+    def _normalize(key, value):
+        if isinstance(key, (list, tuple)):
+            return list(key), list(value)
+        return [key], [value]
+
+
+def _dcn_psum(x):
+    """All-reduce across processes (multi-host DP over DCN)."""
+    if jax.process_count() == 1:
+        return x
+    n = jax.device_count()
+    mesh = jax.sharding.Mesh(jax.devices(), ("workers",))
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    summed = jax.jit(shard_map(lambda v: jax.lax.psum(v, "workers"),
+                               mesh=mesh, in_specs=P(), out_specs=P()))(x)
+    return summed
+
+
+def create(name="local"):
+    if name is None:
+        return None
+    if not isinstance(name, str):
+        return name
+    name = name.lower()
+    if name in ("local", "device", "nccl", "local_allreduce_cpu", "local_allreduce_device"):
+        return KVStore(name if name in ("local", "device") else "device")
+    if name in ("dist_sync", "dist_async", "dist_device_sync", "dist"):
+        return KVStore(name)
+    if name in ("horovod",):
+        return KVStore("device")
+    raise MXNetError(f"unknown kvstore type {name!r}")

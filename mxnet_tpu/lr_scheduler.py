@@ -1,0 +1,90 @@
+"""LR schedules (reference: ``python/mxnet/lr_scheduler.py``).
+
+Schedules are pure functions of the update count; they compose with warmup
+exactly like the reference (warmup_steps + warmup_mode linear/constant).
+They accept traced step values, so a schedule can live *inside* a jitted
+train step (the TPU-idiomatic placement, unlike the reference's host-side
+evaluation per batch).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
+           "PolyScheduler", "CosineScheduler"]
+
+
+class LRScheduler:
+    def __init__(self, base_lr=0.01, warmup_steps=0, warmup_begin_lr=0.0, warmup_mode="linear"):
+        self.base_lr = base_lr
+        self.warmup_steps = warmup_steps
+        self.warmup_begin_lr = warmup_begin_lr
+        self.warmup_final_lr = base_lr
+        self.warmup_mode = warmup_mode
+
+    def get_warmup_lr(self, num_update):
+        if self.warmup_mode == "linear":
+            inc = (self.warmup_final_lr - self.warmup_begin_lr) * num_update / max(self.warmup_steps, 1)
+            return self.warmup_begin_lr + inc
+        return self.warmup_begin_lr
+
+    def base_call(self, num_update):
+        raise NotImplementedError
+
+    def __call__(self, num_update):
+        if self.warmup_steps:
+            return jnp.where(
+                jnp.asarray(num_update) < self.warmup_steps,
+                self.get_warmup_lr(jnp.asarray(num_update, jnp.float32)),
+                self.base_call(num_update),
+            )
+        return self.base_call(num_update)
+
+
+class FactorScheduler(LRScheduler):
+    def __init__(self, step, factor=1.0, stop_factor_lr=1e-8, base_lr=0.01, **kw):
+        super().__init__(base_lr, **kw)
+        self.step, self.factor, self.stop_factor_lr = step, factor, stop_factor_lr
+
+    def base_call(self, num_update):
+        n = jnp.asarray(num_update) // self.step
+        lr = self.base_lr * jnp.power(self.factor, n.astype(jnp.float32))
+        return jnp.maximum(lr, self.stop_factor_lr)
+
+
+class MultiFactorScheduler(LRScheduler):
+    def __init__(self, step, factor=1.0, base_lr=0.01, **kw):
+        super().__init__(base_lr, **kw)
+        self.step, self.factor = list(step), factor
+
+    def base_call(self, num_update):
+        n = jnp.zeros((), jnp.float32)
+        for s in self.step:
+            n = n + (jnp.asarray(num_update) >= s).astype(jnp.float32)
+        return self.base_lr * jnp.power(self.factor, n)
+
+
+class PolyScheduler(LRScheduler):
+    def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0.0, **kw):
+        super().__init__(base_lr, **kw)
+        self.max_update, self.pwr, self.final_lr = max_update, pwr, final_lr
+
+    def base_call(self, num_update):
+        frac = jnp.clip(jnp.asarray(num_update, jnp.float32) - self.warmup_steps, 0, None) / max(
+            self.max_update - self.warmup_steps, 1)
+        frac = jnp.clip(frac, 0.0, 1.0)
+        return self.final_lr + (self.base_lr - self.final_lr) * jnp.power(1 - frac, self.pwr)
+
+
+class CosineScheduler(LRScheduler):
+    def __init__(self, max_update, base_lr=0.01, final_lr=0.0, **kw):
+        super().__init__(base_lr, **kw)
+        self.max_update, self.final_lr = max_update, final_lr
+
+    def base_call(self, num_update):
+        frac = jnp.clip(jnp.asarray(num_update, jnp.float32) - self.warmup_steps, 0, None) / max(
+            self.max_update - self.warmup_steps, 1)
+        frac = jnp.clip(frac, 0.0, 1.0)
+        return self.final_lr + (self.base_lr - self.final_lr) * (1 + jnp.cos(math.pi * frac)) / 2

@@ -1,0 +1,516 @@
+"""``mx.nd`` — imperative NDArray API over ``jax.Array``.
+
+Reference: ``src/ndarray/ndarray.cc`` + ``python/mxnet/ndarray/`` — an async
+tensor whose every mutation is an engine op with read/write var deps. On TPU
+the dependency engine is deleted outright (SURVEY §1): ``jax.Array`` is
+already an async future scheduled by XLA's dataflow, so ``wait_to_read`` is
+``block_until_ready`` and "mutation" is functional rebinding of the
+underlying buffer (``x[:] = v`` → ``x._data = x._data.at[...].set(v)``),
+which preserves MXNet's user-visible aliasing behavior on the *handle* level
+(NDArray identity) without shared-buffer mutation.
+
+The op surface (``mx.nd.dot`` etc.) is code-generated from the central
+registry, mirroring the reference's import-time codegen
+(``python/mxnet/ndarray/register.py`` over ``MXSymbolListAtomicSymbolCreators``).
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import autograd as _ag
+from .. import ops as _ops  # noqa: F401  (populates the registry)
+from .. import random as _rng
+from .. import registry as _registry
+from ..base import MXNetError, dtype_np
+from ..context import Context, current_context
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange", "waitall", "concat", "stack"]
+
+
+_pyslice = slice  # the op codegen below registers an op named "slice"
+
+
+def _wrap(raw, ctx=None):
+    return NDArray(raw, ctx=ctx)
+
+
+def _raw(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+class NDArray:
+    """Tensor handle wrapping a ``jax.Array`` (or a tracer under jit)."""
+
+    __slots__ = ("_data", "_ctx", "_tape", "_grad", "_grad_req", "__weakref__")
+    __array_priority__ = 100.0
+
+    def __init__(self, data, ctx=None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if dtype is not None:
+            data = jnp.asarray(data, dtype_np(dtype))
+        elif not isinstance(data, (jax.Array, jax.core.Tracer)):
+            data = jnp.asarray(data)
+        if ctx is not None and not isinstance(data, jax.core.Tracer):
+            data = jax.device_put(data, Context(ctx).jax_device if not isinstance(ctx, Context) else ctx.jax_device)
+        self._data = data
+        self._ctx = ctx if isinstance(ctx, Context) else (Context(ctx) if ctx else None)
+        self._tape = None
+        self._grad = None
+        self._grad_req = "null"
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype) if self._data.dtype.name != "bfloat16" else self._data.dtype
+
+    @property
+    def size(self):
+        return int(_np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        if self._ctx is not None:
+            return self._ctx
+        dev = getattr(self._data, "device", None)
+        if dev is None or isinstance(self._data, jax.core.Tracer):
+            return current_context()
+        plat = getattr(dev, "platform", "cpu")
+        return Context("cpu" if plat == "cpu" else "gpu", getattr(dev, "id", 0))
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"  # sparse storage types are not carried on TPU (SURVEY §2.2)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    # -- sync / host interop ------------------------------------------------
+    def wait_to_read(self):
+        jax.block_until_ready(self._data)
+        return self
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        return _np.asarray(jax.device_get(self._data))
+
+    def asscalar(self):
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        return bool(self.asnumpy().reshape(()).item()) if self.size == 1 else self.size > 0
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError("len() of 0-d NDArray")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, **kw):
+        return self._data.__dlpack__(**kw)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
+    def __repr__(self):
+        try:
+            body = str(self.asnumpy())
+        except Exception:
+            body = f"<traced {self.shape} {self._data.dtype}>"
+        return f"\n{body}\n<NDArray {'x'.join(map(str, self.shape))} @{self.context}>"
+
+    # -- autograd -----------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        self._grad_req = grad_req
+        self._grad = NDArray(jnp.zeros_like(self._data))
+
+    def _empty_like(self):
+        return NDArray(jnp.zeros_like(self._data))
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _ag.backward([self], [out_grad] if out_grad is not None else None,
+                     retain_graph=retain_graph, train_mode=train_mode)
+
+    def detach(self):
+        out = NDArray(self._data, ctx=self._ctx)
+        return out
+
+    # -- conversion / copies ------------------------------------------------
+    def astype(self, dtype, copy=True):
+        return _invoke_name("cast", (self,), {"dtype": dtype})
+
+    def copy(self):
+        return NDArray(self._data + 0 if False else jnp.copy(self._data), ctx=self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return NDArray(self._data, ctx=other)
+        other._data = jnp.asarray(self._data, other._data.dtype)
+        return other
+
+    def as_in_context(self, ctx):
+        if isinstance(self._data, jax.core.Tracer):
+            return self
+        return NDArray(self._data, ctx=ctx)
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXNetError("sparse storage types are not supported on TPU")
+        return self
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, key):
+        key = _raw_index(key)
+        if _ag.is_recording():
+            def _slice(x, key=key):
+                return x[key]
+            node = _ag.TapeNode(_slice, {}, [self], 1, "getitem")
+            out = _wrap(_slice(self._data))
+            out._tape = (node, 0)
+            return out
+        return _wrap(self._data[key])
+
+    def __setitem__(self, key, value):
+        value = _raw(value)
+        if isinstance(key, _pyslice) and key == _pyslice(None):
+            self._data = jnp.broadcast_to(jnp.asarray(value, self._data.dtype), self.shape)
+        else:
+            self._data = self._data.at[_raw_index(key)].set(jnp.asarray(value, self._data.dtype))
+
+    # -- arithmetic (recorded through the registry) -------------------------
+    def _binop(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, NDArray) or isinstance(other, (jax.Array, jax.core.Tracer, _np.ndarray)):
+            o = other if isinstance(other, NDArray) else NDArray(other)
+            a, b = (o, self) if reverse else (self, o)
+            return _invoke_name(op, (a, b), {})
+        return _invoke_name(scalar_op[1] if reverse and scalar_op[1] else scalar_op[0],
+                            (self,), {"scalar": other})
+
+    def __add__(self, o): return self._binop(o, "add", ("_plus_scalar", None))
+    __radd__ = __add__
+    def __sub__(self, o): return self._binop(o, "subtract", ("_minus_scalar", None))
+    def __rsub__(self, o): return self._binop(o, "subtract", (None, "_rminus_scalar"), reverse=True) if isinstance(o, (NDArray, jax.Array, _np.ndarray)) else _invoke_name("_rminus_scalar", (self,), {"scalar": o})
+    def __mul__(self, o): return self._binop(o, "multiply", ("_mul_scalar", None))
+    __rmul__ = __mul__
+    def __truediv__(self, o): return self._binop(o, "divide", ("_div_scalar", None))
+    def __rtruediv__(self, o): return self._binop(o, "divide", (None, "_rdiv_scalar"), reverse=True) if isinstance(o, (NDArray, jax.Array, _np.ndarray)) else _invoke_name("_rdiv_scalar", (self,), {"scalar": o})
+    def __mod__(self, o): return self._binop(o, "mod", ("_mod_scalar", None))
+    def __pow__(self, o): return self._binop(o, "power", ("_power_scalar", None))
+    def __rpow__(self, o): return _invoke_name("_rpower_scalar", (self,), {"scalar": o})
+    def __matmul__(self, o): return _invoke_name("dot", (self, o if isinstance(o, NDArray) else NDArray(o)), {})
+    def __neg__(self): return _invoke_name("negative", (self,), {})
+    def __abs__(self): return _invoke_name("abs", (self,), {})
+
+    def __iadd__(self, o):
+        self._data = self._data + _raw(o)
+        return self
+
+    def __isub__(self, o):
+        self._data = self._data - _raw(o)
+        return self
+
+    def __imul__(self, o):
+        self._data = self._data * _raw(o)
+        return self
+
+    def __itruediv__(self, o):
+        self._data = self._data / _raw(o)
+        return self
+
+    def __eq__(self, o): return _invoke_name("equal", (self, NDArray(o)), {}) if _is_arr(o) else _invoke_name("equal", (self, NDArray(jnp.asarray(o))), {})
+    def __ne__(self, o): return _invoke_name("not_equal", (self, NDArray(jnp.asarray(_raw(o)))), {})
+    def __gt__(self, o): return _invoke_name("greater", (self, NDArray(jnp.asarray(_raw(o)))), {})
+    def __ge__(self, o): return _invoke_name("greater_equal", (self, NDArray(jnp.asarray(_raw(o)))), {})
+    def __lt__(self, o): return _invoke_name("lesser", (self, NDArray(jnp.asarray(_raw(o)))), {})
+    def __le__(self, o): return _invoke_name("lesser_equal", (self, NDArray(jnp.asarray(_raw(o)))), {})
+
+    def __hash__(self):
+        return id(self)
+
+    # -- method versions of common ops --------------------------------------
+    def reshape(self, *shape, **kw):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _invoke_name("reshape", (self,), {"shape": shape, **kw})
+
+    def reshape_like(self, other):
+        return _invoke_name("reshape_like", (self, other), {})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _invoke_name("transpose", (self,), {"axes": axes or None})
+
+    def flatten(self): return _invoke_name("flatten", (self,), {})
+    def expand_dims(self, axis): return _invoke_name("expand_dims", (self,), {"axis": axis})
+    def squeeze(self, axis=None): return _invoke_name("squeeze", (self,), {"axis": axis})
+    def sum(self, axis=None, keepdims=False): return _invoke_name("sum", (self,), {"axis": axis, "keepdims": keepdims})
+    def mean(self, axis=None, keepdims=False): return _invoke_name("mean", (self,), {"axis": axis, "keepdims": keepdims})
+    def max(self, axis=None, keepdims=False): return _invoke_name("max", (self,), {"axis": axis, "keepdims": keepdims})
+    def min(self, axis=None, keepdims=False): return _invoke_name("min", (self,), {"axis": axis, "keepdims": keepdims})
+    def prod(self, axis=None, keepdims=False): return _invoke_name("prod", (self,), {"axis": axis, "keepdims": keepdims})
+    def argmax(self, axis=None): return _invoke_name("argmax", (self,), {"axis": axis})
+    def argmin(self, axis=None): return _invoke_name("argmin", (self,), {"axis": axis})
+    def norm(self, ord=2, axis=None, keepdims=False): return _invoke_name("norm", (self,), {"ord": ord, "axis": axis, "keepdims": keepdims})
+    def dot(self, other, **kw): return _invoke_name("dot", (self, other), kw)
+    def clip(self, a_min, a_max): return _invoke_name("clip", (self,), {"a_min": a_min, "a_max": a_max})
+    def abs(self): return _invoke_name("abs", (self,), {})
+    def sqrt(self): return _invoke_name("sqrt", (self,), {})
+    def square(self): return _invoke_name("square", (self,), {})
+    def exp(self): return _invoke_name("exp", (self,), {})
+    def log(self): return _invoke_name("log", (self,), {})
+    def tanh(self): return _invoke_name("tanh", (self,), {})
+    def sigmoid(self): return _invoke_name("sigmoid", (self,), {})
+    def relu(self): return _invoke_name("relu", (self,), {})
+    def softmax(self, axis=-1): return _invoke_name("softmax", (self,), {"axis": axis})
+    def log_softmax(self, axis=-1): return _invoke_name("log_softmax", (self,), {"axis": axis})
+    def slice_axis(self, axis, begin, end): return _invoke_name("slice_axis", (self,), {"axis": axis, "begin": begin, "end": end})
+    def take(self, indices, axis=0, mode="clip"): return _invoke_name("take", (self, indices), {"axis": axis, "mode": mode})
+    def one_hot(self, depth, **kw): return _invoke_name("one_hot", (self,), {"depth": depth, **kw})
+    def tile(self, reps): return _invoke_name("tile", (self,), {"reps": reps})
+    def repeat(self, repeats, axis=None): return _invoke_name("repeat", (self,), {"repeats": repeats, "axis": axis})
+    def broadcast_to(self, shape): return _invoke_name("broadcast_to", (self,), {"shape": shape})
+    def broadcast_like(self, other): return _invoke_name("broadcast_like", (self, other), {})
+    def swapaxes(self, dim1, dim2): return _invoke_name("swapaxes", (self,), {"dim1": dim1, "dim2": dim2})
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return _invoke_name("split", (self,), {"num_outputs": num_outputs, "axis": axis, "squeeze_axis": squeeze_axis})
+    def zeros_like(self): return _invoke_name("zeros_like", (self,), {})
+    def ones_like(self): return _invoke_name("ones_like", (self,), {})
+    def sign(self): return _invoke_name("sign", (self,), {})
+    def round(self): return _invoke_name("round", (self,), {})
+    def topk(self, **kw): return _invoke_name("topk", (self,), kw)
+    def sort(self, **kw): return _invoke_name("sort", (self,), kw)
+    def argsort(self, **kw): return _invoke_name("argsort", (self,), kw)
+
+
+def _is_arr(o):
+    return isinstance(o, (NDArray, jax.Array, _np.ndarray))
+
+
+def _raw_index(key):
+    if isinstance(key, NDArray):
+        return key._data if not jnp.issubdtype(key._data.dtype, jnp.floating) else key._data.astype(jnp.int32)
+    if isinstance(key, tuple):
+        return tuple(_raw_index(k) for k in key)
+    return key
+
+
+# --------------------------------------------------------------------------
+# op invocation (the analog of MXImperativeInvokeEx)
+# --------------------------------------------------------------------------
+def invoke(opdef, args, kwargs):
+    arr_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+    raw_args = [_raw(a) for a in args]
+    kwargs = dict(kwargs)
+    if opdef.stochastic and kwargs.get("key") is None:
+        kwargs["key"] = _rng.next_key()
+
+    if _ag.is_recording() and arr_pos:
+        consts = list(raw_args)
+
+        def pure(*arrs, _consts=consts, _pos=arr_pos, _kw=kwargs):
+            full = list(_consts)
+            for p, r in zip(_pos, arrs):
+                full[p] = r
+            return opdef.fn(*full, **_kw)
+
+        node = _ag.TapeNode(pure, {}, [args[i] for i in arr_pos], opdef.nout, opdef.name)
+        out = pure(*[raw_args[i] for i in arr_pos])
+        if isinstance(out, tuple):
+            wrapped = []
+            for i, o in enumerate(out):
+                w = _wrap(o)
+                w._tape = (node, i)
+                wrapped.append(w)
+            return tuple(wrapped)
+        w = _wrap(out)
+        w._tape = (node, 0)
+        return w
+
+    out = opdef.fn(*raw_args, **kwargs)
+    if isinstance(out, tuple):
+        return tuple(_wrap(o) for o in out)
+    return _wrap(out)
+
+
+def _invoke_name(name, args, kwargs):
+    return invoke(_registry.get(name), args, kwargs)
+
+
+def _make_op_func(name):
+    opdef = _registry.get(name)
+
+    def fn(*args, **kwargs):
+        ctx = kwargs.pop("ctx", None)
+        out = kwargs.pop("out", None)
+        res = invoke(opdef, args, kwargs)
+        if out is not None:
+            out._data = res._data
+            return out
+        return res
+
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = opdef.doc
+    return fn
+
+
+# populate mx.nd.* from the registry (import-time codegen, like the reference)
+_g = globals()
+for _name in _registry.list_ops():
+    if _name not in _g:
+        _g[_name] = _make_op_func(_name)
+
+
+def __getattr__(name):  # late-registered ops (e.g. contrib modules)
+    try:
+        return _make_op_func(name)
+    except AttributeError:
+        raise AttributeError(f"module 'mx.nd' has no attribute {name!r}") from None
+
+
+# --------------------------------------------------------------------------
+# creation functions
+# --------------------------------------------------------------------------
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        source_array = source_array._data
+    a = jnp.asarray(source_array, dtype_np(dtype) if dtype is not None else None)
+    if a.dtype == jnp.float64:
+        a = a.astype(jnp.float32)  # MXNet default_dtype is f32
+    return NDArray(a, ctx=ctx)
+
+
+def zeros(shape, ctx=None, dtype="float32"):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.zeros(shape, dtype_np(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype="float32"):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.ones(shape, dtype_np(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype="float32"):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return NDArray(jnp.full(shape, val, dtype_np(dtype)), ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype_np(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(out, ctx=ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype="float32"):
+    return NDArray(jnp.linspace(start, stop, int(num), endpoint=endpoint, dtype=dtype_np(dtype)), ctx=ctx)
+
+
+def zeros_like(a):
+    return _invoke_name("zeros_like", (a,), {})
+
+
+def ones_like(a):
+    return _invoke_name("ones_like", (a,), {})
+
+
+def waitall():
+    # XLA dataflow replaces the engine; effectively a host sync point.
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def save(fname, data):
+    from ..serialization import save_ndarrays
+
+    save_ndarrays(fname, data)
+
+
+def load(fname):
+    from ..serialization import load_ndarrays
+
+    return load_ndarrays(fname)
+
+
+def from_dlpack(cap):
+    return NDArray(jnp.from_dlpack(cap))
+
+
+def to_dlpack_for_read(arr):
+    return arr._data.__dlpack__()
+
+
+to_dlpack_for_write = to_dlpack_for_read
+
+
+# --------------------------------------------------------------------------
+# mx.nd.random submodule
+# --------------------------------------------------------------------------
+random = types.ModuleType(__name__ + ".random")
+random.uniform = _make_op_func("_random_uniform")
+random.normal = _make_op_func("_random_normal")
+random.gamma = _make_op_func("_random_gamma")
+random.exponential = _make_op_func("_random_exponential")
+random.poisson = _make_op_func("_random_poisson")
+random.randint = _make_op_func("_random_randint")
+random.multinomial = _make_op_func("_sample_multinomial")
+random.shuffle = _make_op_func("shuffle")
+random.seed = _rng.seed
+sys.modules[random.__name__] = random
+
+# contrib namespace: ops registered with _contrib_ prefix surface as nd.contrib.x
+contrib = types.ModuleType(__name__ + ".contrib")
+
+
+def _contrib_getattr(name):
+    return _make_op_func("_contrib_" + name)
+
+
+contrib.__getattr__ = _contrib_getattr
+sys.modules[contrib.__name__] = contrib

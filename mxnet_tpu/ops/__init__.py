@@ -1,0 +1,12 @@
+"""Operator library: pure jax functions registered under MXNet op names.
+
+Replaces ``src/operator/`` (~150k LoC of C++/CUDA kernels in the reference)
+with jnp/lax compositions that XLA fuses and tiles onto the MXU — plus Pallas
+kernels for the attention hot path (``mxnet_tpu.ops.attention``). Import
+order: every submodule populates :mod:`mxnet_tpu.registry` at import time.
+"""
+from . import core  # noqa: F401
+from . import nn  # noqa: F401
+from . import attention  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import random_ops  # noqa: F401

@@ -1,0 +1,117 @@
+"""Attention operators.
+
+Re-designs the reference's fused transformer kernels
+(``src/operator/contrib/transformer.cc``/``.cu`` —
+``_contrib_interleaved_matmul_selfatt_qk`` / ``_valatt`` /
+``_contrib_interleaved_matmul_encdec_*`` / ``_contrib_div_sqrt_dim``, the ops
+GluonNLP BERT calls) for TPU:
+
+  - the interleaved-matmul API is preserved exactly (projections stored
+    interleaved as (T, B, H*3*Ch)) so GluonNLP-shaped model code runs;
+  - the *blessed* path is ``multi_head_attention`` which dispatches to a
+    Pallas flash-attention kernel on TPU (O(L) memory, MXU-tiled) and a
+    jnp reference path elsewhere — see ``mxnet_tpu.ops.flash_attention``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register
+
+
+@register("_contrib_div_sqrt_dim")
+def div_sqrt_dim(data):
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], jnp.float32)).astype(data.dtype)
+
+
+def _split_interleaved_qkv(qkv, heads):
+    """(T, B, H*3*Ch) interleaved per head -> q, k, v each (B, H, T, Ch)."""
+    t, b, hc3 = qkv.shape
+    ch = hc3 // (heads * 3)
+    x = qkv.reshape(t, b, heads, 3, ch)
+    q, k, v = x[:, :, :, 0], x[:, :, :, 1], x[:, :, :, 2]
+    # (T,B,H,Ch) -> (B,H,T,Ch)
+    to_bhtc = lambda a: a.transpose(1, 2, 0, 3)
+    return to_bhtc(q), to_bhtc(k), to_bhtc(v)
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk")
+def interleaved_matmul_selfatt_qk(qkv, heads=1):
+    """scores = scaled Q @ K^T, output (B*H, T, T) like the reference."""
+    q, k, v = _split_interleaved_qkv(qkv, int(heads))
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32)).astype(q.dtype)
+    scores = jnp.einsum("bhqc,bhkc->bhqk", q * scale, k)
+    b, h, t, _ = scores.shape
+    return scores.reshape(b * h, t, t)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt")
+def interleaved_matmul_selfatt_valatt(qkv, att, heads=1):
+    """out = att @ V, returned (T, B, H*Ch) like the reference."""
+    q, k, v = _split_interleaved_qkv(qkv, int(heads))
+    b, h, t, ch = v.shape
+    att = att.reshape(b, h, t, t)
+    out = jnp.einsum("bhqk,bhkc->bhqc", att, v)
+    return out.transpose(2, 0, 1, 3).reshape(t, b, h * ch)
+
+
+@register("_contrib_interleaved_matmul_encdec_qk")
+def interleaved_matmul_encdec_qk(q_proj, kv_proj, heads=1):
+    tq, b, hc = q_proj.shape
+    ch = hc // int(heads)
+    q = q_proj.reshape(tq, b, int(heads), ch).transpose(1, 2, 0, 3)
+    tk = kv_proj.shape[0]
+    kv = kv_proj.reshape(tk, b, int(heads), 2, ch)
+    k = kv[:, :, :, 0].transpose(1, 2, 0, 3)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(ch, jnp.float32)).astype(q.dtype)
+    scores = jnp.einsum("bhqc,bhkc->bhqk", q * scale, k)
+    return scores.reshape(b * int(heads), tq, tk)
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt")
+def interleaved_matmul_encdec_valatt(kv_proj, att, heads=1):
+    tk, b, hc2 = kv_proj.shape
+    ch = hc2 // (2 * int(heads))
+    kv = kv_proj.reshape(tk, b, int(heads), 2, ch)
+    v = kv[:, :, :, 1].transpose(1, 2, 0, 3)  # (B,H,Tk,Ch)
+    h = int(heads)
+    tq = att.shape[1]
+    att = att.reshape(b, h, tq, tk)
+    out = jnp.einsum("bhqk,bhkc->bhqc", att, v)
+    return out.transpose(2, 0, 1, 3).reshape(tq, b, h * ch)
+
+
+# --------------------------------------------------------------------------
+# blessed fused attention entry point
+# --------------------------------------------------------------------------
+def _reference_mha(q, k, v, mask=None, causal=False):
+    """jnp O(L^2) reference attention; q,k,v (B,H,T,Ch)."""
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    scores = jnp.einsum("bhqc,bhkc->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        t_q, t_k = scores.shape[-2], scores.shape[-1]
+        cm = jnp.tril(jnp.ones((t_q, t_k), bool), t_k - t_q)
+        scores = jnp.where(cm, scores, -jnp.inf)
+    if mask is not None:
+        scores = jnp.where(mask.astype(bool), scores, -jnp.inf)
+    att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkc->bhqc", att, v)
+
+
+@register("multi_head_attention", aliases=("_contrib_multi_head_attention",))
+def multi_head_attention(q, k, v, mask=None, causal=False, use_flash="auto"):
+    """Fused scaled-dot-product attention over (B, H, T, Ch) tensors.
+
+    ``use_flash='auto'`` picks the Pallas flash kernel on TPU backends when
+    shapes are tile-friendly, otherwise the XLA einsum path.
+    """
+    from . import flash_attention as fa
+
+    if use_flash == "auto":
+        use_flash = fa.flash_supported(q, k, v, mask)
+    if use_flash:
+        return fa.flash_attention(q, k, v, mask=mask, causal=causal)
+    return _reference_mha(q, k, v, mask=mask, causal=causal)

@@ -1,0 +1,452 @@
+"""Tensor / elementwise / reduce / indexing operators.
+
+Covers the reference's ``src/operator/tensor/`` family (elemwise_binary_op,
+broadcast_reduce_op, matrix_op, dot, indexing_op — mshadow expression
+templates + ``Kernel<op,xpu>::Launch`` CUDA loops) as jnp compositions. XLA
+does the fusion the reference needed hand-rolled NVRTC fusion for.
+
+MXNet quirks preserved on purpose:
+  - reduces accept ``axis=None`` meaning "all axes" and ``keepdims``;
+  - ``dot``/``batch_dot`` have ``transpose_a/transpose_b`` flags;
+  - broadcast_* names exist alongside operator overloads.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register, alias
+
+
+def _axis_tuple(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (tuple, list)):
+        return tuple(int(a) for a in axis)
+    return (int(axis),)
+
+
+# --------------------------------------------------------------------------
+# binary broadcast ops (reference: elemwise_binary_op_basic.cc,
+# elemwise_binary_broadcast_op_*.cc — unified here since jnp broadcasts)
+# --------------------------------------------------------------------------
+def _binary(name, fn, aliases=()):
+    register(name, aliases=aliases)(fn)
+
+
+_binary("add", lambda a, b: jnp.add(a, b), aliases=("elemwise_add", "broadcast_add", "broadcast_plus", "_plus", "_add"))
+_binary("subtract", lambda a, b: jnp.subtract(a, b), aliases=("elemwise_sub", "broadcast_sub", "broadcast_minus", "_sub", "_minus"))
+_binary("multiply", lambda a, b: jnp.multiply(a, b), aliases=("elemwise_mul", "broadcast_mul", "_mul"))
+_binary("divide", lambda a, b: jnp.divide(a, b), aliases=("elemwise_div", "broadcast_div", "_div"))
+_binary("mod", lambda a, b: jnp.mod(a, b), aliases=("broadcast_mod",))
+_binary("power", lambda a, b: jnp.power(a, b), aliases=("broadcast_power", "_power", "pow"))
+_binary("maximum", lambda a, b: jnp.maximum(a, b), aliases=("broadcast_maximum", "_maximum"))
+_binary("minimum", lambda a, b: jnp.minimum(a, b), aliases=("broadcast_minimum", "_minimum"))
+_binary("hypot", lambda a, b: jnp.hypot(a, b), aliases=("broadcast_hypot",))
+_binary("equal", lambda a, b: (a == b).astype(jnp.result_type(a)), aliases=("broadcast_equal",))
+_binary("not_equal", lambda a, b: (a != b).astype(jnp.result_type(a)), aliases=("broadcast_not_equal",))
+_binary("greater", lambda a, b: (a > b).astype(jnp.result_type(a)), aliases=("broadcast_greater",))
+_binary("greater_equal", lambda a, b: (a >= b).astype(jnp.result_type(a)), aliases=("broadcast_greater_equal",))
+_binary("lesser", lambda a, b: (a < b).astype(jnp.result_type(a)), aliases=("broadcast_lesser",))
+_binary("lesser_equal", lambda a, b: (a <= b).astype(jnp.result_type(a)), aliases=("broadcast_lesser_equal",))
+_binary("logical_and", lambda a, b: jnp.logical_and(a, b).astype(jnp.result_type(a)), aliases=("broadcast_logical_and",))
+_binary("logical_or", lambda a, b: jnp.logical_or(a, b).astype(jnp.result_type(a)), aliases=("broadcast_logical_or",))
+_binary("logical_xor", lambda a, b: jnp.logical_xor(a, b).astype(jnp.result_type(a)), aliases=("broadcast_logical_xor",))
+
+
+# --------------------------------------------------------------------------
+# unary ops (reference: elemwise_unary_op_basic.cc etc.)
+# --------------------------------------------------------------------------
+for _name, _fn, _al in [
+    ("abs", jnp.abs, ()),
+    ("sign", jnp.sign, ()),
+    ("rint", jnp.rint, ()),
+    ("ceil", jnp.ceil, ()),
+    ("floor", jnp.floor, ()),
+    ("trunc", jnp.trunc, ()),
+    ("round", jnp.round, ()),
+    ("fix", jnp.trunc, ()),
+    ("square", jnp.square, ()),
+    ("sqrt", jnp.sqrt, ()),
+    ("rsqrt", lax.rsqrt, ()),
+    ("cbrt", jnp.cbrt, ()),
+    ("rcbrt", lambda x: 1.0 / jnp.cbrt(x), ()),
+    ("exp", jnp.exp, ()),
+    ("expm1", jnp.expm1, ()),
+    ("log", jnp.log, ()),
+    ("log10", jnp.log10, ()),
+    ("log2", jnp.log2, ()),
+    ("log1p", jnp.log1p, ()),
+    ("sin", jnp.sin, ()),
+    ("cos", jnp.cos, ()),
+    ("tan", jnp.tan, ()),
+    ("arcsin", jnp.arcsin, ()),
+    ("arccos", jnp.arccos, ()),
+    ("arctan", jnp.arctan, ()),
+    ("sinh", jnp.sinh, ()),
+    ("cosh", jnp.cosh, ()),
+    ("tanh", jnp.tanh, ()),
+    ("arcsinh", jnp.arcsinh, ()),
+    ("arccosh", jnp.arccosh, ()),
+    ("arctanh", jnp.arctanh, ()),
+    ("erf", jax.scipy.special.erf, ()),
+    ("erfinv", jax.scipy.special.erfinv, ()),
+    ("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)), ()),
+    ("gammaln", jax.scipy.special.gammaln, ()),
+    ("logical_not", lambda x: jnp.logical_not(x).astype(jnp.result_type(x)), ()),
+    ("negative", jnp.negative, ("_np_negative",)),
+    ("reciprocal", jnp.reciprocal, ()),
+    ("relu", lambda x: jnp.maximum(x, 0), ()),
+    ("sigmoid", jax.nn.sigmoid, ()),
+    ("softsign", jax.nn.soft_sign, ()),
+    ("identity", lambda x: x, ("_copy", "stop_gradient_identity")),
+]:
+    register(_name, aliases=_al)(_fn)
+
+register("BlockGrad", aliases=("stop_gradient",))(lax.stop_gradient)
+
+
+@register("clip")
+def clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+# --------------------------------------------------------------------------
+# scalar ops (reference generates _plus_scalar etc. from the same kernels)
+# --------------------------------------------------------------------------
+register("_plus_scalar")(lambda x, scalar=0.0: x + scalar)
+register("_minus_scalar")(lambda x, scalar=0.0: x - scalar)
+register("_rminus_scalar")(lambda x, scalar=0.0: scalar - x)
+register("_mul_scalar")(lambda x, scalar=1.0: x * scalar)
+register("_div_scalar")(lambda x, scalar=1.0: x / scalar)
+register("_rdiv_scalar")(lambda x, scalar=1.0: scalar / x)
+register("_power_scalar")(lambda x, scalar=1.0: jnp.power(x, scalar))
+register("_rpower_scalar")(lambda x, scalar=1.0: jnp.power(scalar, x))
+register("_mod_scalar")(lambda x, scalar=1.0: jnp.mod(x, scalar))
+register("_maximum_scalar")(lambda x, scalar=0.0: jnp.maximum(x, scalar))
+register("_minimum_scalar")(lambda x, scalar=0.0: jnp.minimum(x, scalar))
+
+
+# --------------------------------------------------------------------------
+# reductions (reference: broadcast_reduce_op_value.cc; MXNET_SAFE_ACCUMULATION
+# maps to accumulating reduces in f32 for low-precision inputs)
+# --------------------------------------------------------------------------
+def _reduce(fn, x, axis, keepdims, safe_acc=True):
+    ax = _axis_tuple(axis)
+    dtype = None
+    if safe_acc and x.dtype in (jnp.float16, jnp.bfloat16):
+        dtype = jnp.float32
+        out = fn(x.astype(dtype), axis=ax, keepdims=bool(keepdims))
+        return out.astype(x.dtype)
+    return fn(x, axis=ax, keepdims=bool(keepdims))
+
+
+register("sum", aliases=("sum_axis",))(lambda x, axis=None, keepdims=False: _reduce(jnp.sum, x, axis, keepdims))
+register("mean")(lambda x, axis=None, keepdims=False: _reduce(jnp.mean, x, axis, keepdims))
+register("prod")(lambda x, axis=None, keepdims=False: _reduce(jnp.prod, x, axis, keepdims))
+register("max", aliases=("max_axis",))(lambda x, axis=None, keepdims=False: jnp.max(x, _axis_tuple(axis), keepdims=bool(keepdims)))
+register("min", aliases=("min_axis",))(lambda x, axis=None, keepdims=False: jnp.min(x, _axis_tuple(axis), keepdims=bool(keepdims)))
+register("nansum")(lambda x, axis=None, keepdims=False: jnp.nansum(x, _axis_tuple(axis), keepdims=bool(keepdims)))
+register("nanprod")(lambda x, axis=None, keepdims=False: jnp.nanprod(x, _axis_tuple(axis), keepdims=bool(keepdims)))
+
+
+@register("norm")
+def norm(x, ord=2, axis=None, keepdims=False):
+    ax = _axis_tuple(axis)
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.float16, jnp.bfloat16) else x
+    if ord == 1:
+        out = jnp.sum(jnp.abs(xf), axis=ax, keepdims=bool(keepdims))
+    else:
+        out = jnp.sqrt(jnp.sum(jnp.square(xf), axis=ax, keepdims=bool(keepdims)))
+    return out.astype(x.dtype)
+
+
+register("argmax")(lambda x, axis=None, keepdims=False: jnp.argmax(x, axis=None if axis is None else int(axis), keepdims=bool(keepdims)).astype(jnp.float32))
+register("argmin")(lambda x, axis=None, keepdims=False: jnp.argmin(x, axis=None if axis is None else int(axis), keepdims=bool(keepdims)).astype(jnp.float32))
+
+
+@register("topk")
+def topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    ax = int(axis) % x.ndim
+    xm = jnp.moveaxis(x, ax, -1)
+    vals, idx = lax.top_k(-xm if is_ascend else xm, int(k))
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax)
+    if ret_typ == "indices":
+        return idx.astype(dtype)
+    if ret_typ == "value":
+        return vals
+    return idx.astype(dtype), vals
+
+
+@register("sort")
+def sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=None if axis is None else int(axis))
+    if not is_ascend:
+        out = jnp.flip(out, axis=-1 if axis is None else int(axis))
+    return out
+
+
+@register("argsort")
+def argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    ax = None if axis is None else int(axis)
+    idx = jnp.argsort(x, axis=ax)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=ax)
+    return idx.astype(dtype)
+
+
+# --------------------------------------------------------------------------
+# matmul family (reference: dot.cc/batch_dot → cuBLAS; here → MXU dot_general)
+# --------------------------------------------------------------------------
+@register("dot")
+def dot(a, b, transpose_a=False, transpose_b=False):
+    """MXNet dot: contracts last axis of a with first axis of b (after transposes)."""
+    if transpose_a:
+        a = jnp.moveaxis(a, 0, -1) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+
+
+@register("batch_dot")
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+register("linalg_gemm2")(lambda a, b, transpose_a=False, transpose_b=False, alpha=1.0: alpha * batch_dot(a, b, transpose_a, transpose_b))
+
+
+# --------------------------------------------------------------------------
+# shape manipulation (reference: matrix_op.cc)
+# --------------------------------------------------------------------------
+@register("reshape", aliases=("Reshape",))
+def reshape(x, shape=None, reverse=False):
+    shape = tuple(int(s) for s in shape)
+    if reverse:
+        # MXNet reverse=True resolves special values right-to-left; support the
+        # common -1 case by flipping, resolving, flipping back.
+        raise NotImplementedError("reshape(reverse=True) is not supported; use explicit shapes")
+    # MXNet special codes: 0 copy input dim, -1 infer, -2 copy rest, -3 merge two,
+    # -4 split (consumes following two entries). Implement 0/-1/-2/-3.
+    out, i = [], 0
+    in_shape = x.shape
+    si = 0
+    while i < len(shape):
+        s = shape[i]
+        if s == 0:
+            out.append(in_shape[si]); si += 1
+        elif s == -1:
+            out.append(-1); si += 1
+        elif s == -2:
+            out.extend(in_shape[si:]); si = len(in_shape)
+        elif s == -3:
+            out.append(in_shape[si] * in_shape[si + 1]); si += 2
+        else:
+            out.append(s); si += 1
+        i += 1
+    return jnp.reshape(x, tuple(out))
+
+
+register("reshape_like")(lambda x, y: jnp.reshape(x, y.shape))
+register("flatten", aliases=("Flatten",))(lambda x: jnp.reshape(x, (x.shape[0], -1)))
+register("transpose")(lambda x, axes=None: jnp.transpose(x, None if not axes else tuple(axes)))
+register("swapaxes", aliases=("SwapAxis",))(lambda x, dim1=0, dim2=0: jnp.swapaxes(x, dim1, dim2))
+register("expand_dims")(lambda x, axis: jnp.expand_dims(x, int(axis)))
+register("squeeze")(lambda x, axis=None: jnp.squeeze(x, _axis_tuple(axis)))
+register("broadcast_to")(lambda x, shape: jnp.broadcast_to(x, tuple(int(s) if s != 0 else xs for s, xs in zip(shape, x.shape))))
+register("broadcast_like")(lambda x, y: jnp.broadcast_to(x, y.shape))
+register("repeat")(lambda x, repeats, axis=None: jnp.repeat(x, repeats, axis=None if axis is None else int(axis)))
+register("tile")(lambda x, reps: jnp.tile(x, tuple(reps)))
+register("reverse", aliases=("flip",))(lambda x, axis: jnp.flip(x, _axis_tuple(axis)))
+register("depth_to_space")(lambda x, block_size: _depth_to_space(x, block_size))
+register("space_to_depth")(lambda x, block_size: _space_to_depth(x, block_size))
+
+
+def _depth_to_space(x, b):
+    n, c, h, w = x.shape
+    x = x.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+def _space_to_depth(x, b):
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 5, 3, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("concat", aliases=("Concat",))
+def concat(*xs, dim=1):
+    return jnp.concatenate(xs, axis=int(dim))
+
+
+@register("stack")
+def stack(*xs, axis=0):
+    return jnp.stack(xs, axis=int(axis))
+
+
+@register("split", aliases=("SliceChannel",), nout=-1)
+def split(x, num_outputs, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, int(num_outputs), axis=int(axis))
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=int(axis)) for p in parts]
+    return tuple(parts)
+
+
+@register("slice")
+def slice_op(x, begin, end, step=None):
+    nd = x.ndim
+    begin = list(begin) + [None] * (nd - len(begin))
+    end = list(end) + [None] * (nd - len(end))
+    step = list(step or []) + [None] * (nd - len(step or []))
+    idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return x[idx]
+
+
+@register("slice_axis")
+def slice_axis(x, axis, begin, end):
+    axis = int(axis) % x.ndim
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(x, y, axes=()):
+    axes = _axis_tuple(axes) or tuple(range(min(x.ndim, y.ndim)))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a % x.ndim] = slice(0, y.shape[a % x.ndim])
+    return x[tuple(idx)]
+
+
+@register("pad", aliases=("Pad",))
+def pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pw, mode=jmode, constant_values=constant_value)
+    return jnp.pad(x, pw, mode=jmode)
+
+
+# --------------------------------------------------------------------------
+# indexing (reference: indexing_op.cc — take/gather_nd/scatter_nd/one_hot)
+# --------------------------------------------------------------------------
+@register("take")
+def take(a, indices, axis=0, mode="clip"):
+    return jnp.take(a, indices.astype(jnp.int32), axis=int(axis), mode=mode)
+
+
+@register("Embedding", aliases=("embedding",))
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None, sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("one_hot")
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), int(depth), dtype=dtype)
+    return oh * (on_value - off_value) + off_value
+
+
+@register("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    ax = int(axis) % data.ndim
+    idx = jnp.expand_dims(index.astype(jnp.int32), ax)
+    idx = jnp.clip(idx, 0, data.shape[ax] - 1)
+    out = jnp.take_along_axis(data, idx, ax)
+    return out if keepdims else jnp.squeeze(out, ax)
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape):
+    out = jnp.zeros(tuple(int(s) for s in shape), dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].set(data)
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("boolean_mask")
+def boolean_mask(data, index, axis=0):
+    # dynamic-shape op: only valid eagerly (outside jit), like reference contrib op
+    import numpy as np
+
+    mask = np.asarray(index).astype(bool)
+    return jnp.compress(mask, data, axis=int(axis))
+
+
+@register("SequenceMask", aliases=("sequence_mask",))
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    axis = int(axis)
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    mask = steps[:, None] < sequence_length[None, :].astype(jnp.int32)  # (T, B)
+    if axis == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+# --------------------------------------------------------------------------
+# dtype / casting / creation
+# --------------------------------------------------------------------------
+from ..base import dtype_np  # noqa: E402
+
+
+@register("cast", aliases=("Cast", "astype"))
+def cast(x, dtype="float32"):
+    return x.astype(dtype_np(dtype))
+
+
+@register("zeros_like")
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register("ones_like")
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register("_full", aliases=("full",))
+def full(shape=(), value=0.0, dtype="float32"):
+    return jnp.full(tuple(shape), value, dtype_np(dtype))
+
+
+@register("_arange", aliases=("arange",))
+def arange(start=0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype_np(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+register("_eye", aliases=("eye",))(lambda N, M=0, k=0, dtype="float32": jnp.eye(int(N), int(M) or None, int(k), dtype_np(dtype)))
+register("diag")(lambda x, k=0: jnp.diag(x, int(k)) if x.ndim <= 1 else jnp.diagonal(x, int(k), -2, -1))
+register("tril")(lambda x, k=0: jnp.tril(x, int(k)))
+register("cumsum")(lambda x, axis=None, dtype=None: jnp.cumsum(x, axis=None if axis is None else int(axis), dtype=dtype and dtype_np(dtype)))
+register("isnan")(lambda x: jnp.isnan(x).astype(jnp.float32))
+register("isinf")(lambda x: jnp.isinf(x).astype(jnp.float32))
+register("isfinite")(lambda x: jnp.isfinite(x).astype(jnp.float32))

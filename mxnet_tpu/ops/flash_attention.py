@@ -1,0 +1,182 @@
+"""Pallas flash attention for TPU.
+
+The marquee custom kernel (SURVEY §5.7): replaces the reference's O(L^2)
+fused attention (``src/operator/contrib/transformer.cu``) with an online-
+softmax blocked kernel — O(L) memory, MXU-tiled q/k blocks, f32 accumulation.
+
+Forward is a Pallas kernel (grid = (batch*heads, q_blocks, k_blocks), with
+m/l/acc scratch carried across the sequential innermost k dimension).
+Backward recomputes attention through the XLA einsum path via ``custom_vjp``
+— correct and fusion-friendly at BERT/GPT block sizes; a dedicated backward
+kernel is a later optimisation.
+
+On non-TPU backends the kernel runs in interpret mode (tests) or callers fall
+back to the einsum path via ``flash_supported``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+_LANES = 128
+
+
+def _on_tpu() -> bool:
+    try:
+        dev = jax.devices()[0]
+        return dev.platform in ("tpu", "axon") or "TPU" in getattr(dev, "device_kind", "")
+    except Exception:
+        return False
+
+
+def flash_supported(q, k, v, mask=None) -> bool:
+    """Kernel eligibility: TPU backend, no arbitrary mask, tile-able lengths."""
+    if mask is not None or not _HAS_PLTPU or not _on_tpu():
+        return False
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    return tq % 128 == 0 and tk % 128 == 0 and d % 128 == 0 and q.dtype in (
+        jnp.float32,
+        jnp.bfloat16,
+    )
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal, bq, bk, scale):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
+        k = k_ref[0].astype(jnp.float32)  # (bk, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+        m_prev = m_ref[:, :1]  # (bq, 1), replicated over lanes
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # guard fully-masked rows (m_new == -inf) against nan exp
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
+        l_new = corr * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * corr + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    if causal:
+        # skip fully-masked k blocks above the diagonal
+        @pl.when(qi * bq + bq > ki * bk)
+        def _():
+            _body()
+    else:
+        _body()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    bq, bk = min(block_q, tq), min(block_k, tk)
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+    scale = 1.0 / (d ** 0.5)
+    grid = (b * h, tq // bq, tk // bk)
+    kernel = functools.partial(_fwd_kernel, causal=causal, bq=bq, bk=bk, scale=scale)
+    scratch = [
+        pltpu.VMEM((bq, _LANES), jnp.float32),
+        pltpu.VMEM((bq, _LANES), jnp.float32),
+        pltpu.VMEM((bq, d), jnp.float32),
+    ] if _HAS_PLTPU else [
+        pl.MemorySpace.ANY  # pragma: no cover
+    ]
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        scratch_shapes=scratch,
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ) if _HAS_PLTPU and not interpret else None,
+    )(qr, kr, vr)
+    return out.reshape(b, h, tq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, causal):
+    return _flash_fwd(q, k, v, causal)
+
+
+def _ref_attention(q, k, v, causal):
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    s = jnp.einsum("bhqc,bhkc->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        s = jnp.where(cm, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkc->bhqc", p, v)
+
+
+def _flash_vjp_fwd(q, k, v, causal):
+    return _flash_fwd(q, k, v, causal), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _ref_attention(q, k, v, causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, mask=None, causal=False, interpret=None):
+    """Blocked flash attention over (B, H, T, Ch). ``mask`` unsupported here —
+    callers gate via :func:`flash_supported`."""
+    if mask is not None:
+        raise ValueError("flash_attention kernel does not take arbitrary masks; "
+                         "use multi_head_attention which falls back to the einsum path")
+    if interpret is None:
+        interpret = not _on_tpu()
+    if interpret:
+        return _flash_fwd(q, k, v, causal, interpret=True)
+    return _flash(q, k, v, bool(causal))

@@ -1,0 +1,428 @@
+"""Neural-network operators.
+
+Covers the reference's ``src/operator/nn/`` family — FullyConnected,
+Convolution (cuDNN autotuned in the reference), BatchNorm, LayerNorm,
+Pooling, Activation, softmax, Dropout, RNN — as lax/jnp compositions that XLA
+maps onto the MXU. Layout: the reference is NCHW (cuDNN's native layout); TPU
+convs prefer NHWC, so convs transpose at the boundary and keep the public
+NCHW contract — XLA folds the transposes into the conv's dimension_numbers.
+
+RNN replaces the cuDNN fused descriptor machinery (``src/operator/rnn.cc``,
+``cudnn_rnn-inl.h``) with a ``lax.scan`` over fused-gate cells — the
+compiler-friendly TPU formulation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register
+from .. import random as _random
+
+
+# --------------------------------------------------------------------------
+# FullyConnected (reference: fully_connected.cc → cuBLAS gemm)
+# --------------------------------------------------------------------------
+@register("FullyConnected", aliases=("fully_connected",))
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True):
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = jnp.matmul(data, weight.T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+# --------------------------------------------------------------------------
+# Convolution / Deconvolution (reference: convolution.cc + cudnn autotune)
+# --------------------------------------------------------------------------
+def _pair(v, n=2):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+@register("Convolution", aliases=("convolution",))
+def convolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1, 1),
+                pad=(0, 0), num_filter=None, num_group=1, no_bias=False, layout="NCHW"):
+    """2D (or 1D) convolution, NCHW public layout, MXU-friendly inside."""
+    conv_1d = data.ndim == 3
+    if conv_1d:  # NCW -> NCHW with H=1
+        data = data[:, :, None, :]
+        weight = weight[:, :, None, :]
+        stride, dilate, pad = (1, _pair(stride, 1)[0]), (1, _pair(dilate, 1)[0]), (0, _pair(pad, 1)[0])
+    stride, dilate, pad = _pair(stride), _pair(dilate), _pair(pad)
+    out = lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=dilate,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+    )
+    out = out.astype(data.dtype)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    if conv_1d:
+        out = out[:, :, 0, :]
+    return out
+
+
+@register("Deconvolution", aliases=("deconvolution",))
+def deconvolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1, 1),
+                  pad=(0, 0), adj=(0, 0), num_filter=None, num_group=1, no_bias=False):
+    stride, pad = _pair(stride), _pair(pad)
+    kh, kw = weight.shape[-2], weight.shape[-1]
+    # transposed conv = lhs-dilated conv with flipped kernel (IOHW)
+    out = lax.conv_general_dilated(
+        data, jnp.flip(weight, (-1, -2)).swapaxes(0, 1),
+        window_strides=(1, 1),
+        padding=[(kh - 1 - pad[0], kh - 1 - pad[0] + adj[0]), (kw - 1 - pad[1], kw - 1 - pad[1] + adj[1])],
+        lhs_dilation=stride,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=int(num_group),
+    )
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Pooling (reference: pooling.cc / cudnn_pooling)
+# --------------------------------------------------------------------------
+@register("Pooling", aliases=("pooling",))
+def pooling(data, kernel=(2, 2), pool_type="max", stride=None, pad=(0, 0),
+            global_pool=False, count_include_pad=True, pooling_convention="valid"):
+    if global_pool:
+        if pool_type == "max":
+            return jnp.max(data, axis=(-2, -1), keepdims=True)
+        return jnp.mean(data, axis=(-2, -1), keepdims=True)
+    kernel = _pair(kernel)
+    stride = _pair(stride) if stride is not None else kernel
+    pad = _pair(pad)
+    dims = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1]))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, dims, strides, padding)
+    s = lax.reduce_window(data, 0.0, lax.add, dims, strides, padding)
+    if count_include_pad or pad == (0, 0):
+        return s / (kernel[0] * kernel[1])
+    ones = jnp.ones(data.shape[-2:], data.dtype)[None, None]
+    cnt = lax.reduce_window(jnp.broadcast_to(ones, (1, 1) + data.shape[-2:]), 0.0, lax.add, dims, strides, padding)
+    return s / cnt
+
+
+@register("_contrib_AdaptiveAvgPooling2D")
+def adaptive_avg_pooling(data, output_size=1):
+    oh, ow = _pair(output_size)
+    n, c, h, w = data.shape
+    x = data.reshape(n, c, oh, h // oh, ow, w // ow)
+    return x.mean(axis=(3, 5))
+
+
+# --------------------------------------------------------------------------
+# Activation (reference: activation.cc + leaky_relu.cc)
+# --------------------------------------------------------------------------
+_ACTS = {
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "erf_gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "tanh_gelu": lambda x: jax.nn.gelu(x, approximate=True),
+    "silu": jax.nn.silu,
+}
+
+
+@register("Activation", aliases=("activation",))
+def activation(data, act_type="relu"):
+    return _ACTS[act_type](data)
+
+
+@register("LeakyReLU")
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125, upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2
+        return jnp.where(data >= 0, data, mid * data)
+    raise ValueError(f"unknown LeakyReLU act_type {act_type!r}")
+
+
+# --------------------------------------------------------------------------
+# softmax family (reference: softmax.cc, softmax_output; fused on TPU by XLA)
+# --------------------------------------------------------------------------
+@register("softmax")
+def softmax(data, axis=-1, temperature=None, length=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    if length is not None:
+        steps = jnp.arange(data.shape[axis])
+        mask = steps[None, :] < length[:, None].astype(jnp.int32)
+        shape = [1] * data.ndim
+        shape[0], shape[axis] = mask.shape[0], mask.shape[1]
+        data = jnp.where(mask.reshape(shape), data, -jnp.inf)
+    return jax.nn.softmax(data, axis=int(axis))
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    return jax.nn.log_softmax(data, axis=int(axis))
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    nll = -jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return jnp.sum(nll)
+
+
+@register("SoftmaxOutput", aliases=("softmax_output",))
+def softmax_output(data, label=None, grad_scale=1.0, ignore_label=-1, use_ignore=False,
+                   multi_output=False, preserve_shape=False, normalization="null",
+                   out_grad=False, smooth_alpha=0.0):
+    """Forward = softmax; the loss-gradient fusion of the reference op is
+    delegated to autograd (loss modules are the blessed path)."""
+    return jax.nn.softmax(data, axis=-1)
+
+
+# --------------------------------------------------------------------------
+# normalization (reference: batch_norm.cc, layer_norm.cc, l2_normalization)
+# --------------------------------------------------------------------------
+@register("BatchNorm", aliases=("batch_norm",), nout=3)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.9,
+               fix_gamma=False, use_global_stats=False, axis=1, training=False):
+    """Returns (out, batch_mean, batch_var); moving-stat update happens in the
+    Gluon layer (functional state threading, unlike the reference's in-kernel
+    mutation of aux states)."""
+    axis = int(axis) % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    xf = data.astype(jnp.float32)
+    if training and not use_global_stats:
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.var(xf, axis=red)
+    else:
+        mean, var = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
+    inv = lax.rsqrt(var + eps)
+    out = (xf - mean.reshape(shape)) * inv.reshape(shape)
+    out = out * gamma.astype(jnp.float32).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(data.dtype), mean, var
+
+
+@register("LayerNorm", aliases=("layer_norm",))
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    ax = int(axis)
+    xf = data.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=ax, keepdims=True)
+    var = jnp.var(xf, axis=ax, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    out = out * gamma.astype(jnp.float32).reshape(shape) + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(data.dtype)
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        red = (1,)
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+    n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    return data / n
+
+
+@register("RMSNorm", aliases=("_contrib_rms_norm",))
+def rms_norm(data, gamma, axis=-1, eps=1e-6):
+    xf = data.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+    out = xf * lax.rsqrt(ms + eps) * gamma.astype(jnp.float32)
+    return out.astype(data.dtype)
+
+
+# --------------------------------------------------------------------------
+# Dropout (reference: dropout-inl.h w/ cuDNN dropout descriptors)
+# --------------------------------------------------------------------------
+@register("Dropout", aliases=("dropout",), stochastic=True)
+def dropout(data, p=0.5, mode="training", axes=(), training=False, key=None):
+    if not training or p <= 0.0:
+        return data
+    if key is None:
+        key = _random.next_key()
+    shape = list(data.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape))
+    return jnp.where(mask, data / keep, jnp.zeros((), data.dtype)).astype(data.dtype)
+
+
+# --------------------------------------------------------------------------
+# RNN (reference: rnn.cc fused cuDNN op) → lax.scan formulation
+# --------------------------------------------------------------------------
+def _lstm_cell(carry, x_t, wx, wh, b):
+    h, c = carry
+    gates = x_t @ wx.T + h @ wh.T + b
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return (h, c), h
+
+
+def _gru_cell(carry, x_t, wx, wh, b):
+    (h,) = carry
+    xz = x_t @ wx.T + b
+    hz = h @ wh.T
+    xr, xu, xn = jnp.split(xz, 3, axis=-1)
+    hr, hu, hn = jnp.split(hz, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    u = jax.nn.sigmoid(xu + hu)
+    n = jnp.tanh(xn + r * hn)
+    h = (1 - u) * n + u * h
+    return (h,), h
+
+
+def _tanh_cell(carry, x_t, wx, wh, b):
+    (h,) = carry
+    h = jnp.tanh(x_t @ wx.T + h @ wh.T + b)
+    return (h,), h
+
+
+def _relu_cell(carry, x_t, wx, wh, b):
+    (h,) = carry
+    h = jnp.maximum(x_t @ wx.T + h @ wh.T + b, 0)
+    return (h,), h
+
+
+_RNN_CELLS = {"lstm": (_lstm_cell, 4, 2), "gru": (_gru_cell, 3, 1),
+              "rnn_tanh": (_tanh_cell, 1, 1), "rnn_relu": (_relu_cell, 1, 1)}
+
+
+def rnn_layer_scan(x_tbc, h0, c0, wx, wh, b, mode):
+    """One direction, one layer: x (T,B,C) -> (T,B,H). Weights pre-split."""
+    cell, ngates, nstate = _RNN_CELLS[mode]
+    carry = (h0, c0)[:nstate]
+
+    def step(carry, x_t):
+        return cell(carry, x_t, wx, wh, b)
+
+    carry, ys = lax.scan(step, carry, x_tbc)
+    return ys, carry
+
+
+@register("RNN", nout=3, stochastic=True)
+def rnn(data, params, state, state_cell=None, state_size=None, num_layers=1,
+        mode="lstm", bidirectional=False, p=0.0, projection_size=None,
+        training=False, key=None):
+    """Fused multi-layer RNN with cuDNN-compatible flat param layout.
+
+    data: (T, B, C); params: flat vector in cuDNN order (per layer, per
+    direction: W_x then W_h, then biases b_x, b_h); state: (L*D, B, H).
+    Returns (output, h_n, c_n) like the reference op with state_outputs=True.
+    """
+    cell, ngates, nstate = _RNN_CELLS[mode]
+    T, B, C = data.shape
+    H = int(state_size)
+    D = 2 if bidirectional else 1
+    L = int(num_layers)
+
+    # unflatten params
+    off = 0
+
+    def take(n, shape):
+        nonlocal off
+        w = lax.dynamic_slice(params, (off,), (n,)).reshape(shape)
+        off += n
+        return w
+
+    layer_ws = []
+    for layer in range(L):
+        in_dim = C if layer == 0 else H * D
+        dirs = []
+        for d in range(D):
+            wx = take(ngates * H * in_dim, (ngates * H, in_dim))
+            wh = take(ngates * H * H, (ngates * H, H))
+            dirs.append((wx, wh))
+        layer_ws.append(dirs)
+    layer_bs = []
+    for layer in range(L):
+        dirs = []
+        for d in range(D):
+            bx = take(ngates * H, (ngates * H,))
+            bh = take(ngates * H, (ngates * H,))
+            dirs.append(bx + bh)
+        layer_bs.append(dirs)
+
+    h_n, c_n = [], []
+    x = data
+    for layer in range(L):
+        outs = []
+        for d in range(D):
+            idx = layer * D + d
+            h0 = state[idx]
+            c0 = state_cell[idx] if state_cell is not None else jnp.zeros_like(h0)
+            wx, wh = layer_ws[layer][d]
+            b = layer_bs[layer][d]
+            xs = jnp.flip(x, 0) if d == 1 else x
+            ys, carry = rnn_layer_scan(xs, h0, c0, wx, wh, b, mode)
+            if d == 1:
+                ys = jnp.flip(ys, 0)
+            outs.append(ys)
+            h_n.append(carry[0])
+            c_n.append(carry[1] if nstate == 2 else jnp.zeros_like(carry[0]))
+        x = jnp.concatenate(outs, axis=-1) if D == 2 else outs[0]
+        if training and p > 0 and layer < L - 1:
+            k = key if key is not None else _random.next_key()
+            mask = jax.random.bernoulli(jax.random.fold_in(k, layer), 1 - p, x.shape)
+            x = jnp.where(mask, x / (1 - p), 0).astype(x.dtype)
+    return x, jnp.stack(h_n), jnp.stack(c_n)
+
+
+# --------------------------------------------------------------------------
+# misc image ops used by the vision zoo
+# --------------------------------------------------------------------------
+@register("UpSampling")
+def upsampling(data, scale=2, sample_type="nearest", num_args=1):
+    s = int(scale)
+    return jnp.repeat(jnp.repeat(data, s, axis=-2), s, axis=-1)
+
+
+@register("BilinearResize2D", aliases=("_contrib_BilinearResize2D",))
+def bilinear_resize(data, height=None, width=None, scale_height=None, scale_width=None):
+    n, c, h, w = data.shape
+    oh = int(height) if height else int(h * scale_height)
+    ow = int(width) if width else int(w * scale_width)
+    return jax.image.resize(data, (n, c, oh, ow), method="linear")

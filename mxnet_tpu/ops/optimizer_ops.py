@@ -1,0 +1,141 @@
+"""Fused optimizer update operators.
+
+Reference: ``src/operator/optimizer_op.cc`` — ``sgd_update``,
+``sgd_mom_update``, ``adam_update``, ``lamb_update_phase1/2``, multi-tensor
+``multi_sgd_*`` and mixed-precision ``mp_*`` variants. On TPU each update is
+one jit-fused elementwise program; the multi-tensor fusion the reference
+hand-rolled falls out of jit-ing the whole parameter pytree at once
+(see ``mxnet_tpu.optimizer``). ``mp_*`` = bf16 weights + f32 master copy.
+
+All functions are pure: they *return* updated tensors instead of mutating.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register
+
+
+def _apply_wd(grad, weight, wd, rescale_grad, clip_gradient):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight.astype(jnp.float32)
+
+
+@register("sgd_update")
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    return (weight.astype(jnp.float32) - lr * g).astype(weight.dtype)
+
+
+@register("sgd_mom_update", nout=2)
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=False):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    mom_new = momentum * mom.astype(jnp.float32) - lr * g
+    w = weight.astype(jnp.float32) + mom_new
+    return w.astype(weight.dtype), mom_new.astype(mom.dtype)
+
+
+@register("nag_mom_update", nout=2)
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    mom_new = momentum * mom.astype(jnp.float32) + g
+    w = weight.astype(jnp.float32) - lr * (g + momentum * mom_new)
+    return w.astype(weight.dtype), mom_new.astype(mom.dtype)
+
+
+@register("adam_update", nout=3)
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    m = beta1 * mean.astype(jnp.float32) + (1 - beta1) * g
+    v = beta2 * var.astype(jnp.float32) + (1 - beta2) * jnp.square(g)
+    w = weight.astype(jnp.float32) - lr * m / (jnp.sqrt(v) + epsilon)
+    return w.astype(weight.dtype), m.astype(mean.dtype), v.astype(var.dtype)
+
+
+@register("rmsprop_update", nout=2)
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    n_new = (1 - gamma1) * jnp.square(g) + gamma1 * n.astype(jnp.float32)
+    w = weight.astype(jnp.float32) - lr * g / jnp.sqrt(n_new + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w.astype(weight.dtype), n_new.astype(n.dtype)
+
+
+@register("ftml_update", nout=4)
+def ftml_update(weight, grad, d, v, z, lr, t=1, beta1=0.6, beta2=0.999, epsilon=1e-8,
+                wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_grad if clip_grad > 0 else None)
+    v_new = beta2 * v.astype(jnp.float32) + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (jnp.sqrt(v_new / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d.astype(jnp.float32)
+    z_new = beta1 * z.astype(jnp.float32) + (1 - beta1) * g - sigma * weight.astype(jnp.float32)
+    w = -z_new / d_t
+    return w.astype(weight.dtype), d_t.astype(d.dtype), v_new.astype(v.dtype), z_new.astype(z.dtype)
+
+
+@register("adagrad_update", nout=2)
+def adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    h = history.astype(jnp.float32) + jnp.square(g)
+    w = weight.astype(jnp.float32) - lr * g / (jnp.sqrt(h) + epsilon)
+    return w.astype(weight.dtype), h.astype(history.dtype)
+
+
+@register("ftrl_update", nout=3)
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w = weight.astype(jnp.float32)
+    n_old = n.astype(jnp.float32)
+    n_new = n_old + jnp.square(g)
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n_old)) / lr
+    z_new = z.astype(jnp.float32) + g - sigma * w
+    w_new = jnp.where(
+        jnp.abs(z_new) <= lamda1,
+        0.0,
+        -(z_new - jnp.sign(z_new) * lamda1) / ((beta + jnp.sqrt(n_new)) / lr + wd),
+    )
+    return w_new.astype(weight.dtype), z_new.astype(z.dtype), n_new.astype(n.dtype)
+
+
+@register("signsgd_update")
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient if clip_gradient > 0 else None)
+    return (weight.astype(jnp.float32) - lr * jnp.sign(g)).astype(weight.dtype)
+
+
+# -- LAMB (reference: lamb_update_phase1/phase2, the BERT optimizer) ---------
+@register("lamb_update_phase1")
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                       t=1, bias_correction=True, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean.astype(jnp.float32) + (1 - beta1) * g
+    v = beta2 * var.astype(jnp.float32) + (1 - beta2) * jnp.square(g)
+    mh, vh = m, v
+    if bias_correction:
+        mh = m / (1 - beta1 ** t)
+        vh = v / (1 - beta2 ** t)
+    update = mh / (jnp.sqrt(vh) + epsilon) + wd * weight.astype(jnp.float32)
+    return update, m.astype(mean.dtype), v.astype(var.dtype)
+
+
+@register("lamb_update_phase2")
+def lamb_update_phase2(weight, g_update, r1, r2, lr, lower_bound=-1.0, upper_bound=-1.0):
+    r1 = jnp.where(r1 > 0, r1, jnp.ones_like(r1))
+    r2 = jnp.where(r2 > 0, r2, jnp.ones_like(r2))
+    if lower_bound is not None and lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    trust = r1 / r2
+    return (weight.astype(jnp.float32) - lr * trust * g_update).astype(weight.dtype)

@@ -1,0 +1,76 @@
+"""Random sampling operators (reference: ``src/operator/random/sample_op.cc``).
+
+Each op draws from the process-global threefry key chain
+(:mod:`mxnet_tpu.random`) so ``mx.random.seed`` reproduces runs, and splits
+deterministically under jit traces.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from ..registry import register
+from .. import random as _random
+
+
+def _key(key):
+    return key if key is not None else _random.next_key()
+
+
+@register("_random_uniform", aliases=("random_uniform", "uniform_sample"), stochastic=True)
+def random_uniform(low=0.0, high=1.0, shape=(), dtype="float32", key=None):
+    return jax.random.uniform(_key(key), tuple(shape), dtype_np(dtype), low, high)
+
+
+@register("_random_normal", aliases=("random_normal", "normal_sample"), stochastic=True)
+def random_normal(loc=0.0, scale=1.0, shape=(), dtype="float32", key=None):
+    return jax.random.normal(_key(key), tuple(shape), dtype_np(dtype)) * scale + loc
+
+
+@register("_random_gamma", aliases=("random_gamma",), stochastic=True)
+def random_gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", key=None):
+    return jax.random.gamma(_key(key), alpha, tuple(shape), dtype_np(dtype)) * beta
+
+
+@register("_random_exponential", aliases=("random_exponential",), stochastic=True)
+def random_exponential(lam=1.0, shape=(), dtype="float32", key=None):
+    return jax.random.exponential(_key(key), tuple(shape), dtype_np(dtype)) / lam
+
+
+@register("_random_poisson", aliases=("random_poisson",), stochastic=True)
+def random_poisson(lam=1.0, shape=(), dtype="float32", key=None):
+    return jax.random.poisson(_key(key), lam, tuple(shape)).astype(dtype_np(dtype))
+
+
+@register("_random_randint", aliases=("random_randint",), stochastic=True)
+def random_randint(low=0, high=None, shape=(), dtype="int32", key=None):
+    return jax.random.randint(_key(key), tuple(shape), low, high, dtype_np(dtype))
+
+
+@register("_sample_multinomial", aliases=("sample_multinomial",), stochastic=True)
+def sample_multinomial(data, shape=(), get_prob=False, dtype="int32", key=None):
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    n = 1
+    for s in shape if isinstance(shape, (tuple, list)) else (shape,):
+        n *= int(s) if s else 1
+    out_shape = data.shape[:-1] + (tuple(shape) if isinstance(shape, (tuple, list)) else (int(shape),) if shape else ())
+    idx = jax.random.categorical(_key(key), logits, axis=-1, shape=None if not shape else out_shape)
+    idx = idx.astype(dtype_np(dtype))
+    if get_prob:
+        p = jnp.take_along_axis(jax.nn.log_softmax(logits), idx[..., None].astype(jnp.int32), -1)[..., 0]
+        return idx, p
+    return idx
+
+
+@register("shuffle", aliases=("_shuffle",), stochastic=True)
+def shuffle(data, key=None):
+    return jax.random.permutation(_key(key), data, axis=0)
+
+
+@register("_sample_unique_zipfian", stochastic=True)
+def sample_unique_zipfian(range_max, shape=(), key=None):
+    # approximate: log-uniform sampling without dedup (reference is approximate too)
+    u = jax.random.uniform(_key(key), tuple(shape))
+    out = jnp.exp(u * jnp.log(float(range_max))).astype(jnp.int64) - 1
+    return jnp.clip(out, 0, range_max - 1)

@@ -1,0 +1,13 @@
+"""Distributed / parallelism (SURVEY §2.3, §5.8).
+
+The reference's three comm stacks (ps-lite ZMQ, NCCL, CUDA p2p comm trees)
+collapse into *one* mechanism here: a ``jax.sharding.Mesh`` + sharding
+annotations, with GSPMD emitting all collectives over ICI/DCN. This package
+adds the parallelism the reference never had (TP, SP/CP ring attention) as
+first-class capabilities, per the build contract.
+"""
+from .mesh import MeshConfig, make_mesh, local_mesh  # noqa: F401
+from .sharding import ShardingRules, named_sharding, shard_params  # noqa: F401
+from .train_step import TrainStep  # noqa: F401
+from .distributed_trainer import DistributedTrainer, init as dist_init  # noqa: F401
+from . import ring_attention  # noqa: F401

@@ -1,0 +1,60 @@
+"""Device mesh construction.
+
+Axes follow the scaling-book convention: ``dp`` (data), ``fsdp`` (optional
+param/optimizer sharding on the data axis), ``tp`` (tensor/model), ``sp``
+(sequence/context), ``pp`` (pipeline stages), ``ep`` (experts). A config
+names the axes it uses; unused axes have size 1 and cost nothing.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["MeshConfig", "make_mesh", "local_mesh"]
+
+AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
+
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXES)
+
+    @property
+    def total(self) -> int:
+        return math.prod(self.sizes())
+
+    @staticmethod
+    def auto(n_devices: int, tp: int = 1, sp: int = 1) -> "MeshConfig":
+        """All leftover devices go to dp (the ResNet/BERT DP default)."""
+        rest = n_devices // (tp * sp)
+        return MeshConfig(dp=rest, tp=tp, sp=sp)
+
+
+def make_mesh(config: Optional[MeshConfig] = None, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig(dp=len(devices))
+    if config.total != len(devices):
+        raise ValueError(f"mesh {config} needs {config.total} devices, "
+                         f"got {len(devices)}")
+    arr = np.asarray(devices).reshape(config.sizes())
+    return Mesh(arr, AXES)
+
+
+def local_mesh(n: Optional[int] = None, **axis_sizes) -> Mesh:
+    """Mesh over the first n local devices (test/dry-run helper)."""
+    devs = jax.devices()[: n or len(jax.devices())]
+    cfg = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig(dp=len(devs))
+    return make_mesh(cfg, devs)

@@ -1,0 +1,86 @@
+"""Sharding rules: parameter-name patterns -> PartitionSpec.
+
+Replaces the reference's manual ``group2ctx`` placement (nnvm PlaceDevice
+pass) with GSPMD annotations. Rules are regex patterns over parameter names
+(megatron-style TP: column-parallel first projection, row-parallel second),
+plus a ZeRO-style ``fsdp`` fallback that shards the largest axis.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ShardingRules", "named_sharding", "shard_params", "DEFAULT_BERT_RULES"]
+
+
+class ShardingRules:
+    """Ordered (pattern, spec-maker) list; first match wins."""
+
+    def __init__(self, rules: Optional[List[Tuple[str, tuple]]] = None,
+                 fsdp_axis: Optional[str] = None, min_fsdp_size: int = 2 ** 16):
+        self.rules = [(re.compile(p), spec) for p, spec in (rules or [])]
+        self.fsdp_axis = fsdp_axis
+        self.min_fsdp_size = min_fsdp_size
+
+    def spec_for(self, name: str, shape, mesh: Mesh) -> P:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                spec = tuple(spec)[: len(shape)]
+                if _fits(spec, shape, mesh):
+                    return P(*spec)
+        if self.fsdp_axis and _size(shape) >= self.min_fsdp_size:
+            ax_size = mesh.shape[self.fsdp_axis]
+            for dim, s in sorted(enumerate(shape), key=lambda t: -t[1]):
+                if s % ax_size == 0:
+                    spec = [None] * len(shape)
+                    spec[dim] = self.fsdp_axis
+                    return P(*spec)
+        return P()
+
+    def tree_specs(self, params: Dict[str, jax.Array], mesh: Mesh):
+        return {k: self.spec_for(k, v.shape, mesh) for k, v in params.items()}
+
+
+def _size(shape):
+    n = 1
+    for s in shape:
+        n *= s
+    return n
+
+
+def _fits(spec, shape, mesh) -> bool:
+    for dim, ax in zip(shape, spec):
+        if ax is not None and dim % mesh.shape[ax] != 0:
+            return False
+    return True
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_params(params: Dict[str, jax.Array], mesh: Mesh,
+                 rules: Optional[ShardingRules] = None) -> Dict[str, jax.Array]:
+    """Place a parameter pytree onto the mesh per the rules."""
+    rules = rules or ShardingRules(fsdp_axis=None)
+    specs = rules.tree_specs(params, mesh)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}
+
+
+# Megatron-style TP pattern set for the transformer models in models/:
+# attention qkv + ffn-in are column-parallel (shard output dim on tp),
+# attention out + ffn-out are row-parallel (shard input dim on tp),
+# embeddings shard vocab on tp.
+DEFAULT_BERT_RULES = ShardingRules(
+    rules=[
+        (r"(qkv|query|key|value|ffn1|intermediate|fc1)\w*_weight$", ("tp", None)),
+        (r"(proj|ffn2|output_dense|fc2)\w*_weight$", (None, "tp")),
+        (r"(qkv|query|key|value|ffn1|intermediate|fc1)\w*_bias$", ("tp",)),
+        (r"word_embed\w*_weight$", ("tp", None)),
+    ],
+    fsdp_axis=None,
+)

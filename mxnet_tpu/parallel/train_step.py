@@ -1,0 +1,156 @@
+"""The pjit-ed train step factory — the performance path.
+
+One compiled XLA program = forward + backward + (GSPMD-inserted) gradient
+all-reduce + optimizer update, with donated buffers. This is the TPU
+replacement for the whole per-batch choreography of SURVEY §3.2 (CachedOp
+forward, autograd backward, KVStore push/pull, per-param optimizer ops).
+
+Works with any Gluon ``HybridBlock``: parameters are pulled into a pytree,
+the block's forward is re-run functionally inside jit via the hybrid trace
+machinery, and updated parameters are written back on request (``sync``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import random as _rng
+from ..gluon.block import _HybridTrace
+from ..ndarray import NDArray
+from .sharding import ShardingRules
+
+__all__ = ["TrainStep"]
+
+
+class TrainStep:
+    """Compile a full training step over a mesh.
+
+    Parameters
+    ----------
+    net : HybridBlock — the model (initialized).
+    loss_fn : callable(out_nd, *label_nds) -> scalar-able NDArray loss.
+    optimizer : mxnet_tpu.optimizer.Optimizer (pure update_raw protocol).
+    mesh : jax.sharding.Mesh or None (single device).
+    rules : ShardingRules for parameters (None = replicate).
+    batch_spec : PartitionSpec for each batch input (default shard dim0 on
+        'dp' when the mesh has that axis).
+    donate : donate param/opt-state buffers (default True).
+    """
+
+    def __init__(self, net, loss_fn, optimizer, mesh: Optional[Mesh] = None,
+                 rules: Optional[ShardingRules] = None, batch_spec=None,
+                 donate: bool = True):
+        self.net = net
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.rules = rules or ShardingRules()
+        self.donate = donate
+        self._plist = [p for _, p in sorted(net.collect_params().items())]
+        for p in self._plist:
+            if p._nd is None:
+                raise ValueError(f"parameter {p.name} not initialized; run one "
+                                 "forward pass first")
+        self._trainable = [p.grad_req != "null" for p in self._plist]
+        self.params = {p.name: p._nd._data for p in self._plist}
+        self.opt_state = {
+            p.name: optimizer.create_state(i, p._nd._data)
+            for i, p in enumerate(self._plist) if self._trainable[i]
+        }
+        self.step_count = jnp.zeros((), jnp.int32)
+        if mesh is not None:
+            specs = self.rules.tree_specs(self.params, mesh)
+            self.param_sharding = {k: NamedSharding(mesh, s) for k, s in specs.items()}
+            self.params = {k: jax.device_put(v, self.param_sharding[k])
+                           for k, v in self.params.items()}
+            self.opt_state = jax.tree_util.tree_map(
+                lambda x: x, self.opt_state)  # states follow params lazily below
+            self.opt_state = {
+                k: jax.tree_util.tree_map(
+                    lambda s, _k=k: jax.device_put(s, self.param_sharding[_k]), v)
+                for k, v in self.opt_state.items()
+            }
+            if batch_spec is None and "dp" in mesh.shape:
+                axes = [ax for ax in ("dp", "fsdp") if ax in mesh.shape and mesh.shape[ax] > 1]
+                batch_spec = P(tuple(axes) if len(axes) > 1 else (axes[0] if axes else None))
+            self.batch_sharding = NamedSharding(mesh, batch_spec or P())
+        else:
+            self.param_sharding = None
+            self.batch_sharding = None
+        self._compiled = None
+
+    # -- functional loss -----------------------------------------------------
+    def _loss_of(self, params: Dict[str, jax.Array], batch, key):
+        raws = [params[p.name] for p in self._plist]
+        with _HybridTrace(self._plist, raws, True, key):
+            nd_batch = [NDArray(b) for b in batch]
+            out = self.net(nd_batch[0])
+            loss = self.loss_fn(out, *nd_batch[1:])
+        raw = loss._data if isinstance(loss, NDArray) else loss
+        return jnp.mean(raw.astype(jnp.float32))
+
+    def _make_step(self):
+        opt = self.optimizer
+
+        def step(params, opt_state, step_count, batch, key, lr, wd):
+            loss, grads = jax.value_and_grad(self._loss_of)(params, batch, key)
+            new_params, new_state = dict(params), {}
+            t = step_count + 1
+            for name in params:
+                if name not in opt_state:
+                    continue
+                w, g = params[name], grads[name]
+                nw, ns = opt.update_raw(w, g, opt_state[name], lr, wd, t)
+                new_params[name] = nw
+                new_state[name] = ns
+            return new_params, new_state, t, loss
+
+        donate = (0, 1) if self.donate else ()
+        if self.mesh is not None:
+            in_shardings = (
+                self.param_sharding,
+                {k: jax.tree_util.tree_map(lambda _ : self.param_sharding[k], v)
+                 for k, v in self.opt_state.items()},
+                NamedSharding(self.mesh, P()),
+                tuple(self.batch_sharding for _ in range(self._n_batch)),
+                NamedSharding(self.mesh, P()),
+                NamedSharding(self.mesh, P()),
+                NamedSharding(self.mesh, P()),
+            )
+            return jax.jit(step, donate_argnums=donate, in_shardings=in_shardings)
+        return jax.jit(step, donate_argnums=donate)
+
+    # -- public API ----------------------------------------------------------
+    def __call__(self, *batch):
+        """Run one step. batch = (x, label, ...) as NDArray/jax arrays."""
+        raws = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b) for b in batch)
+        if self.batch_sharding is not None:
+            raws = tuple(jax.device_put(r, self.batch_sharding) for r in raws)
+        self._n_batch = len(raws)
+        if self._compiled is None:
+            self._compiled = self._make_step()
+        key = _rng.next_key()
+        lr = jnp.float32(self.optimizer.learning_rate)
+        wd = jnp.float32(self.optimizer.wd)
+        self.params, self.opt_state, self.step_count, loss = self._compiled(
+            self.params, self.opt_state, self.step_count, raws, key, lr, wd)
+        # host-side mirror (no device sync — loss is returned as a future)
+        self.optimizer.num_update += 1
+        return loss
+
+    def sync(self):
+        """Write compiled-side params back into the Gluon block."""
+        for p in self._plist:
+            p._nd._data = self.params[p.name]
+
+    def lower_hlo(self, *batch):
+        raws = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b) for b in batch)
+        self._n_batch = len(raws)
+        step = self._make_step()
+        key = _rng.next_key()
+        return step.lower(self.params, self.opt_state, self.step_count, raws, key,
+                          jnp.float32(1e-3), jnp.float32(0.0))

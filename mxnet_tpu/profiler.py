@@ -1,0 +1,88 @@
+"""Profiler (reference: ``src/profiler/`` + ``python/mxnet/profiler.py``).
+
+The reference engine wraps every op with Chrome-trace events. On TPU the
+instrumentation layer is ``jax.profiler`` (XPlane → TensorBoard/Perfetto);
+this module keeps the MXNet control surface (``set_config`` /
+``set_state('run'|'stop')`` / ``dump``) and the ``scope``/``annotate`` API
+mapped onto ``jax.profiler`` traces + named annotations.
+"""
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+
+import jax
+
+__all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume", "scope", "Profiler"]
+
+_state = {"running": False, "dir": "/tmp/mxnet_tpu_profile", "aggregate": {}}
+
+
+def set_config(filename=None, profile_all=False, profile_symbolic=True,
+               profile_imperative=True, profile_memory=True, profile_api=True,
+               aggregate_stats=False, **kwargs):
+    if filename:
+        _state["dir"] = os.path.dirname(os.path.abspath(filename)) or "."
+    _state["aggregate_stats"] = aggregate_stats
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run" and not _state["running"]:
+        jax.profiler.start_trace(_state["dir"])
+        _state["running"] = True
+        _state["t0"] = time.time()
+    elif state == "stop" and _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+
+
+def pause(profile_process="worker"):
+    set_state("stop")
+
+
+def resume(profile_process="worker"):
+    set_state("run")
+
+
+def dump(finished=True, profile_process="worker"):
+    if _state["running"]:
+        set_state("stop")
+    return _state["dir"]
+
+
+def dumps(reset=False):
+    """Aggregate per-op stat table. With XLA fusion, per-op means per-compiled
+    computation; detailed tables come from the xplane protos in the dump dir."""
+    lines = ["Profile Statistics (see TensorBoard / Perfetto for op-level "
+             f"detail; traces in {_state['dir']})"]
+    for name, (count, total) in sorted(_state["aggregate"].items()):
+        lines.append(f"{name}\t{count}\t{total * 1e3:.3f}ms")
+    return "\n".join(lines)
+
+
+@contextmanager
+def scope(name="<unk>:"):
+    with jax.profiler.TraceAnnotation(name):
+        t0 = time.time()
+        yield
+        c, t = _state["aggregate"].get(name, (0, 0.0))
+        _state["aggregate"][name] = (c + 1, t + time.time() - t0)
+
+
+annotate = scope
+
+
+class Profiler:
+    """Context-manager convenience (not in the reference; thin sugar)."""
+
+    def __init__(self, output_dir=None):
+        if output_dir:
+            set_config(filename=os.path.join(output_dir, "profile.json"))
+
+    def __enter__(self):
+        set_state("run")
+        return self
+
+    def __exit__(self, *exc):
+        set_state("stop")

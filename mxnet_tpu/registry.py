@@ -1,0 +1,77 @@
+"""Central operator registry.
+
+The one architectural idea deliberately kept from the reference: a single
+registry from which every user-facing op namespace is code-generated. In
+MXNet 1.x this is the nnvm registry (``NNVM_REGISTER_OP`` +
+``python/mxnet/ndarray/register.py`` generating ``mx.nd.*`` at import time).
+Here an op is a *pure jax function* ``fn(*arrays, **params)`` — shape/dtype
+inference, kernels and gradients all come from jax/XLA tracing instead of the
+reference's ``FInferShape/FCompute/FGradient`` attribute triple.
+
+The registry drives:
+  - ``mx.nd.*``   (imperative namespace; NDArray in/out, autograd-recorded)
+  - ``mx.sym.*``  (lazy Symbol namespace; same ops, deferred)
+  - docstring + alias generation (incl. ``_contrib_*`` names).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Sequence
+
+__all__ = ["OpDef", "register", "get", "list_ops", "alias"]
+
+
+@dataclasses.dataclass
+class OpDef:
+    name: str
+    fn: Callable  # pure: (*jax_arrays, **params) -> array | tuple(arrays)
+    nout: int = 1
+    aliases: Sequence[str] = ()
+    doc: Optional[str] = None
+    # ops that must not be constant-folded across autograd replay (e.g. RNG
+    # consumers) advertise it; the tape forwards an explicit key param.
+    stochastic: bool = False
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register(name, *, nout=1, aliases=(), stochastic=False):
+    """Decorator: register a pure jax function as a named operator."""
+
+    def deco(fn):
+        op = OpDef(
+            name=name,
+            fn=fn,
+            nout=nout,
+            aliases=tuple(aliases),
+            doc=fn.__doc__,
+            stochastic=stochastic,
+        )
+        for n in (name, *aliases):
+            if n in _REGISTRY:
+                raise ValueError(f"operator {n!r} registered twice")
+            _REGISTRY[n] = op
+        return fn
+
+    return deco
+
+
+def alias(existing: str, *names: str) -> None:
+    op = _REGISTRY[existing]
+    for n in names:
+        _REGISTRY[n] = op
+
+
+def get(name: str) -> OpDef:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise AttributeError(f"operator {name!r} is not registered") from None
+
+
+def list_ops():
+    return sorted(set(_REGISTRY))

@@ -1,0 +1,99 @@
+""".params-compatible tensor serialization.
+
+Reference: ``NDArray::Save/Load`` (``src/ndarray/ndarray.cc``) — a dmlc
+binary stream: magic 0x112 ("NDAR"), reserved u64, count, arrays (each with
+its own magic, shape, context, dtype, raw bytes), then names. This module
+writes/reads that exact wire format so ``.params`` files interoperate with
+reference-era model zoos, and also round-trips a native ``.npz`` fast path.
+
+Layout notes: format stores raw C-order bytes; bfloat16 uses MXNet type flag
+12 when writing (reference forks with bf16 used the same slot).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Union
+
+import numpy as np
+
+from .base import MXNetError, dtype_flag, dtype_np
+
+NDARRAY_MAGIC = 0x112  # dmlc NDArray list magic (ndarray.cc kMXAPINDArrayListMagic)
+_SINGLE_MAGIC = 0xF993FAC9  # per-array magic in MXNet >= 1.0 (NDARRAY_V2_MAGIC)
+_V3_MAGIC = 0xF993FACA
+
+_FLAG_TO_NP = {0: "float32", 1: "float64", 2: "float16", 3: "uint8", 4: "int32",
+               5: "int8", 6: "int64", 7: "bool", 12: "bfloat16"}
+
+
+def _write_one(f, arr: np.ndarray):
+    f.write(struct.pack("<I", _SINGLE_MAGIC))
+    # stype (-1 dense is implicit in V2 by writing shape directly)
+    f.write(struct.pack("<I", len(arr.shape)))
+    for s in arr.shape:
+        f.write(struct.pack("<q", s))
+    f.write(struct.pack("<ii", 1, 0))  # context: cpu(0)
+    f.write(struct.pack("<i", dtype_flag(arr.dtype)))
+    f.write(np.ascontiguousarray(arr).tobytes())
+
+
+def _read_one(f) -> np.ndarray:
+    magic = struct.unpack("<I", f.read(4))[0]
+    if magic not in (_SINGLE_MAGIC, _V3_MAGIC):
+        raise MXNetError(f"bad NDArray magic {magic:#x}")
+    if magic == _V3_MAGIC:
+        stype = struct.unpack("<i", f.read(4))[0]
+        if stype != -1:
+            raise MXNetError("sparse .params arrays are not supported on TPU")
+    ndim = struct.unpack("<I", f.read(4))[0]
+    shape = tuple(struct.unpack("<q", f.read(8))[0] for _ in range(ndim))
+    _devtype, _devid = struct.unpack("<ii", f.read(8))
+    flag = struct.unpack("<i", f.read(4))[0]
+    dt = dtype_np(_FLAG_TO_NP[flag])
+    n = int(np.prod(shape)) if shape else 1
+    data = np.frombuffer(f.read(n * dt.itemsize), dtype=dt).reshape(shape)
+    return data.copy()
+
+
+def save_ndarrays(fname: str, data) -> None:
+    """``mx.nd.save``: dict[str, NDArray] | list[NDArray] -> .params file."""
+    if hasattr(data, "_data"):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v) for v in data.values()]
+    else:
+        names = []
+        arrays = [np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v) for v in data]
+    with open(fname, "wb") as f:
+        f.write(struct.pack("<Q", NDARRAY_MAGIC))
+        f.write(struct.pack("<Q", 0))  # reserved
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            _write_one(f, a)
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            b = n.encode()
+            f.write(struct.pack("<Q", len(b)))
+            f.write(b)
+
+
+def load_ndarrays(fname: str) -> Union[Dict[str, "object"], List["object"]]:
+    from .ndarray import NDArray
+
+    with open(fname, "rb") as f:
+        magic = struct.unpack("<Q", f.read(8))[0]
+        if magic != NDARRAY_MAGIC:
+            raise MXNetError(f"{fname}: not an MXNet .params file (magic {magic:#x})")
+        f.read(8)
+        count = struct.unpack("<Q", f.read(8))[0]
+        arrays = [_read_one(f) for _ in range(count)]
+        nname = struct.unpack("<Q", f.read(8))[0]
+        names = []
+        for _ in range(nname):
+            ln = struct.unpack("<Q", f.read(8))[0]
+            names.append(f.read(ln).decode())
+    nds = [NDArray(a) for a in arrays]
+    if names:
+        return dict(zip(names, nds))
+    return nds

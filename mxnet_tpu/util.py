@@ -1,0 +1,17 @@
+"""Misc utilities (reference: ``python/mxnet/util.py``)."""
+from __future__ import annotations
+
+
+def is_np_array() -> bool:
+    """numpy-semantics toggle; this build is always nd-semantics."""
+    return False
+
+
+def use_np_shape(fn):
+    return fn
+
+
+def makedirs(d):
+    import os
+
+    os.makedirs(d, exist_ok=True)

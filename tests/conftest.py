@@ -1,0 +1,26 @@
+"""Test harness: 8 virtual CPU devices (SURVEY §4 — multi-node-without-a-
+cluster testing), mirroring the reference's N-local-process KVStore CI.
+
+The axon sitecustomize pre-imports jax and pins JAX_PLATFORMS=axon, so the
+platform override must go through jax.config (env vars are already read).
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    """Reference: @with_seed() decorator — reproducible randomness per test."""
+    import mxnet_tpu as mx
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,136 @@
+"""autograd record/backward semantics (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_and_broadcast():
+    x = nd.array(np.random.rand(3, 4).astype(np.float32))
+    w = nd.array(np.random.rand(5, 4).astype(np.float32))
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = nd.FullyConnected(x, w, None, num_hidden=5, no_bias=True)
+        z = nd.relu(y)
+        loss = (z * z).mean()
+    loss.backward()
+    # numeric check on x
+    def f(xv):
+        y = xv @ w.asnumpy().T
+        z = np.maximum(y, 0)
+        return (z * z).mean()
+
+    eps = 1e-3
+    g = np.zeros_like(x.asnumpy())
+    xv = x.asnumpy()
+    for i in range(3):
+        for j in range(4):
+            xp, xm = xv.copy(), xv.copy()
+            xp[i, j] += eps
+            xm[i, j] -= eps
+            g[i, j] = (f(xp) - f(xm)) / (2 * eps)
+    np.testing.assert_allclose(x.grad.asnumpy(), g, rtol=1e-2, atol=1e-4)
+
+
+def test_head_gradient():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([5.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [15.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 4 * x.asnumpy())
+
+
+def test_detach_blocks_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = (y.detach() * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_stop_gradient_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * 2) * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_is_training_flags():
+    assert not autograd.is_recording()
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_autograd_grad_api():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+    (g,) = autograd.grad([y], [x])
+    np.testing.assert_allclose(g.asnumpy(), 3 * x.asnumpy() ** 2, rtol=1e-6)
+
+
+def test_dropout_replay_consistency():
+    """Stochastic op must reuse its key in the vjp replay (grad matches mask)."""
+    x = nd.array(np.ones((200,), np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Dropout(x, p=0.5, training=True)
+        loss = y.sum()
+    loss.backward()
+    out = y.asnumpy()
+    g = x.grad.asnumpy()
+    # grad is 2.0 exactly where output kept, 0 where dropped
+    np.testing.assert_allclose((out != 0).astype(np.float32) * 2.0, g)
+
+
+def test_getitem_grad():
+    x = nd.array([1.0, 2.0, 3.0, 4.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x[1:3] * 2).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0, 2, 2, 0])
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables(x, g)
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())

@@ -1,0 +1,226 @@
+"""Gluon blocks: deferred init, hybridize-equivalence (the core invariant,
+SURVEY §4), trainer steps, serialization round-trips
+(reference: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def test_dense_deferred_init():
+    net = nn.Dense(4)
+    net.initialize()
+    x = nd.array(np.random.rand(2, 3).astype(np.float32))
+    out = net(x)
+    assert out.shape == (2, 4)
+    assert net.weight.shape == (4, 3)
+
+
+def test_dense_explicit_in_units():
+    net = nn.Dense(4, in_units=3, use_bias=False)
+    net.initialize(mx.init.Constant(0.5))
+    x = nd.ones((2, 3))
+    np.testing.assert_allclose(net(x).asnumpy(), np.full((2, 4), 1.5), rtol=1e-6)
+
+
+def test_sequential_mlp_forward():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = nd.array(np.random.rand(4, 5).astype(np.float32))
+    assert net(x).shape == (4, 3)
+
+
+def test_hybridize_equivalence_mlp():
+    """eager == hybridized — the single most important invariant."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8, activation="tanh"), nn.Dense(4))
+    net.initialize()
+    x = nd.array(np.random.rand(5, 10).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()   # first call: deferred-safe path
+    hybrid2 = net(x).asnumpy()  # second call: jit cache hit
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(eager, hybrid2, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_equivalence_conv():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2, 2), nn.Flatten(), nn.Dense(3))
+    net.initialize()
+    x = nd.array(np.random.rand(2, 3, 8, 8).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    _ = net(x)
+    np.testing.assert_allclose(net(x).asnumpy(), eager, rtol=1e-4, atol=1e-5)
+
+
+def test_hybridize_backward_matches_eager():
+    def run(hybrid):
+        mx.random.seed(5)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(6, activation="relu"), nn.Dense(1))
+        net.initialize()
+        if hybrid:
+            net.hybridize()
+        x = nd.array(np.random.RandomState(3).rand(4, 5).astype(np.float32))
+        _ = net(x)  # trigger deferred init / trace
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        return {name: p.grad().asnumpy() for name, p in net.collect_params().items()}
+
+    g_eager = run(False)
+    g_hybrid = run(True)
+    assert set(g_eager) == {k.replace("hybridsequential1", "hybridsequential0")
+                            if False else k for k in g_eager}
+    for (k1, v1), (k2, v2) in zip(sorted(g_eager.items()), sorted(g_hybrid.items())):
+        np.testing.assert_allclose(v1, v2, rtol=1e-4, atol=1e-5, err_msg=k1)
+
+
+def test_batchnorm_moving_stats_update_eager_and_hybrid():
+    for hybrid in (False, True):
+        net = nn.HybridSequential()
+        net.add(nn.BatchNorm())
+        net.initialize()
+        if hybrid:
+            net.hybridize()
+        x = nd.array((np.random.rand(8, 3, 4, 4) * 5 + 2).astype(np.float32))
+        bn = net[0]
+        _ = net(x)
+        with autograd.record():
+            _ = net(x)
+        rm = bn.running_mean.data().asnumpy()
+        assert not np.allclose(rm, 0), f"running stats not updated (hybrid={hybrid})"
+
+
+def test_trainer_sgd_step_converges_linreg():
+    w_true = np.array([[2.0, -3.4]], np.float32)
+    b_true = 4.2
+    X = np.random.rand(256, 2).astype(np.float32)
+    Y = X @ w_true.T + b_true
+
+    net = nn.Dense(1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5})
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(300):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(X)), nd.array(Y))
+        loss.backward()
+        trainer.step(256)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w_true, atol=0.1)
+    np.testing.assert_allclose(net.bias.data().asnumpy(), [b_true], atol=0.1)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    x = nd.ones((1, 3))
+    out1 = net(x).asnumpy()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4), nn.Dense(2))
+    net2.load_parameters(f)
+    np.testing.assert_allclose(net2(x).asnumpy(), out1, rtol=1e-6)
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    _ = net(nd.ones((1, 3)))
+    weights = net.collect_params(".*weight")
+    assert all(k.endswith("weight") for k in weights)
+    assert len(list(weights)) == 2
+
+
+def test_losses():
+    pred = nd.array(np.random.randn(4, 5).astype(np.float32))
+    label = nd.array(np.array([0, 1, 2, 3], np.float32))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    p = pred.asnumpy()
+    e = np.exp(p - p.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    ref = -np.log(sm[np.arange(4), label.asnumpy().astype(int)])
+    np.testing.assert_allclose(l.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(nd.ones((2, 3)), nd.zeros((2, 3)))
+    np.testing.assert_allclose(l2.asnumpy(), [0.5, 0.5])
+
+    l1 = gluon.loss.L1Loss()(nd.ones((2, 3)), nd.zeros((2, 3)))
+    np.testing.assert_allclose(l1.asnumpy(), [1.0, 1.0])
+
+
+def test_dropout_layer_train_vs_eval():
+    net = nn.Dropout(0.5)
+    net.initialize()
+    x = nd.ones((100,))
+    out_eval = net(x).asnumpy()
+    np.testing.assert_allclose(out_eval, np.ones(100))
+    with autograd.record():
+        out_train = net(x).asnumpy()
+    assert (out_train == 0).any()
+
+
+def test_embedding_layer():
+    net = nn.Embedding(10, 4)
+    net.initialize()
+    idx = nd.array([1, 2, 3], dtype="int32")
+    assert net(idx).shape == (3, 4)
+
+
+def test_rnn_layers_forward():
+    for cls, nstates in ((gluon.rnn.LSTM, 2), (gluon.rnn.GRU, 1), (gluon.rnn.RNN, 1)):
+        net = cls(hidden_size=6, num_layers=2)
+        net.initialize()
+        x = nd.array(np.random.rand(5, 3, 4).astype(np.float32))  # TNC
+        out = net(x)
+        assert out.shape == (5, 3, 6)
+        states = net.begin_state(batch_size=3)
+        out2, new_states = net(x, states)
+        assert out2.shape == (5, 3, 6)
+        assert len(new_states) == nstates
+
+
+def test_rnn_cell_unroll():
+    cell = gluon.rnn.LSTMCell(8)
+    cell.initialize()
+    x = nd.array(np.random.rand(2, 5, 3).astype(np.float32))  # NTC
+    out, states = cell.unroll(5, x, layout="NTC")
+    assert out.shape == (2, 5, 8) or out.shape == (5, 2, 8)
+
+
+def test_model_zoo_lenet_resnet_forward():
+    net = gluon.model_zoo.get_model("lenet")
+    net.initialize()
+    assert net(nd.ones((2, 1, 28, 28))).shape == (2, 10)
+
+    net = gluon.model_zoo.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    out = net(nd.ones((1, 3, 32, 32)))
+    assert out.shape == (1, 10)
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.1})
+    x = nd.ones((4, 2))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(4)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+    tr2 = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.1})
+    tr2.load_states(f)
+    assert tr2._optimizer.num_update == tr._optimizer.num_update

@@ -1,0 +1,128 @@
+"""NDArray semantics vs numpy oracle (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    b = nd.ones((2, 3), dtype="int32")
+    assert b.asnumpy().sum() == 6
+    c = nd.full((2, 2), 7.0)
+    np.testing.assert_allclose(c.asnumpy(), np.full((2, 2), 7.0))
+    d = nd.array(np.arange(6).reshape(2, 3))
+    assert d.shape == (2, 3)
+    e = nd.arange(0, 10, 2)
+    np.testing.assert_allclose(e.asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[6, 8], [10, 12]])
+    np.testing.assert_allclose((a - b).asnumpy(), [[-4, -4], [-4, -4]])
+    np.testing.assert_allclose((a * b).asnumpy(), [[5, 12], [21, 32]])
+    np.testing.assert_allclose((b / a).asnumpy(), [[5, 3], [7 / 3, 2]])
+    np.testing.assert_allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    np.testing.assert_allclose((2 * a).asnumpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((1 / a).asnumpy(), 1 / a.asnumpy())
+    np.testing.assert_allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]])
+    np.testing.assert_allclose((-a).asnumpy(), -a.asnumpy())
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    aid = id(a)
+    a += 1
+    assert id(a) == aid
+    np.testing.assert_allclose(a.asnumpy(), np.full((2, 2), 2.0))
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), np.full((2, 2), 6.0))
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(a[1:3].asnumpy(), np.arange(4, 12).reshape(2, 4))
+    a[0] = 0
+    assert a.asnumpy()[0].sum() == 0
+    a[:] = 1
+    assert a.asnumpy().sum() == 12
+    b = nd.array(np.arange(6))
+    idx = nd.array([0, 2], dtype="int32")
+    np.testing.assert_allclose(b[idx].asnumpy(), [0, 2])
+
+
+def test_methods():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(a.reshape(6, 4).asnumpy(), x.reshape(6, 4))
+    np.testing.assert_allclose(a.reshape((-1,)).asnumpy(), x.reshape(-1))
+    np.testing.assert_allclose(a.transpose().asnumpy(), x.T, rtol=1e-6)
+    np.testing.assert_allclose(a.sum(axis=1).asnumpy(), x.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(a.mean().asnumpy(), x.mean(), rtol=1e-5)
+    np.testing.assert_allclose(a.max(axis=(0, 2)).asnumpy(), x.max((0, 2)))
+    np.testing.assert_allclose(a.flatten().asnumpy(), x.reshape(2, -1))
+    assert a.astype("float16").dtype == np.float16
+
+
+def test_reshape_special_codes():
+    a = nd.zeros((2, 3, 4))
+    assert nd.reshape(a, shape=(0, -1)).shape == (2, 12)
+    assert nd.reshape(a, shape=(-2,)).shape == (2, 3, 4)
+    assert nd.reshape(a, shape=(-3, 4)).shape == (6, 4)
+
+
+def test_scalar_conversion():
+    a = nd.array([3.5])
+    assert float(a) == 3.5
+    assert a.asscalar() == 3.5
+    assert int(nd.array([2])) == 2
+
+
+def test_wait_and_context():
+    a = nd.ones((4,))
+    a.wait_to_read()
+    assert a.context.device_type in ("cpu", "gpu", "tpu")
+    nd.waitall()
+
+
+def test_dtype_flags():
+    a = nd.zeros((2,), dtype="bfloat16")
+    assert "bfloat16" in str(a._data.dtype)
+    b = a.astype("float32")
+    assert b.dtype == np.float32
+
+
+def test_concat_split_stack():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert parts[0].shape == (2, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "x.params")
+    d = {"a": nd.array([[1, 2]]), "b": nd.ones((3,), dtype="int32")}
+    nd.save(f, d)
+    loaded = nd.load(f)
+    np.testing.assert_allclose(loaded["a"].asnumpy(), [[1, 2]])
+    assert loaded["b"].dtype == np.int32
+    lst = [nd.zeros((2,)), nd.ones((2,))]
+    nd.save(f, lst)
+    l2 = nd.load(f)
+    assert isinstance(l2, list) and len(l2) == 2
+
+
+def test_comparison_returns_float_like_mxnet():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_allclose((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose((a == b).asnumpy(), [0, 1, 0])

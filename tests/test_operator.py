@@ -1,0 +1,218 @@
+"""Operator forward checks vs numpy oracle + finite-difference gradients
+(reference: tests/python/unittest/test_operator.py + check_numeric_gradient)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _fd_grad(fn, x, eps=1e-3):
+    """Central finite differences of scalar-valued fn at x (numpy)."""
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        g[i] = (fn(xp) - fn(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+@pytest.mark.parametrize("name,npfn", [
+    ("exp", np.exp), ("log", lambda x: np.log(np.abs(x) + 1)), ("tanh", np.tanh),
+    ("sqrt", lambda x: np.sqrt(np.abs(x))), ("square", np.square),
+    ("abs", np.abs), ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+])
+def test_unary(name, npfn):
+    x = np.random.randn(3, 4).astype(np.float32)
+    if name in ("log",):
+        arg = np.abs(x) + 1
+    elif name == "sqrt":
+        arg = np.abs(x)
+    else:
+        arg = x
+    out = getattr(nd, name)(nd.array(arg)).asnumpy()
+    np.testing.assert_allclose(out, npfn(x) if name not in ("log", "sqrt") else npfn(x), rtol=1e-5, atol=1e-6)
+
+
+def test_broadcast_binary():
+    a = np.random.rand(3, 1, 4).astype(np.float32)
+    b = np.random.rand(1, 5, 4).astype(np.float32)
+    np.testing.assert_allclose(nd.broadcast_add(nd.array(a), nd.array(b)).asnumpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose(nd.broadcast_mul(nd.array(a), nd.array(b)).asnumpy(), a * b, rtol=1e-6)
+    np.testing.assert_allclose(nd.broadcast_maximum(nd.array(a), nd.array(b)).asnumpy(), np.maximum(a, b))
+
+
+def test_dot_variants():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    np.testing.assert_allclose(nd.dot(nd.array(a), nd.array(b)).asnumpy(), a @ b, rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a.T), nd.array(b), transpose_a=True).asnumpy(), a @ b, rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(a), nd.array(b.T), transpose_b=True).asnumpy(), a @ b, rtol=1e-5)
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    y = np.random.rand(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(nd.batch_dot(nd.array(x), nd.array(y)).asnumpy(), x @ y, rtol=1e-5)
+
+
+def test_softmax_family():
+    x = np.random.randn(4, 7).astype(np.float32)
+    sm = nd.softmax(nd.array(x)).asnumpy()
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(sm, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    ls = nd.log_softmax(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(ls, np.log(e / e.sum(-1, keepdims=True)), rtol=1e-4, atol=1e-5)
+
+
+def test_fully_connected():
+    x = np.random.rand(2, 8).astype(np.float32)
+    w = np.random.rand(3, 8).astype(np.float32)
+    b = np.random.rand(3).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=3).asnumpy()
+    np.testing.assert_allclose(out, x @ w.T + b, rtol=1e-5)
+
+
+def test_convolution_vs_naive():
+    x = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    w = np.random.rand(3, 2, 3, 3).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(3, 3),
+                         num_filter=3, no_bias=True).asnumpy()
+    ref = np.zeros((1, 3, 3, 3), np.float32)
+    for o in range(3):
+        for i in range(3):
+            for j in range(3):
+                ref[0, o, i, j] = (x[0, :, i:i + 3, j:j + 3] * w[o]).sum()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    mx_out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max").asnumpy()
+    np.testing.assert_allclose(mx_out, [[[[5, 7], [13, 15]]]])
+    avg = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg").asnumpy()
+    np.testing.assert_allclose(avg, [[[[2.5, 4.5], [10.5, 12.5]]]])
+    g = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg").asnumpy()
+    np.testing.assert_allclose(g, [[[[7.5]]]])
+
+
+def test_batchnorm_layernorm():
+    x = np.random.rand(4, 3, 2, 2).astype(np.float32)
+    gamma, beta = np.ones(3, np.float32), np.zeros(3, np.float32)
+    mean, var = np.zeros(3, np.float32), np.ones(3, np.float32)
+    out, bm, bv = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                               nd.array(mean), nd.array(var), training=True)
+    m = x.mean(axis=(0, 2, 3))
+    v = x.var(axis=(0, 2, 3))
+    ref = (x - m[None, :, None, None]) / np.sqrt(v + 1e-5)[None, :, None, None]
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(bm.asnumpy(), m, rtol=1e-5)
+
+    g2 = np.random.rand(5).astype(np.float32)
+    b2 = np.random.rand(5).astype(np.float32)
+    x2 = np.random.rand(3, 5).astype(np.float32)
+    ln = nd.LayerNorm(nd.array(x2), nd.array(g2), nd.array(b2)).asnumpy()
+    mu = x2.mean(-1, keepdims=True)
+    sd = np.sqrt(x2.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(ln, (x2 - mu) / sd * g2 + b2, rtol=1e-4, atol=1e-5)
+
+
+def test_take_embedding_onehot():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([1, 3, 5])
+    np.testing.assert_allclose(
+        nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4).asnumpy(),
+        w[idx])
+    oh = nd.one_hot(nd.array(idx), depth=10).asnumpy()
+    assert oh.shape == (3, 10)
+    assert (oh.argmax(-1) == idx).all()
+    t = nd.take(nd.array(w), nd.array(idx), axis=0).asnumpy()
+    np.testing.assert_allclose(t, w[idx])
+
+
+def test_topk_sort():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+    idx = nd.topk(nd.array(x), k=2).asnumpy()
+    np.testing.assert_allclose(idx, [[0, 2], [1, 2]])
+    vals = nd.topk(nd.array(x), k=2, ret_typ="value").asnumpy()
+    np.testing.assert_allclose(vals, [[3, 2], [5, 4]])
+    np.testing.assert_allclose(nd.sort(nd.array(x)).asnumpy(), np.sort(x))
+
+
+def test_reduce_safe_accumulation_bf16():
+    x = nd.full((1000,), 1.0, dtype="bfloat16")
+    # naive bf16 accumulation loses precision well below 1000; f32 accumulate
+    assert abs(float(nd.sum(x).astype("float32").asnumpy()) - 1000.0) < 16
+
+
+def test_pick():
+    x = np.random.rand(4, 6).astype(np.float32)
+    idx = np.array([0, 2, 5, 1])
+    out = nd.pick(nd.array(x), nd.array(idx), axis=1).asnumpy()
+    np.testing.assert_allclose(out, x[np.arange(4), idx])
+
+
+def test_optimizer_ops():
+    from mxnet_tpu.ops import optimizer_ops as oo
+
+    w = np.random.rand(5).astype(np.float32)
+    g = np.random.rand(5).astype(np.float32)
+    new_w = np.asarray(oo.sgd_update(w, g, lr=0.1, wd=0.0, rescale_grad=1.0))
+    np.testing.assert_allclose(new_w, w - 0.1 * g, rtol=1e-6)
+
+    mom = np.zeros(5, np.float32)
+    w2, m2 = oo.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(np.asarray(w2), w - 0.1 * g, rtol=1e-6)
+
+    mean = np.zeros(5, np.float32)
+    var = np.zeros(5, np.float32)
+    w3, m3, v3 = oo.adam_update(w, g, mean, var, lr=0.01)
+    assert np.isfinite(np.asarray(w3)).all()
+
+
+def test_rnn_op_lstm_shapes():
+    T, B, C, H, L = 3, 2, 4, 5, 1
+    ng = 4
+    psize = ng * H * C + ng * H * H + 2 * ng * H
+    params = np.random.randn(psize).astype(np.float32) * 0.1
+    x = np.random.randn(T, B, C).astype(np.float32)
+    h0 = np.zeros((L, B, H), np.float32)
+    out, hn, cn = nd.RNN(nd.array(x), nd.array(params), nd.array(h0), nd.array(h0),
+                         state_size=H, num_layers=L, mode="lstm")
+    assert out.shape == (T, B, H)
+    assert hn.shape == (L, B, H)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_random_ops_reproducible():
+    mx.random.seed(7)
+    a = nd.random.uniform(shape=(4,)).asnumpy()
+    mx.random.seed(7)
+    b = nd.random.uniform(shape=(4,)).asnumpy()
+    np.testing.assert_allclose(a, b)
+    c = nd.random.normal(loc=1.0, scale=0.0, shape=(3,)).asnumpy()
+    np.testing.assert_allclose(c, np.ones(3), atol=1e-6)
+
+
+def test_attention_interleaved_matches_reference_shape():
+    T, B, H, Ch = 4, 2, 3, 8
+    qkv = np.random.randn(T, B, H * 3 * Ch).astype(np.float32)
+    scores = nd._contrib_interleaved_matmul_selfatt_qk(nd.array(qkv), heads=H)
+    assert scores.shape == (B * H, T, T)
+    att = nd.softmax(scores, axis=-1)
+    out = nd._contrib_interleaved_matmul_selfatt_valatt(nd.array(qkv), att, heads=H)
+    assert out.shape == (T, B, H * Ch)
+    # oracle: explicit attention
+    x = qkv.reshape(T, B, H, 3, Ch)
+    q, k, v = x[..., 0, :], x[..., 1, :], x[..., 2, :]
+    q = q.transpose(1, 2, 0, 3) / np.sqrt(Ch)
+    k = k.transpose(1, 2, 0, 3)
+    v = v.transpose(1, 2, 0, 3)
+    s = q @ k.transpose(0, 1, 3, 2)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = (p @ v).transpose(2, 0, 1, 3).reshape(T, B, H * Ch)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,87 @@
+"""M1 gate (SURVEY §7): LeNet on MNIST via HybridSequential, hybridized,
+matching eager loss curves — driver config #1 shape.
+(reference analog: tests/python/train/test_conv.py)"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.data.vision import MNIST
+
+
+def _lenet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(6, 5, padding=2, activation="tanh"),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(16, 5, activation="tanh"),
+            nn.MaxPool2D(2, 2),
+            nn.Flatten(),
+            nn.Dense(120, activation="tanh"),
+            nn.Dense(84, activation="tanh"),
+            nn.Dense(10))
+    return net
+
+
+def test_lenet_mnist_end_to_end():
+    mx.random.seed(0)
+    train = MNIST(train=True)  # synthetic fallback, weakly learnable
+    loader = gluon.data.DataLoader(
+        train.transform_first(lambda d: d.astype("float32") / 255.0),
+        batch_size=64, shuffle=True)
+
+    net = _lenet()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    losses = []
+    steps = 0
+    for epoch in range(2):
+        for data, label in loader:
+            x = data.transpose((0, 3, 1, 2))  # HWC->CHW
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update(label, out)
+            losses.append(float(loss.mean().asnumpy()))
+            steps += 1
+            if steps >= 60:
+                break
+        if steps >= 60:
+            break
+
+    name, acc = metric.get()
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses[0]} -> {losses[-1]}"
+    assert acc > 0.15, f"accuracy {acc} no better than chance"
+
+
+def test_lenet_hybrid_eager_loss_parity():
+    """First training losses must match between eager and hybridized nets
+    when params and data are identical."""
+    def run(hybrid):
+        mx.random.seed(1)
+        net = _lenet()
+        net.initialize(mx.init.Xavier())
+        x = nd.array(np.random.RandomState(0).rand(8, 1, 28, 28).astype(np.float32))
+        y = nd.array(np.arange(8) % 10)
+        if hybrid:
+            net.hybridize()
+        _ = net(x)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+        out = []
+        for _ in range(3):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(8)
+            out.append(float(loss.mean().asnumpy()))
+        return out
+
+    eager = run(False)
+    hybrid = run(True)
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-4, atol=1e-5)
