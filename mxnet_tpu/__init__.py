@@ -32,3 +32,4 @@ from . import symbol as sym  # noqa: F401
 from .util import is_np_array  # noqa: F401
 
 from .attribute import AttrScope  # noqa: F401
+from . import models  # noqa: F401

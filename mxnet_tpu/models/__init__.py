@@ -1,0 +1,14 @@
+"""Model families matching the reference's acceptance configs (BASELINE.md):
+
+  #1 LeNet/MNIST       -> gluon.model_zoo.vision.lenet
+  #2 ResNet-50/ImageNet -> gluon.model_zoo.vision.resnet
+  #3 BERT base/large    -> models.bert       (GluonNLP scripts/bert shape)
+  #4 Transformer WMT    -> models.transformer (GluonNLP machine_translation)
+  #5 GPT-2 345M         -> models.gpt2
+"""
+from . import bert  # noqa: F401
+from . import gpt2  # noqa: F401
+from . import transformer  # noqa: F401
+from .bert import BERTModel, BERTForPretrain, get_bert  # noqa: F401
+from .gpt2 import GPT2Model, get_gpt2  # noqa: F401
+from .transformer import Transformer, get_transformer  # noqa: F401

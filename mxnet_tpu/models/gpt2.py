@@ -1,0 +1,100 @@
+"""GPT-2 (driver config #5: 345M, multi-host data parallel).
+
+Decoder-only transformer with causal flash attention. Sizes follow the
+published GPT-2 family; 345M == ``gpt2_medium``. Pre-LN blocks (as GPT-2).
+Parameter names carry the TP sharding markers (qkv_/proj_/ffn1_/ffn2_).
+"""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .. import initializer as init
+
+__all__ = ["GPT2Model", "get_gpt2", "gpt2_configs", "lm_loss"]
+
+gpt2_configs = {
+    "gpt2_tiny": dict(num_layers=2, units=128, num_heads=2, max_length=512,
+                      vocab_size=50257),
+    "gpt2_117m": dict(num_layers=12, units=768, num_heads=12, max_length=1024,
+                      vocab_size=50257),
+    "gpt2_345m": dict(num_layers=24, units=1024, num_heads=16, max_length=1024,
+                      vocab_size=50257),
+    "gpt2_774m": dict(num_layers=36, units=1280, num_heads=20, max_length=1024,
+                      vocab_size=50257),
+}
+
+
+class GPT2Block(HybridBlock):
+    def __init__(self, units, num_heads, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._heads = num_heads
+        with self.name_scope():
+            self.ln1 = nn.LayerNorm(in_channels=units, prefix="ln1_")
+            self.qkv = nn.Dense(3 * units, flatten=False, prefix="qkv_",
+                                weight_initializer=init.Normal(0.02))
+            self.proj = nn.Dense(units, flatten=False, prefix="proj_",
+                                 weight_initializer=init.Normal(0.02))
+            self.ln2 = nn.LayerNorm(in_channels=units, prefix="ln2_")
+            self.ffn1 = nn.Dense(4 * units, flatten=False, prefix="ffn1_",
+                                 weight_initializer=init.Normal(0.02))
+            self.ffn2 = nn.Dense(units, flatten=False, prefix="ffn2_",
+                                 weight_initializer=init.Normal(0.02))
+            self.drop = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x):
+        b, t, c = x.shape
+        h = self._heads
+        y = self.ln1(x)
+        qkv = self.qkv(y).reshape((b, t, 3, h, c // h)).transpose((2, 0, 3, 1, 4))
+        att = F.multi_head_attention(qkv[0], qkv[1], qkv[2], causal=True)
+        att = att.transpose((0, 2, 1, 3)).reshape((b, t, c))
+        x = x + self.drop(self.proj(att))
+        y = self.ffn2(F.Activation(self.ffn1(self.ln2(x)), act_type="tanh_gelu"))
+        return x + self.drop(y)
+
+
+class GPT2Model(HybridBlock):
+    def __init__(self, num_layers=12, units=768, num_heads=12, max_length=1024,
+                 vocab_size=50257, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        with self.name_scope():
+            self.word_embed = nn.Embedding(vocab_size, units, prefix="word_embed_",
+                                           weight_initializer=init.Normal(0.02))
+            self.position_embed = nn.Embedding(max_length, units,
+                                               prefix="position_embed_",
+                                               weight_initializer=init.Normal(0.01))
+            self.drop = nn.Dropout(dropout)
+            self.blocks = nn.HybridSequential(prefix="")
+            for i in range(num_layers):
+                self.blocks.add(GPT2Block(units, num_heads, dropout,
+                                          prefix=f"layer{i}_"))
+            self.ln_f = nn.LayerNorm(in_channels=units, prefix="lnf_")
+
+    def hybrid_forward(self, F, token_ids):
+        b, t = token_ids.shape
+        pos = F.arange(0, t, dtype="int32")
+        x = self.drop(self.word_embed(token_ids) + self.position_embed(pos))
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        # weight-tied LM head (GPT-2 ties input/output embeddings)
+        logits = F.dot(x.reshape((b * t, self._units)),
+                       self.word_embed.weight.data(), transpose_b=True)
+        return logits.reshape((b, t, -1))
+
+
+def get_gpt2(model_name="gpt2_345m", dropout=0.1, **overrides):
+    cfg = dict(gpt2_configs[model_name])
+    cfg.update(overrides)
+    return GPT2Model(dropout=dropout, **cfg)
+
+
+def lm_loss(logits, labels):
+    """Next-token cross entropy; labels = input shifted by caller."""
+    from .. import ndarray as nd
+
+    b, t, v = logits.shape
+    logp = nd.log_softmax(logits, axis=-1)
+    ll = nd.pick(logp.reshape((b * t, v)), labels.reshape((b * t,)), axis=-1)
+    return -ll.mean()

@@ -1,0 +1,177 @@
+"""Transformer encoder-decoder (GluonNLP ``machine_translation`` / WMT En-De
+shape — driver config #4). Vaswani-style post-LN base/big configs.
+
+Cross-attention uses the einsum path (ragged q/kv lengths); self-attention
+dispatches to flash when tile-friendly.
+"""
+from __future__ import annotations
+
+import math
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from .. import initializer as init
+
+__all__ = ["Transformer", "get_transformer", "transformer_configs", "label_smoothing_loss"]
+
+transformer_configs = {
+    "transformer_tiny": dict(num_layers=2, units=64, hidden_size=128, num_heads=2,
+                             vocab_size=32000, max_length=256),
+    "transformer_base": dict(num_layers=6, units=512, hidden_size=2048, num_heads=8,
+                             vocab_size=36500, max_length=1024),
+    "transformer_big": dict(num_layers=6, units=1024, hidden_size=4096, num_heads=16,
+                            vocab_size=36500, max_length=1024),
+}
+
+
+class MultiHeadAttention(HybridBlock):
+    def __init__(self, units, num_heads, dropout=0.1, self_attn=True, **kwargs):
+        super().__init__(**kwargs)
+        self._heads = num_heads
+        self._self = self_attn
+        with self.name_scope():
+            if self_attn:
+                self.qkv = nn.Dense(3 * units, flatten=False, prefix="qkv_",
+                                    weight_initializer=init.Xavier())
+            else:
+                self.q_proj = nn.Dense(units, flatten=False, prefix="query_",
+                                       weight_initializer=init.Xavier())
+                self.kv_proj = nn.Dense(2 * units, flatten=False, prefix="key_",
+                                        weight_initializer=init.Xavier())
+            self.proj = nn.Dense(units, flatten=False, prefix="proj_",
+                                 weight_initializer=init.Xavier())
+            self.drop = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x, mem=None, mask=None, causal=False):
+        b, t, c = x.shape
+        h = self._heads
+        if self._self:
+            qkv = self.qkv(x).reshape((b, t, 3, h, c // h)).transpose((2, 0, 3, 1, 4))
+            q, k, v = qkv[0], qkv[1], qkv[2]
+        else:
+            tk = mem.shape[1]
+            q = self.q_proj(x).reshape((b, t, h, c // h)).transpose((0, 2, 1, 3))
+            kv = self.kv_proj(mem).reshape((b, tk, 2, h, c // h)).transpose((2, 0, 3, 1, 4))
+            k, v = kv[0], kv[1]
+        out = F.multi_head_attention(q, k, v, mask=mask, causal=causal)
+        out = out.transpose((0, 2, 1, 3)).reshape((b, t, c))
+        return self.drop(self.proj(out))
+
+
+class _FFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ffn1 = nn.Dense(hidden_size, flatten=False, activation="relu",
+                                 prefix="ffn1_", weight_initializer=init.Xavier())
+            self.ffn2 = nn.Dense(units, flatten=False, prefix="ffn2_",
+                                 weight_initializer=init.Xavier())
+            self.drop = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, x):
+        return self.drop(self.ffn2(self.ffn1(x)))
+
+
+class EncoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attn = MultiHeadAttention(units, num_heads, dropout, prefix="attn_")
+            self.ln1 = nn.LayerNorm(in_channels=units, prefix="ln1_")
+            self.ffn = _FFN(units, hidden_size, dropout, prefix="ffn_")
+            self.ln2 = nn.LayerNorm(in_channels=units, prefix="ln2_")
+
+    def hybrid_forward(self, F, x, mask=None):
+        x = self.ln1(x + self.attn(x, mask=mask))
+        return self.ln2(x + self.ffn(x))
+
+
+class DecoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.self_attn = MultiHeadAttention(units, num_heads, dropout, prefix="sattn_")
+            self.ln1 = nn.LayerNorm(in_channels=units, prefix="ln1_")
+            self.cross_attn = MultiHeadAttention(units, num_heads, dropout,
+                                                 self_attn=False, prefix="cattn_")
+            self.ln2 = nn.LayerNorm(in_channels=units, prefix="ln2_")
+            self.ffn = _FFN(units, hidden_size, dropout, prefix="ffn_")
+            self.ln3 = nn.LayerNorm(in_channels=units, prefix="ln3_")
+
+    def hybrid_forward(self, F, x, mem, mem_mask=None):
+        x = self.ln1(x + self.self_attn(x, causal=True))
+        x = self.ln2(x + self.cross_attn(x, mem=mem, mask=mem_mask))
+        return self.ln3(x + self.ffn(x))
+
+
+class Transformer(HybridBlock):
+    def __init__(self, num_layers=6, units=512, hidden_size=2048, num_heads=8,
+                 vocab_size=36500, max_length=1024, dropout=0.1,
+                 shared_embed=True, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        with self.name_scope():
+            self.src_embed = nn.Embedding(vocab_size, units, prefix="word_embed_",
+                                          weight_initializer=init.Normal(units ** -0.5))
+            self.tgt_embed = self.src_embed if shared_embed else nn.Embedding(
+                vocab_size, units, prefix="tgt_embed_",
+                weight_initializer=init.Normal(units ** -0.5))
+            self.pos_embed = nn.Embedding(max_length, units, prefix="pos_embed_",
+                                          weight_initializer=init.Normal(0.02))
+            self.drop = nn.Dropout(dropout)
+            self.enc_layers = nn.HybridSequential(prefix="")
+            for i in range(num_layers):
+                self.enc_layers.add(EncoderLayer(units, hidden_size, num_heads,
+                                                 dropout, prefix=f"enc{i}_"))
+            self.dec_layers = nn.HybridSequential(prefix="")
+            for i in range(num_layers):
+                self.dec_layers.add(DecoderLayer(units, hidden_size, num_heads,
+                                                 dropout, prefix=f"dec{i}_"))
+            self.out_proj = nn.Dense(vocab_size, flatten=False, prefix="outproj_",
+                                     weight_initializer=init.Xavier())
+
+    def _embed(self, F, embed, ids):
+        b, t = ids.shape
+        pos = F.arange(0, t, dtype="int32")
+        scale = math.sqrt(self._units)
+        return self.drop(embed(ids) * scale + self.pos_embed(pos))
+
+    def encode(self, F, src_ids, src_valid=None):
+        x = self._embed(F, self.src_embed, src_ids)
+        mask = None
+        if src_valid is not None:
+            b, t = src_ids.shape
+            steps = F.arange(0, t, dtype="int32")
+            mask = (steps.reshape((1, 1, 1, t)) <
+                    src_valid.astype("int32").reshape((b, 1, 1, 1)))
+        for layer in self.enc_layers:
+            x = layer(x, mask)
+        return x, mask
+
+    def hybrid_forward(self, F, src_ids, tgt_ids, src_valid=None):
+        mem, mem_mask = self.encode(F, src_ids, src_valid)
+        y = self._embed(F, self.tgt_embed, tgt_ids)
+        for layer in self.dec_layers:
+            y = layer(y, mem, mem_mask)
+        return self.out_proj(y)
+
+
+def get_transformer(model_name="transformer_base", dropout=0.1, **overrides):
+    cfg = dict(transformer_configs[model_name])
+    cfg.update(overrides)
+    return Transformer(dropout=dropout, **cfg)
+
+
+def label_smoothing_loss(logits, labels, epsilon=0.1, ignore_index=0):
+    """WMT training loss: label-smoothed cross entropy with padding mask."""
+    from .. import ndarray as nd
+
+    b, t, v = logits.shape
+    logp = nd.log_softmax(logits, axis=-1)
+    flat = logp.reshape((b * t, v))
+    lab = labels.reshape((b * t,))
+    nll = -nd.pick(flat, lab, axis=-1)
+    smooth = -flat.mean(axis=-1)
+    loss = (1 - epsilon) * nll + epsilon * smooth
+    mask = (lab != ignore_index)
+    return (loss * mask).sum() / (mask.sum() + 1e-6)

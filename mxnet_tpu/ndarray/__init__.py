@@ -343,7 +343,9 @@ def _raw_index(key):
 def invoke(opdef, args, kwargs):
     arr_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
     raw_args = [_raw(a) for a in args]
-    kwargs = dict(kwargs)
+    # NDArray kwargs (masks etc.) are unwrapped but not taped — gradients flow
+    # through positional args only, like the reference's input/attr split
+    kwargs = {k: _raw(v) for k, v in kwargs.items()}
     if opdef.stochastic and kwargs.get("key") is None:
         kwargs["key"] = _rng.next_key()
 

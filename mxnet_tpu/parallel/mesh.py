@@ -46,6 +46,8 @@ class MeshConfig:
 def make_mesh(config: Optional[MeshConfig] = None, devices=None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     config = config or MeshConfig(dp=len(devices))
+    if config.total < len(devices):
+        devices = devices[: config.total]
     if config.total != len(devices):
         raise ValueError(f"mesh {config} needs {config.total} devices, "
                          f"got {len(devices)}")

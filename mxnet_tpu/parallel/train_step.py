@@ -43,10 +43,11 @@ class TrainStep:
 
     def __init__(self, net, loss_fn, optimizer, mesh: Optional[Mesh] = None,
                  rules: Optional[ShardingRules] = None, batch_spec=None,
-                 donate: bool = True):
+                 donate: bool = True, n_model_inputs: int = 1):
         self.net = net
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self.n_model_inputs = n_model_inputs
         self.mesh = mesh
         self.rules = rules or ShardingRules()
         self.donate = donate
@@ -86,10 +87,11 @@ class TrainStep:
     # -- functional loss -----------------------------------------------------
     def _loss_of(self, params: Dict[str, jax.Array], batch, key):
         raws = [params[p.name] for p in self._plist]
+        n = self.n_model_inputs
         with _HybridTrace(self._plist, raws, True, key):
             nd_batch = [NDArray(b) for b in batch]
-            out = self.net(nd_batch[0])
-            loss = self.loss_fn(out, *nd_batch[1:])
+            out = self.net(*nd_batch[:n])
+            loss = self.loss_fn(out, *nd_batch[n:])
         raw = loss._data if isinstance(loss, NDArray) else loss
         return jnp.mean(raw.astype(jnp.float32))
 

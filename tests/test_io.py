@@ -1,0 +1,117 @@
+"""Data pipeline: DataLoader, NDArrayIter, RecordIO wire format
+(reference: tests/python/unittest/test_io.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.io import NDArrayIter, MXRecordIO, IndexedRecordIO
+from mxnet_tpu.io.recordio import IRHeader, pack, unpack, pack_img, unpack_img
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(20).reshape(10, 2).astype(np.float32)
+    label = np.arange(10).astype(np.float32)
+    it = NDArrayIter(data, label, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 2)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_ndarray_iter_discard():
+    it = NDArrayIter(np.zeros((10, 2)), np.zeros(10), batch_size=4,
+                     last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_dataloader_batching_and_shuffle():
+    ds = gluon.data.ArrayDataset(np.arange(10).astype(np.float32),
+                                 np.arange(10).astype(np.float32))
+    loader = gluon.data.DataLoader(ds, batch_size=3, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 4
+    np.testing.assert_allclose(batches[0][0].asnumpy(), [0, 1, 2])
+
+    loader2 = gluon.data.DataLoader(ds, batch_size=5, shuffle=True, last_batch="discard")
+    batches2 = list(loader2)
+    assert len(batches2) == 2
+
+
+def test_dataloader_transform():
+    ds = gluon.data.ArrayDataset(np.ones((6, 2), np.float32))
+    ds2 = ds.transform(lambda x: x * 2)
+    loader = gluon.data.DataLoader(ds2, batch_size=2)
+    for (b,) in [(b,) for b in loader]:
+        np.testing.assert_allclose(b.asnumpy(), np.full((2, 2), 2.0))
+
+
+def test_recordio_roundtrip(tmp_path):
+    f = str(tmp_path / "x.rec")
+    w = MXRecordIO(f, "w")
+    records = [b"hello", b"x" * 1000, b"", b"abc" * 7]
+    for r in records:
+        w.write(r)
+    w.close()
+    r = MXRecordIO(f, "r")
+    out = []
+    while True:
+        item = r.read()
+        if item is None:
+            break
+        out.append(item)
+    assert out == records
+
+
+def test_indexed_recordio(tmp_path):
+    f = str(tmp_path / "y.rec")
+    idx = str(tmp_path / "y.idx")
+    w = IndexedRecordIO(idx, f, "w")
+    for i in range(5):
+        w.write_idx(i, f"rec{i}".encode())
+    w.close()
+    r = IndexedRecordIO(idx, f, "r")
+    assert r.read_idx(3) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+    assert len(r.keys) == 5
+
+
+def test_pack_unpack_header():
+    h = IRHeader(0, 3.0, 7, 0)
+    s = pack(h, b"payload")
+    h2, data = unpack(s)
+    assert h2.label == 3.0 and h2.id == 7 and data == b"payload"
+    # vector label
+    hv = IRHeader(0, np.array([1.0, 2.0], np.float32), 1, 0)
+    s = pack(hv, b"p2")
+    h3, d3 = unpack(s)
+    np.testing.assert_allclose(h3.label, [1.0, 2.0])
+
+
+def test_pack_img_roundtrip():
+    img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+    s = pack_img(IRHeader(0, 1.0, 0, 0), img)
+    h, img2 = unpack_img(s)
+    np.testing.assert_array_equal(img, img2)
+
+
+def test_vision_datasets_synthetic():
+    ds = gluon.data.vision.MNIST(train=True)
+    x, y = ds[0]
+    assert x.shape == (28, 28, 1)
+    assert 0 <= int(y) < 10
+    c = gluon.data.vision.CIFAR10(train=False)
+    x, y = c[5]
+    assert x.shape == (32, 32, 3)
+
+
+def test_prefetching_iter():
+    from mxnet_tpu.io import PrefetchingIter
+
+    base = NDArrayIter(np.zeros((8, 2)), np.zeros(8), batch_size=4)
+    pf = PrefetchingIter(base)
+    n = 0
+    for _ in pf:
+        n += 1
+    assert n == 2

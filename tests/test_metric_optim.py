@@ -1,0 +1,103 @@
+"""Metric registry + optimizer semantics + lr schedulers
+(reference: test_metric.py, test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_accuracy_and_topk():
+    acc = mx.metric.Accuracy()
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1, 0, 0])
+    acc.update(label, pred)
+    assert abs(acc.get()[1] - 2 / 3) < 1e-6
+    topk = mx.metric.TopKAccuracy(top_k=2)
+    topk.update(nd.array([0]), nd.array([[0.3, 0.2, 0.5]]))  # 0 is 2nd-best
+    assert topk.get()[1] == 1.0
+    topk.update(nd.array([1]), nd.array([[0.3, 0.2, 0.5]]))  # 1 is worst
+    assert topk.get()[1] == 0.5
+
+
+def test_mse_rmse_mae():
+    for name, val in (("mse", 4.0), ("rmse", 2.0), ("mae", 2.0)):
+        m = mx.metric.create(name)
+        m.update(nd.full((2, 2), 3.0), nd.full((2, 2), 1.0))
+        assert abs(m.get()[1] - val) < 1e-6, name
+
+
+def test_perplexity():
+    m = mx.metric.Perplexity()
+    pred = nd.array([[0.5, 0.5], [0.25, 0.75]])
+    label = nd.array([0, 1])
+    m.update(label, pred)
+    expected = np.exp(-(np.log(0.5) + np.log(0.75)) / 2)
+    assert abs(m.get()[1] - expected) < 1e-4
+
+
+def test_composite_metric():
+    comp = mx.metric.CompositeEvalMetric(["acc", "ce"])
+    comp.update(nd.array([1]), nd.array([[0.2, 0.8]]))
+    names, vals = comp.get()
+    assert len(names) == 2
+
+
+def test_optimizer_sgd_momentum_semantics():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    w = nd.ones((3,))
+    g = nd.ones((3,))
+    state = opt.create_state(0, w)
+    state = opt.update(0, w, g, state)
+    np.testing.assert_allclose(w.asnumpy(), np.full(3, 0.9), rtol=1e-6)
+    state = opt.update(0, w, g, state)
+    # mom = 0.9*(-0.1) - 0.1 = -0.19 -> w = 0.9 - 0.19
+    np.testing.assert_allclose(w.asnumpy(), np.full(3, 0.71), rtol=1e-5)
+
+
+def test_optimizer_wd_and_clip():
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.1, clip_gradient=0.5)
+    w = nd.ones((2,))
+    g = nd.full((2,), 10.0)  # clipped to 0.5
+    opt.update(0, w, g, opt.create_state(0, w))
+    # g_eff = 0.5 + 0.1*1 = 0.6 -> w = 1 - 0.06
+    np.testing.assert_allclose(w.asnumpy(), np.full(2, 0.94), rtol=1e-5)
+
+
+def test_lr_schedulers():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert abs(float(s(5)) - 1.0) < 1e-6
+    assert abs(float(s(15)) - 0.5) < 1e-6
+    c = mx.lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert abs(float(c(0)) - 1.0) < 1e-6
+    assert abs(float(c(100)) - 0.0) < 1e-6
+    assert 0.4 < float(c(50)) < 0.6
+    w = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, warmup_steps=10)
+    assert float(w(5)) < 1.0  # warming up
+
+
+def test_lamb_runs():
+    opt = mx.optimizer.LAMB(learning_rate=1e-3)
+    w = nd.array(np.random.rand(10).astype(np.float32))
+    g = nd.array(np.random.rand(10).astype(np.float32))
+    s = opt.create_state(0, w)
+    s = opt.update(0, w, g, s)
+    assert np.isfinite(w.asnumpy()).all()
+
+
+def test_trainer_lr_scheduler_integration():
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.1, base_lr=1.0)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 1.0, "lr_scheduler": sched})
+    x = nd.ones((1, 1))
+    for i in range(4):
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        tr.step(1)
+    assert abs(tr.learning_rate - 0.01) < 1e-6  # 4 updates, step=2 -> factor^2

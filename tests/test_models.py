@@ -1,0 +1,102 @@
+"""Model family forward/train smoke (tiny configs; full sizes run on TPU via
+bench.py). Covers driver configs #3/#4/#5 shapes."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models import bert, gpt2, transformer
+
+
+def test_bert_tiny_forward_and_pretrain_loss():
+    net = bert.get_bert("bert_tiny", pretrain_head=True, vocab_size=1000)
+    net.initialize()
+    B, T, M = 2, 16, 4
+    ids = nd.array(np.random.randint(0, 1000, (B, T)), dtype="int32")
+    types = nd.zeros((B, T), dtype="int32")
+    valid = nd.array([16, 12], dtype="int32")
+    pos = nd.array(np.random.randint(0, T, (B, M)), dtype="int32")
+    mlm, nsp = net(ids, types, valid, pos)
+    assert mlm.shape == (B, M, 1000)
+    assert nsp.shape == (B, 2)
+    labels = nd.array(np.random.randint(0, 1000, (B, M)), dtype="int32")
+    weights = nd.ones((B, M))
+    nsp_labels = nd.array([0, 1], dtype="int32")
+    loss = bert.pretrain_loss(mlm, nsp, labels, weights, nsp_labels)
+    assert np.isfinite(float(loss.asnumpy()))
+
+
+def test_bert_tiny_train_step_decreases_loss():
+    net = bert.get_bert("bert_tiny", pretrain_head=True, vocab_size=200)
+    net.initialize()
+    net.hybridize()
+    B, T, M = 4, 16, 4
+    rs = np.random.RandomState(0)
+    ids = nd.array(rs.randint(0, 200, (B, T)), dtype="int32")
+    types = nd.zeros((B, T), dtype="int32")
+    valid = nd.full((B,), T, dtype="int32")
+    pos = nd.array(rs.randint(0, T, (B, M)), dtype="int32")
+    labels = nd.array(rs.randint(0, 200, (B, M)), dtype="int32")
+    weights = nd.ones((B, M))
+    nsp_labels = nd.array(rs.randint(0, 2, (B,)), dtype="int32")
+
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            mlm, nsp = net(ids, types, valid, pos)
+            loss = bert.pretrain_loss(mlm, nsp, labels, weights, nsp_labels)
+        loss.backward()
+        trainer.step(B)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0], losses
+
+
+def test_gpt2_tiny_forward_and_loss():
+    net = gpt2.get_gpt2("gpt2_tiny", vocab_size=500)
+    net.initialize()
+    B, T = 2, 32
+    ids = nd.array(np.random.randint(0, 500, (B, T)), dtype="int32")
+    logits = net(ids)
+    assert logits.shape == (B, T, 500)
+    loss = gpt2.lm_loss(logits, ids)
+    assert np.isfinite(float(loss.asnumpy()))
+
+
+def test_gpt2_causality():
+    """Changing a future token must not affect past logits."""
+    net = gpt2.get_gpt2("gpt2_tiny", vocab_size=100, dropout=0.0)
+    net.initialize()
+    ids1 = np.random.randint(0, 100, (1, 8))
+    ids2 = ids1.copy()
+    ids2[0, -1] = (ids2[0, -1] + 1) % 100
+    l1 = net(nd.array(ids1, dtype="int32")).asnumpy()
+    l2 = net(nd.array(ids2, dtype="int32")).asnumpy()
+    np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], rtol=1e-4, atol=1e-5)
+    assert np.abs(l1[0, -1] - l2[0, -1]).max() > 1e-6
+
+
+def test_transformer_tiny_forward_and_loss():
+    net = transformer.get_transformer("transformer_tiny", vocab_size=300)
+    net.initialize()
+    B, Ts, Tt = 2, 12, 10
+    src = nd.array(np.random.randint(1, 300, (B, Ts)), dtype="int32")
+    tgt = nd.array(np.random.randint(1, 300, (B, Tt)), dtype="int32")
+    valid = nd.array([12, 8], dtype="int32")
+    logits = net(src, tgt, valid)
+    assert logits.shape == (B, Tt, 300)
+    loss = transformer.label_smoothing_loss(logits, tgt)
+    assert np.isfinite(float(loss.asnumpy()))
+
+
+def test_bert_hybridize_equivalence():
+    net = bert.get_bert("bert_tiny", pretrain_head=False, vocab_size=300, dropout=0.0)
+    net.initialize()
+    B, T = 2, 16
+    ids = nd.array(np.random.randint(0, 300, (B, T)), dtype="int32")
+    seq_e, pooled_e = net(ids)
+    net.hybridize()
+    _ = net(ids)
+    seq_h, pooled_h = net(ids)
+    np.testing.assert_allclose(seq_e.asnumpy(), seq_h.asnumpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(pooled_e.asnumpy(), pooled_h.asnumpy(), rtol=1e-4, atol=1e-5)

@@ -1,0 +1,176 @@
+"""Parallelism on the 8-device virtual CPU mesh (SURVEY §4 fixture #5):
+GSPMD train step with dp/tp shardings, ring attention vs dense oracle,
+KVStore facade semantics."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import (MeshConfig, ShardingRules, TrainStep, make_mesh,
+                                ring_attention)
+from mxnet_tpu.parallel.sharding import DEFAULT_BERT_RULES
+
+
+def test_mesh_construction():
+    mesh = make_mesh(MeshConfig(dp=4, tp=2))
+    assert mesh.shape["dp"] == 4 and mesh.shape["tp"] == 2
+    mesh2 = make_mesh(MeshConfig.auto(8, tp=2))
+    assert mesh2.shape["dp"] == 4
+
+
+def test_sharding_rules_tp_patterns():
+    mesh = make_mesh(MeshConfig(dp=4, tp=2))
+    spec = DEFAULT_BERT_RULES.spec_for("bert0_enc_layer3_attn_qkv_weight", (384, 128), mesh)
+    assert spec == P("tp", None)
+    spec = DEFAULT_BERT_RULES.spec_for("bert0_enc_layer3_attn_proj_weight", (128, 128), mesh)
+    assert spec == P(None, "tp")
+    spec = DEFAULT_BERT_RULES.spec_for("bert0_embed_ln_gamma", (128,), mesh)
+    assert spec == P()
+
+
+def test_train_step_dp_matches_single_device():
+    """DP over the mesh must produce the same params as single-device."""
+    def build():
+        mx.random.seed(3)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+        net.initialize()
+        _ = net(nd.ones((8, 8)))
+        return net
+
+    X = np.random.RandomState(0).rand(16, 8).astype(np.float32)
+    Y = np.random.RandomState(1).randint(0, 4, 16)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def loss_of(out, label):
+        return loss_fn(out, label)
+
+    from mxnet_tpu import optimizer as opt
+
+    net1 = build()
+    ts1 = TrainStep(net1, loss_of, opt.SGD(learning_rate=0.1), mesh=None)
+    net2 = build()
+    mesh = make_mesh(MeshConfig(dp=8))
+    ts2 = TrainStep(net2, loss_of, opt.SGD(learning_rate=0.1), mesh=mesh)
+
+    for _ in range(3):
+        l1 = ts1(nd.array(X), nd.array(Y))
+        l2 = ts2(nd.array(X), nd.array(Y))
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    # prefixes differ between builds (global name counters); compare by order
+    for (k1, v1), (k2, v2) in zip(sorted(ts1.params.items()), sorted(ts2.params.items())):
+        np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
+                                   rtol=1e-4, atol=1e-6, err_msg=f"{k1} vs {k2}")
+
+
+def test_train_step_tp_bert_tiny():
+    """TP-sharded BERT step must run and produce finite loss with params
+    actually sharded across tp."""
+    from mxnet_tpu.models import bert
+
+    mx.random.seed(0)
+    net = bert.get_bert("bert_tiny", pretrain_head=False, vocab_size=512)
+    net.initialize()
+    B, T = 8, 16
+    ids = nd.array(np.random.randint(0, 512, (B, T)), dtype="int32")
+    _ = net(ids)
+
+    mesh = make_mesh(MeshConfig(dp=4, tp=2))
+
+    def loss_of(out):
+        seq, pooled = out
+        return (seq * seq).mean() + (pooled * pooled).mean()
+
+    from mxnet_tpu import optimizer as opt
+
+    ts = TrainStep(net, lambda out: loss_of(out), opt.Adam(learning_rate=1e-3),
+                   mesh=mesh, rules=DEFAULT_BERT_RULES)
+    qkv_names = [k for k in ts.params if "qkv_weight" in k]
+    assert qkv_names
+    sh = ts.params[qkv_names[0]].sharding
+    assert "tp" in str(sh.spec), f"qkv weight not tp-sharded: {sh.spec}"
+    loss = ts(ids)
+    assert np.isfinite(float(loss))
+    loss2 = ts(ids)
+    assert float(loss2) < float(loss)  # deterministic batch: loss must drop
+    ts.sync()  # write back to gluon params without error
+
+
+def test_ring_attention_matches_dense():
+    mesh = make_mesh(MeshConfig(sp=8))
+    B, H, T, D = 2, 2, 64, 16
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+
+    def dense(q, k, v, causal):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        if causal:
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    with mesh:
+        out = ring_attention.ring_attention(q, k, v, mesh, axis="sp", causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense(q, k, v, False)),
+                               rtol=1e-4, atol=1e-5)
+
+    with mesh:
+        out_c = ring_attention.ring_attention(q, k, v, mesh, axis="sp", causal=True)
+    np.testing.assert_allclose(np.asarray(out_c), np.asarray(dense(q, k, v, True)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_grad_finite():
+    mesh = make_mesh(MeshConfig(sp=4))
+    B, H, T, D = 1, 2, 32, 8
+    q = jnp.ones((B, H, T, D), jnp.float32) * 0.1
+
+    def f(q):
+        return ring_attention.ring_attention(q, q, q, mesh, axis="sp", causal=True).sum()
+
+    g = jax.grad(f)(q)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_kvstore_local_push_pull():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones((2, 3)))
+    kv.push(3, nd.full((2, 3), 4.0))
+    out = nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 4.0))
+    # multi-value push aggregates (the reference's multi-device reduce)
+    kv.push(3, [nd.ones((2, 3)), nd.ones((2, 3))])
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 3), 2.0))
+
+
+def test_kvstore_optimizer_on_store():
+    kv = mx.kv.create("device")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv.init("w", nd.ones((4,)))
+    kv.push("w", nd.ones((4,)))  # grad=1 -> w -= 0.5
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.full((4,), 0.5))
+
+
+def test_distributed_trainer_single_process():
+    from mxnet_tpu.parallel import DistributedTrainer, dist_init
+
+    dist_init()
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    tr = DistributedTrainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    x = nd.ones((4, 3))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(4)  # must not raise

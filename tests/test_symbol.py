@@ -1,0 +1,62 @@
+"""Symbol DSL + Executor (reference: tests/python/unittest/test_symbol.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+
+
+def test_compose_and_eval():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a * 2 + b
+    (out,) = c.eval(a=nd.array([1.0, 2.0]), b=nd.array([3.0, 4.0]))
+    np.testing.assert_allclose(out.asnumpy(), [5.0, 8.0])
+
+
+def test_list_arguments_order():
+    x = sym.var("x")
+    w = sym.var("w")
+    y = sym.FullyConnected(x, w, None, num_hidden=3, no_bias=True)
+    assert y.list_arguments() == ["x", "w"]
+
+
+def test_infer_shape():
+    x = sym.var("x")
+    w = sym.var("w")
+    y = sym.FullyConnected(x, w, None, num_hidden=3, no_bias=True)
+    arg_shapes, out_shapes, _ = y.infer_shape(x=(2, 5), w=(3, 5))
+    assert out_shapes[0] == (2, 3)
+
+
+def test_simple_bind_forward_backward():
+    x = sym.var("x")
+    w = sym.var("w")
+    y = sym.FullyConnected(x, w, None, num_hidden=2, no_bias=True)
+    loss = sym.sum(y * y)
+    ex = loss.simple_bind(x=(3, 4), w=(2, 4))
+    ex.arg_dict["x"][:] = 1.0
+    ex.arg_dict["w"][:] = 0.5
+    (out,) = ex.forward(is_train=True)
+    np.testing.assert_allclose(out.asnumpy(), 3 * 2 * (4 * 0.5) ** 2, rtol=1e-5)
+    ex.backward()
+    assert ex.grad_dict["w"].shape == (2, 4)
+    assert np.isfinite(ex.grad_dict["w"].asnumpy()).all()
+
+
+def test_json_roundtrip():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = sym.add(a, b)
+    d = sym.tanh(c)
+    js = d.tojson()
+    d2 = sym.load_json(js)
+    (o1,) = d.eval(a=nd.array([0.3]), b=nd.array([0.2]))
+    (o2,) = d2.eval(a=nd.array([0.3]), b=nd.array([0.2]))
+    np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy())
+
+
+def test_symbol_arithmetic_scalars():
+    a = sym.var("a")
+    b = (a + 1) * 3 / 2 - 0.5
+    (out,) = b.eval(a=nd.array([1.0]))
+    np.testing.assert_allclose(out.asnumpy(), [2.5])
