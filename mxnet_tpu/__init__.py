@@ -33,3 +33,14 @@ from .util import is_np_array  # noqa: F401
 
 from .attribute import AttrScope  # noqa: F401
 from . import models  # noqa: F401
+from . import module  # noqa: F401
+from . import module as mod  # noqa: F401
+from . import callback  # noqa: F401
+from . import contrib  # noqa: F401
+from . import image  # noqa: F401
+from . import config  # noqa: F401
+from . import test_utils  # noqa: F401
+from .io import recordio  # noqa: F401
+
+# horovod compat is imported lazily (mxnet_tpu.horovod) to keep import light
+

@@ -1,0 +1,66 @@
+"""Training callbacks (reference: ``python/mxnet/callback.py``)."""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["Speedometer", "do_checkpoint", "LogValidationMetricsCallback"]
+
+
+class Speedometer:
+    """Logs samples/sec every ``frequent`` batches (the classic training log)."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0
+        self.last_count = 0
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                if param.eval_metric is not None:
+                    name_value = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s"
+                    logging.info(msg, param.epoch, count, speed,
+                                 "\t".join(f"{n}={v:f}" for n, v in name_value))
+                else:
+                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                                 param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback: save module checkpoint every ``period`` epochs."""
+
+    def _callback(epoch, sym, arg_params, aux_params):
+        if (epoch + 1) % period == 0:
+            from .serialization import save_ndarrays
+
+            if sym is not None:
+                sym.save(f"{prefix}-symbol.json")
+            save_ndarrays(f"{prefix}-{epoch + 1:04d}.params",
+                          {f"arg:{k}": v for k, v in arg_params.items()})
+            logging.info("Saved checkpoint to \"%s-%04d.params\"", prefix, epoch + 1)
+
+    return _callback
+
+
+class LogValidationMetricsCallback:
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)

@@ -1,0 +1,75 @@
+"""Runtime config / env-var layer (reference SURVEY §5.6: the ``MXNET_*``
+env-var tier read via ``dmlc::GetEnv`` at use sites).
+
+One typed module: every knob has a declared type/default and an ``MXNET_*``
+alias where the reference semantics survive on TPU. Knobs whose mechanism is
+deleted (engine type, GPU mem pool, cuDNN autotune) are accepted and mapped
+to their closest analog or a no-op, so reference launch scripts run.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+__all__ = ["get", "set", "knobs", "describe"]
+
+# name -> (type, default, env aliases, doc)
+_KNOBS: Dict[str, tuple] = {
+    "safe_accumulation": (bool, True, ("MXNET_SAFE_ACCUMULATION",),
+                          "accumulate low-precision reductions in f32"),
+    "engine_type": (str, "xla", ("MXNET_ENGINE_TYPE",),
+                    "reference: ThreadedEnginePerDevice/NaiveEngine; here "
+                    "'xla' (async) or 'naive' (sync eager via jax.disable_jit "
+                    "debugging semantics)"),
+    "exec_bulk_exec_train": (bool, True, ("MXNET_EXEC_BULK_EXEC_TRAIN",),
+                             "reference op-bulking; here jit fusion (no-op)"),
+    "gpu_mem_pool_type": (str, "xla", ("MXNET_GPU_MEM_POOL_TYPE",),
+                          "allocator pooling is XLA's BFC arena (no-op)"),
+    "cudnn_autotune_default": (int, 0, ("MXNET_CUDNN_AUTOTUNE_DEFAULT",),
+                               "XLA autotunes convs itself (no-op)"),
+    "kvstore_usetree": (bool, False, ("MXNET_KVSTORE_USETREE",),
+                        "comm-tree selection is XLA's collective scheduling"),
+    "kvstore_bigarray_bound": (int, 1000000, ("MXNET_KVSTORE_BIGARRAY_BOUND",),
+                               "kept for API compat"),
+    "use_fusion": (bool, True, ("MXNET_USE_FUSION",),
+                   "pointwise fusion — always on via XLA"),
+    "flash_attention": (bool, True, ("MXNET_TPU_FLASH_ATTENTION",),
+                        "use the Pallas flash kernel when shapes allow"),
+    "default_dtype": (str, "float32", ("MXNET_DEFAULT_DTYPE",), "creation dtype"),
+    "profiler_dir": (str, "/tmp/mxnet_tpu_profile", ("MXNET_PROFILER_DIR",),
+                     "xplane trace output directory"),
+    "num_cpu_workers": (int, 4, ("MXNET_CPU_WORKER_NTHREADS", "OMP_NUM_THREADS"),
+                        "host-side data worker default"),
+}
+
+_values: Dict[str, Any] = {}
+
+
+def _coerce(typ, raw):
+    if typ is bool:
+        return str(raw).lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+def get(name: str):
+    if name in _values:
+        return _values[name]
+    typ, default, envs, _doc = _KNOBS[name]
+    for e in envs:
+        if e in os.environ:
+            return _coerce(typ, os.environ[e])
+    return default
+
+
+def set(name: str, value) -> None:
+    typ, _d, _e, _doc = _KNOBS[name]
+    _values[name] = _coerce(typ, value)
+
+
+def knobs():
+    return sorted(_KNOBS)
+
+
+def describe(name: str) -> str:
+    typ, default, envs, doc = _KNOBS[name]
+    return f"{name} ({typ.__name__}, default={default!r}, env={'/'.join(envs)}): {doc}"

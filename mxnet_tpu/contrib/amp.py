@@ -1,0 +1,97 @@
+"""Automatic mixed precision (reference: ``python/mxnet/contrib/amp/amp.py``).
+
+The reference rewrites graphs with ``amp_cast`` using fp16 white/black op
+lists and dynamically scales the loss. On TPU the target dtype is
+**bfloat16**, which shares float32's exponent range — so loss scaling is
+mathematically unnecessary and ``scale_loss`` becomes an identity (kept as a
+context manager for script compat, and fully functional if ``dtype='float16'``
+is forced). ``init()`` flips the global policy; ``init_trainer`` attaches the
+scaler; ``convert_model``/Block casting maps to ``net.cast``.
+
+Op lists survive conceptually: matmul/conv-class ops run in bf16, reductions
+and normalizations accumulate f32 (the ops in ``mxnet_tpu.ops`` already do
+f32 accumulation internally — see ``_reduce``/``layer_norm``/``batch_norm``).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+__all__ = ["init", "init_trainer", "scale_loss", "convert_model", "LossScaler",
+           "amp_dtype"]
+
+_STATE = threading.local()
+_STATE.dtype = None
+
+
+def amp_dtype():
+    return getattr(_STATE, "dtype", None)
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP globally. On TPU target_dtype defaults to bfloat16."""
+    assert target_dtype in ("bfloat16", "float16")
+    _STATE.dtype = target_dtype
+
+
+class LossScaler:
+    """Dynamic loss scaling (only meaningful for float16)."""
+
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0, scale_window=2000):
+        self.loss_scale = init_scale if amp_dtype() == "float16" else 1.0
+        self._factor = scale_factor
+        self._window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        import jax.numpy as jnp
+        import numpy as np
+
+        for p in params:
+            g = p.grad()._data
+            if not bool(jnp.isfinite(g).all()):
+                return True
+        return False
+
+    def update_scale(self, skip):
+        if skip:
+            self.loss_scale = max(1.0, self.loss_scale / self._factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self.loss_scale *= self._factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer):
+    trainer._amp_loss_scaler = LossScaler()
+    trainer._amp_original_scale = trainer._scale
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None or scaler.loss_scale == 1.0:
+        yield loss
+        return
+    trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    if isinstance(loss, (list, tuple)):
+        yield [l * scaler.loss_scale for l in loss]
+    else:
+        yield loss * scaler.loss_scale
+    trainer._scale = trainer._amp_original_scale
+
+
+def unscale(trainer):
+    pass  # grads rescaled through trainer._scale
+
+
+def convert_model(net, target_dtype="bfloat16"):
+    """Cast a Gluon block's parameters for mixed-precision compute.
+    BatchNorm stats/gamma/beta stay f32 (see BatchNorm.cast)."""
+    net.cast(target_dtype)
+    return net

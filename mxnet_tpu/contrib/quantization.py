@@ -1,0 +1,83 @@
+"""INT8 post-training quantization (reference:
+``python/mxnet/contrib/quantization.py`` + ``src/operator/quantization/``).
+
+The reference inserts quantize/dequantize ops and calibrates scales via
+min-max or KL(entropy) over a calibration set. The TPU design keeps the same
+calibration logic (it's backend-agnostic math) and applies *simulated*
+quantization: int8 weights with per-channel scales, dequantized into the bf16
+matmul — which is how XLA consumes int8 on TPU without custom kernels. A
+Pallas native-int8 matmul is the later optimization.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["quantize_array", "dequantize_array", "calib_minmax", "calib_entropy",
+           "quantize_net"]
+
+
+def quantize_array(x, scale=None, axis=None):
+    """f32 -> (int8, scale). Per-channel when axis is given."""
+    xf = x.astype(jnp.float32)
+    if scale is None:
+        amax = jnp.max(jnp.abs(xf), axis=None if axis is None else tuple(
+            i for i in range(x.ndim) if i != axis), keepdims=axis is not None)
+        scale = amax / 127.0 + 1e-12
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_array(q, scale, dtype=jnp.bfloat16):
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def calib_minmax(samples):
+    """Min-max calibration: scale from the absolute max over samples."""
+    amax = max(float(np.abs(np.asarray(s)).max()) for s in samples)
+    return amax / 127.0 + 1e-12
+
+
+def calib_entropy(samples, num_bins=2048, num_quantized_bins=255):
+    """KL-divergence (entropy) calibration, reference algorithm shape."""
+    data = np.abs(np.concatenate([np.asarray(s).ravel() for s in samples]))
+    amax = data.max() + 1e-12
+    hist, edges = np.histogram(data, bins=num_bins, range=(0, amax))
+    best_kl, best_t = np.inf, amax
+    for i in range(num_quantized_bins // 2, num_bins + 1, num_bins // 64 or 1):
+        t = edges[i] if i < len(edges) else amax
+        p = hist[:i].astype(np.float64).copy()
+        p[-1] += hist[i:].sum()  # clip outliers into last bin
+        if p.sum() == 0:
+            continue
+        # quantize p into num_quantized_bins then expand back
+        factor = max(1, i // num_quantized_bins)
+        q = np.zeros_like(p)
+        for j in range(0, i, factor):
+            chunk = p[j:j + factor]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[j:j + factor] = np.where(chunk > 0, chunk.sum() / nz, 0)
+        pn, qn = p / p.sum(), q / max(q.sum(), 1e-12)
+        mask = pn > 0
+        kl = float(np.sum(pn[mask] * np.log(pn[mask] / np.maximum(qn[mask], 1e-12))))
+        if kl < best_kl:
+            best_kl, best_t = kl, t
+    return best_t / 127.0
+
+
+def quantize_net(net, calib_data=None, calib_mode="naive", quantized_dtype="int8",
+                 exclude_patterns=("bias", "gamma", "beta", "running", "embed")):
+    """Quantize a Gluon block's weight parameters in place (simulated int8:
+    stored dequantized-bf16 with int8-grid values; scales returned)."""
+    scales = {}
+    for name, p in net.collect_params().items():
+        if p._nd is None or any(s in name for s in exclude_patterns):
+            continue
+        if p.data().ndim < 2:
+            continue
+        q, scale = quantize_array(p.data()._data, axis=0)
+        p._nd._data = dequantize_array(q, scale, dtype=p.data()._data.dtype)
+        scales[name] = np.asarray(scale)
+    return net, scales

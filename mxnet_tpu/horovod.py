@@ -1,0 +1,55 @@
+"""Horovod-MXNet compatibility namespace (reference: external
+``horovod.mxnet`` package — SURVEY §2.3 allreduce DP path).
+
+Reference scripts do::
+
+    import horovod.mxnet as hvd
+    hvd.init(); trainer = hvd.DistributedTrainer(params, opt)
+
+Here ``import mxnet_tpu.horovod as hvd`` gives the same surface over
+``jax.distributed`` + GSPMD collectives (no MPI/NCCL anywhere).
+"""
+from __future__ import annotations
+
+import jax
+
+from .parallel.distributed_trainer import DistributedTrainer, init as _init
+
+__all__ = ["init", "rank", "size", "local_rank", "local_size",
+           "DistributedTrainer", "allreduce", "broadcast_parameters"]
+
+
+def init():
+    _init()
+
+
+def rank() -> int:
+    return jax.process_index()
+
+
+def size() -> int:
+    return jax.process_count()
+
+
+def local_rank() -> int:
+    return 0
+
+
+def local_size() -> int:
+    return 1
+
+
+def allreduce(tensor, average=True, name=None, priority=0):
+    from .kvstore import _dcn_psum
+    from .ndarray import NDArray
+
+    out = _dcn_psum(tensor._data)
+    if average:
+        out = out / size()
+    return NDArray(out)
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Single-controller GSPMD: parameters are already one logical value on
+    every process; kept for script compat."""
+    return params

@@ -1,0 +1,139 @@
+"""Image augmentation pipeline (reference: ``python/mxnet/image/image.py``).
+
+The reference's augmenters are host-side OpenCV calls. Here they are
+jax-array ops (device or host), with the same composable Augmenter list
+protocol so ``ImageIter``-style pipelines port.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import NDArray, array
+
+__all__ = ["imresize", "resize_short", "center_crop", "random_crop",
+           "color_normalize", "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",
+           "RandomCropAug", "CenterCropAug", "ResizeAug", "CreateAugmenter"]
+
+
+def _raw(x):
+    return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def imresize(src, w, h, interp=1):
+    x = _raw(src).astype(jnp.float32)
+    out = jax.image.resize(x, (h, w, x.shape[2]), method="linear")
+    return NDArray(out.astype(_raw(src).dtype))
+
+
+def resize_short(src, size, interp=1):
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def center_crop(src, size, interp=1):
+    h, w = src.shape[:2]
+    cw, ch = size
+    x0, y0 = (w - cw) // 2, (h - ch) // 2
+    out = src[y0:y0 + ch, x0:x0 + cw]
+    return out, (x0, y0, cw, ch)
+
+
+def random_crop(src, size, interp=1):
+    h, w = src.shape[:2]
+    cw, ch = size
+    x0 = np.random.randint(0, w - cw + 1)
+    y0 = np.random.randint(0, h - ch + 1)
+    return src[y0:y0 + ch, x0:x0 + cw], (x0, y0, cw, ch)
+
+
+def color_normalize(src, mean, std=None):
+    out = _raw(src).astype(jnp.float32) - _raw(mean)
+    if std is not None:
+        out = out / _raw(std)
+    return NDArray(out)
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return resize_short(src, self.size)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return center_crop(src, self.size)[0]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return random_crop(src, self.size)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if np.random.rand() < self.p:
+            return NDArray(jnp.flip(_raw(src), axis=1))
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean, self.std = jnp.asarray(mean), jnp.asarray(std)
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
+                    mean=None, std=None, **kwargs):
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize))
+    crop_size = (data_shape[2], data_shape[1])
+    auglist.append(RandomCropAug(crop_size) if rand_crop else CenterCropAug(crop_size))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std if std is not None else 1.0))
+    return auglist

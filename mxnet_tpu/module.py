@@ -1,0 +1,292 @@
+"""Module API (reference: ``python/mxnet/module/`` — ``Module.fit``, the
+legacy symbolic ImageNet training path, SURVEY §3.3).
+
+``bind`` ≈ lowering+compile: the Symbol lowers into one jitted executor.
+``DataParallelExecutorGroup``'s per-GPU batch slicing is gone — a batch is
+one global array and the mesh shards it (the compile-then-run structure the
+reference pioneered maps 1:1 onto jit).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import metric as metric_mod
+from . import optimizer as opt_mod
+from .base import MXNetError
+from .io.io import DataBatch, DataDesc
+from .kvstore import create as kv_create
+from .ndarray import NDArray, array, zeros
+from .symbol import Symbol
+
+__all__ = ["BaseModule", "Module"]
+
+
+class BaseModule:
+    def __init__(self, logger=None):
+        self.logger = logger or logging.getLogger()
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc", epoch_end_callback=None,
+            batch_end_callback=None, kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),), initializer=None,
+            arg_params=None, aux_params=None, allow_missing=False,
+            force_init=False, begin_epoch=0, num_epoch=None,
+            validation_metric=None, monitor=None):
+        assert num_epoch is not None, "num_epoch required"
+        if not self.binded:
+            self.bind(data_shapes=train_data.provide_data,
+                      label_shapes=train_data.provide_label, for_training=True)
+        if not self.params_initialized or force_init:
+            self.init_params(initializer=initializer, arg_params=arg_params,
+                             aux_params=aux_params, allow_missing=allow_missing,
+                             force_init=force_init)
+        if not self.optimizer_initialized:
+            self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                optimizer_params=dict(optimizer_params))
+        eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            nbatch = 0
+            train_data.reset()
+            for batch in train_data:
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    for cb in _listify(batch_end_callback):
+                        cb(_BatchEndParam(epoch, nbatch, eval_metric))
+                nbatch += 1
+            name_vals = eval_metric.get_name_value()
+            self.logger.info("Epoch[%d] %s", epoch,
+                             " ".join(f"{n}={v:.5f}" for n, v in name_vals))
+            if epoch_end_callback is not None:
+                arg_p, aux_p = self.get_params()
+                for cb in _listify(epoch_end_callback):
+                    cb(epoch, self._symbol, arg_p, aux_p)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric)
+                for n, v in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, n, v)
+
+    def score(self, eval_data, eval_metric, num_batch=None, reset=True):
+        if reset:
+            eval_data.reset()
+        eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, reset=True):
+        if reset:
+            eval_data.reset()
+        outs = []
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs.append(self.get_outputs()[0].asnumpy())
+        from .ndarray import array as _arr
+
+        return _arr(np.concatenate(outs))
+
+
+class _BatchEndParam:
+    def __init__(self, epoch, nbatch, eval_metric):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = None
+
+
+def _listify(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol: Symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=None, context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
+        super().__init__(logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context
+        self._exec = None
+        self._arg_params: Dict[str, NDArray] = {}
+        self._optimizer = None
+        self._kvstore = None
+        self._loss_sym = None
+
+    # -- bind ---------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        shapes = {}
+        for d in data_shapes:
+            name, shape = (d.name, d.shape) if isinstance(d, DataDesc) else d
+            shapes[name] = shape
+        if label_shapes:
+            for d in label_shapes:
+                name, shape = (d.name, d.shape) if isinstance(d, DataDesc) else d
+                shapes[name] = shape
+        args = self._symbol.list_arguments()
+        # label args may be absent from the symbol (loss computed in-symbol)
+        self._param_names = [a for a in args
+                             if a not in shapes]
+        full = dict(shapes)
+        self._shapes = shapes
+        self.binded = True
+        self._for_training = for_training
+        self._grad_req = grad_req
+        return self
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        from . import initializer as init_mod
+        from . import random as rng
+
+        initializer = initializer or init_mod.Uniform(0.01)
+        # infer param shapes from data shapes
+        arg_shapes, _, _ = self._symbol.infer_shape(**{
+            k: v for k, v in self._shapes.items()})
+        if arg_shapes is None:
+            raise MXNetError("init_params: cannot infer shapes; provide all "
+                             "input shapes at bind time")
+        names = self._symbol.list_arguments()
+        for name, shape in zip(names, arg_shapes):
+            if name in self._shapes:
+                continue
+            if arg_params and name in arg_params:
+                self._arg_params[name] = arg_params[name].copy()
+            elif name not in self._arg_params or force_init:
+                data = initializer.init_for_name(name, shape, "float32", rng.next_key())
+                self._arg_params[name] = NDArray(data)
+        for p in self._arg_params.values():
+            p.attach_grad()
+        self.params_initialized = True
+        return self
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd", optimizer_params=None,
+                       force_init=False):
+        self._optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self._kvstore = kv_create(kvstore) if isinstance(kvstore, str) else kvstore
+        self._opt_states = {k: self._optimizer.create_state(i, v)
+                            for i, (k, v) in enumerate(self._arg_params.items())}
+        self._opt_idx = {k: i for i, k in enumerate(self._arg_params)}
+        self.optimizer_initialized = True
+        return self
+
+    # -- step ---------------------------------------------------------------
+    def forward(self, data_batch: DataBatch, is_train=None):
+        from . import autograd
+
+        env = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            env[name] = arr if isinstance(arr, NDArray) else array(arr)
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                env[name] = arr if isinstance(arr, NDArray) else array(arr)
+        env.update(self._arg_params)
+        self._env = env
+        is_train = self._for_training if is_train is None else is_train
+        if is_train:
+            with autograd.record():
+                self._outputs = [self._eval_symbol(env)]
+        else:
+            self._outputs = [self._eval_symbol(env)]
+        return self
+
+    def _eval_symbol(self, env):
+        from .ndarray import invoke
+        from . import registry
+
+        memo = {}
+
+        def ev(s):
+            key = (s._op, s._name)
+            if s._op is None:
+                return env[s._name]
+            if key not in memo:
+                ins = [ev(i) for i in s._inputs]
+                out = invoke(registry.get(s._op), tuple(ins), dict(s._kwargs))
+                memo[key] = out if isinstance(out, tuple) else (out,)
+            return memo[key][s._out_index]
+
+        return ev(self._symbol)
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def backward(self, out_grads=None):
+        from . import autograd
+
+        head = self._outputs[0]
+        loss = head if head.size == 1 else head.sum()
+        autograd.backward([loss])
+
+    def update(self):
+        ws = list(self._arg_params.values())
+        idxs = [self._opt_idx[k] for k in self._arg_params]
+        gs = [w._grad for w in ws]
+        states = [self._opt_states[k] for k in self._arg_params]
+        new_states = self._optimizer.update_multi(idxs, ws, gs, states)
+        for k, s in zip(self._arg_params, new_states):
+            self._opt_states[k] = s
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self._outputs)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._outputs
+
+    def get_params(self):
+        return dict(self._arg_params), {}
+
+    def set_params(self, arg_params, aux_params=None, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        for k, v in (arg_params or {}).items():
+            self._arg_params[k] = v.copy()
+            self._arg_params[k].attach_grad()
+        self.params_initialized = True
+
+    # -- checkpoint (reference: mod.save_checkpoint / Module.load) -----------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from .serialization import save_ndarrays
+
+        self._symbol.save(f"{prefix}-symbol.json")
+        save_ndarrays(f"{prefix}-{epoch:04d}.params",
+                      {f"arg:{k}": v for k, v in self._arg_params.items()})
+        if save_optimizer_states:
+            import pickle
+
+            import jax
+
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                host = jax.tree_util.tree_map(lambda x: np.asarray(x), self._opt_states)
+                pickle.dump(host, f)
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        from . import symbol as sym_mod
+        from .serialization import load_ndarrays
+
+        symbol = sym_mod.load(f"{prefix}-symbol.json")
+        mod = Module(symbol, **kwargs)
+        loaded = load_ndarrays(f"{prefix}-{epoch:04d}.params")
+        mod._pending_params = {k.removeprefix("arg:"): v for k, v in loaded.items()}
+        return mod
+
+    def init_params_from_pending(self):
+        self.set_params(self._pending_params)

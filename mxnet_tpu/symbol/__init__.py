@@ -127,14 +127,21 @@ class Symbol:
         return [NDArray(self._make_fn()(env))]
 
     def infer_shape(self, **kwargs):
+        """Shape inference; solves unknown parameter shapes from data shapes
+        via per-op hints (the analog of the reference's bidirectional
+        FInferShape pass)."""
         args = self.list_arguments()
-        env = {}
-        for name in args:
-            if name not in kwargs:
-                return None, None, None
-            env[name] = jax.ShapeDtypeStruct(tuple(kwargs[name]), jnp.float32)
+        known = {k: tuple(v) for k, v in kwargs.items()}
+        shapes = _infer_shapes_partial(self, known)
+        if shapes is None:
+            return None, None, None
+        arg_shapes = [shapes.get(a) for a in args]
+        if any(s is None for s in arg_shapes):
+            return None, None, None
+        env = {a: jax.ShapeDtypeStruct(shapes[a], jnp.float32) for a in args}
         out = jax.eval_shape(lambda e: self._make_fn()(e), env)
-        return [tuple(env[a].shape) for a in args], [tuple(out.shape)], []
+        out = out if isinstance(out, tuple) else (out,)
+        return arg_shapes, [tuple(o.shape) for o in out], []
 
     def infer_type(self, **kwargs):
         return None, [jnp.float32], []
@@ -190,6 +197,94 @@ def _jsonable(kwargs):
         elif isinstance(v, (tuple, list)):
             out[k] = list(v)
     return out
+
+
+# -- partial shape inference -------------------------------------------------
+# hint: (data_input_shapes, n_array_inputs, kwargs) -> shapes for ALL inputs
+def _fc_hint(shapes, kwargs):
+    data = shapes[0]
+    num_hidden = int(kwargs["num_hidden"])
+    flatten = kwargs.get("flatten", True)
+    in_units = 1
+    if data is not None:
+        in_units = int(np.prod(data[1:])) if flatten else data[-1]
+    out = [data, (num_hidden, in_units)]
+    if len(shapes) > 2:
+        out.append((num_hidden,))
+    return out
+
+
+def _conv_hint(shapes, kwargs):
+    data = shapes[0]
+    nf = int(kwargs["num_filter"])
+    kern = tuple(kwargs.get("kernel", (1, 1)))
+    groups = int(kwargs.get("num_group", 1))
+    w = (nf, (data[1] // groups) if data else 1) + kern
+    out = [data, w]
+    if len(shapes) > 2:
+        out.append((nf,))
+    return out
+
+
+def _norm_hint(shapes, kwargs):
+    data = shapes[0]
+    axis = int(kwargs.get("axis", 1 if kwargs.get("_bn", False) else -1))
+    c = data[axis] if data else 1
+    return [data] + [(c,)] * (len(shapes) - 1)
+
+
+def _embed_hint(shapes, kwargs):
+    return [shapes[0], (int(kwargs["input_dim"]), int(kwargs["output_dim"]))]
+
+
+_PARAM_SHAPE_HINTS = {
+    "FullyConnected": _fc_hint,
+    "Convolution": _conv_hint,
+    "Embedding": _embed_hint,
+    "LayerNorm": lambda s, k: _norm_hint(s, {**k}),
+    "BatchNorm": lambda s, k: _norm_hint(s, {**k, "_bn": True}),
+    "InstanceNorm": lambda s, k: _norm_hint(s, {**k, "_bn": True}),
+}
+
+import numpy as np  # noqa: E402
+
+
+def _infer_shapes_partial(head, known):
+    """Topo walk filling variable shapes via op hints, then eval_shape."""
+    shapes = dict(known)  # var name -> shape
+    node_out = {}  # id(node-ish) -> tuple of shapes
+
+    def out_shape(s):
+        if s._op is None:
+            return shapes.get(s._name)
+        key = (s._op, s._name)
+        if key in node_out:
+            outs = node_out[key]
+            return outs[s._out_index] if outs is not None else None
+        in_shapes = [out_shape(i) for i in s._inputs]
+        hint = _PARAM_SHAPE_HINTS.get(s._op)
+        if hint is not None:
+            full = hint(in_shapes, s._kwargs)
+            for inp, sh in zip(s._inputs, full):
+                if inp._op is None and shapes.get(inp._name) is None and sh:
+                    shapes[inp._name] = tuple(int(x) for x in sh)
+            in_shapes = [out_shape(i) for i in s._inputs]
+        if any(sh is None for sh in in_shapes):
+            node_out[key] = None
+            return None
+        try:
+            structs = [jax.ShapeDtypeStruct(sh, jnp.float32) for sh in in_shapes]
+            outs = jax.eval_shape(lambda *a: _registry.get(s._op).fn(*a, **s._kwargs),
+                                  *structs)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            node_out[key] = tuple(tuple(o.shape) for o in outs)
+        except Exception:
+            node_out[key] = None
+            return None
+        return node_out[key][s._out_index]
+
+    out_shape(head)
+    return shapes
 
 
 _NAME_COUNT: Dict[str, int] = {}

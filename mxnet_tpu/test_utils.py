@@ -1,0 +1,123 @@
+"""Test utilities (reference: ``python/mxnet/test_utils.py`` — the backbone
+of the reference's entire python test suite, SURVEY §4).
+
+Ports the *oracle machinery*: dtype-aware ``assert_almost_equal``, the
+finite-difference gradient checker, and ``check_consistency`` recast as
+CPU-vs-TPU / eager-vs-jit comparison (the reference compared CPU vs GPU
+kernels; here the second backend is the compiled path).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import autograd
+from .base import dtype_np
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array
+
+__all__ = ["default_context", "assert_almost_equal", "almost_equal",
+           "check_numeric_gradient", "check_consistency", "rand_ndarray",
+           "same_array", "default_rtols"]
+
+_DEFAULT_RTOL = {
+    np.dtype(np.float16): 1e-2,
+    np.dtype(np.float32): 1e-4,
+    np.dtype(np.float64): 1e-6,
+}
+_DEFAULT_ATOL = {
+    np.dtype(np.float16): 1e-2,
+    np.dtype(np.float32): 1e-5,
+    np.dtype(np.float64): 1e-7,
+}
+
+
+def default_rtols(dtype):
+    d = np.dtype(dtype) if not str(dtype).startswith("bfloat") else np.dtype(np.float16)
+    return _DEFAULT_RTOL.get(d, 1e-4), _DEFAULT_ATOL.get(d, 1e-5)
+
+
+def default_context():
+    return current_context()
+
+
+def _np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    a, b = _np(a), _np(b)
+    rt, at = default_rtols(a.dtype)
+    return np.allclose(a, b, rtol=rtol or rt, atol=atol or at)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    a_np, b_np = _np(a), _np(b)
+    rt, at = default_rtols(a_np.dtype)
+    np.testing.assert_allclose(a_np, b_np, rtol=rtol or rt, atol=atol or at,
+                               err_msg=f"{names[0]} vs {names[1]}")
+
+
+def rand_ndarray(shape, dtype="float32", ctx=None, scale=1.0):
+    data = (np.random.randn(*shape) * scale).astype(dtype_np(dtype))
+    return array(data, ctx=ctx)
+
+
+def same_array(a, b):
+    """Handle-level aliasing check (buffer identity is meaningless with
+    functional updates; the reference checked raw pointers)."""
+    return a is b or a._data is b._data
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4,
+                           input_grads=None):
+    """Compare autograd gradients of ``fn(*inputs)`` (scalar output) against
+    central finite differences (reference: check_numeric_gradient)."""
+    nds = [x if isinstance(x, NDArray) else array(x) for x in inputs]
+    for x in nds:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*nds)
+        if out.size != 1:
+            out = out.sum()
+    out.backward()
+    analytic = [x.grad.asnumpy() for x in nds]
+
+    for xi, x in enumerate(nds):
+        base = x.asnumpy().astype(np.float64)
+        fd = np.zeros_like(base)
+        it = np.nditer(base, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            xp = base.copy(); xp[idx] += eps
+            xm = base.copy(); xm[idx] -= eps
+
+            def eval_at(v):
+                args = [array(v.astype(base.dtype)) if j == xi else nds[j]
+                        for j in range(len(nds))]
+                o = fn(*args)
+                return float(o.sum().asnumpy()) if o.size != 1 else float(o.asnumpy())
+
+            fd[idx] = (eval_at(xp) - eval_at(xm)) / (2 * eps)
+            it.iternext()
+        np.testing.assert_allclose(analytic[xi], fd, rtol=rtol, atol=atol,
+                                   err_msg=f"input {xi}: autograd vs finite-diff")
+
+
+def check_consistency(fn, inputs, rtol=1e-4, atol=1e-5):
+    """Eager vs jit-compiled equivalence — the TPU analog of the reference's
+    cpu-vs-gpu check_consistency oracle."""
+    import jax
+
+    nds = [x if isinstance(x, NDArray) else array(x) for x in inputs]
+    eager = fn(*nds)
+    eager_np = _np(eager)
+
+    def pure(*raws):
+        out = fn(*[NDArray(r) for r in raws])
+        return out._data
+
+    compiled = jax.jit(pure)(*[x._data for x in nds])
+    np.testing.assert_allclose(eager_np, np.asarray(compiled), rtol=rtol,
+                               atol=atol, err_msg="eager vs compiled")

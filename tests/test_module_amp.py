@@ -1,0 +1,155 @@
+"""Module.fit path, AMP facade, quantization, config layer, test_utils
+oracles (reference: test_module.py / test_amp.py / quantization tests)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import NDArrayIter
+
+
+def _mlp_symbol():
+    x = sym.var("data")
+    w1 = sym.var("fc1_weight")
+    b1 = sym.var("fc1_bias")
+    h = sym.Activation(sym.FullyConnected(x, w1, b1, num_hidden=16), act_type="relu")
+    w2 = sym.var("fc2_weight")
+    b2 = sym.var("fc2_bias")
+    out = sym.FullyConnected(h, w2, b2, num_hidden=3)
+    label = sym.var("softmax_label")
+    return sym.softmax_cross_entropy(out, label), out
+
+
+def test_module_fit_runs_and_learns():
+    rs = np.random.RandomState(0)
+    X = rs.rand(120, 8).astype(np.float32)
+    Y = (X[:, 0] * 3).astype(np.int32) % 3
+    it = NDArrayIter(X, Y.astype(np.float32), batch_size=20)
+
+    loss_sym, _logits = _mlp_symbol()
+    mod = mx.mod.Module(loss_sym, data_names=("data",), label_names=("softmax_label",))
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam", optimizer_params={"learning_rate": 1e-2})
+
+    it.reset()
+    first_loss = None
+    for epoch in range(3):
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            cur = float(mod.get_outputs()[0].asnumpy()) / 20
+            if first_loss is None:
+                first_loss = cur
+    assert cur < first_loss, (first_loss, cur)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    loss_sym, _ = _mlp_symbol()
+    mod = mx.mod.Module(loss_sym)
+    it = NDArrayIter(np.zeros((4, 8), np.float32), np.zeros(4, np.float32), batch_size=4)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    prefix = str(tmp_path / "model")
+    mod.init_optimizer()
+    mod.save_checkpoint(prefix, 1)
+    mod2 = mx.mod.Module.load(prefix, 1)
+    assert set(mod2._pending_params) == set(mod._arg_params)
+
+
+def test_amp_bf16_training_step():
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.contrib import amp
+    from mxnet_tpu.gluon import nn
+
+    amp.init("bfloat16")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    _ = net(nd.ones((2, 4)))
+    amp.convert_model(net)
+    assert "bfloat16" in str(net[0].weight.data()._data.dtype)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    x = nd.ones((2, 4)).astype("bfloat16")
+    with autograd.record():
+        out = net(x)
+        loss = (out.astype("float32") ** 2).sum()
+    with amp.scale_loss(loss, tr) as scaled:
+        scaled.backward()
+    tr.step(2)
+    assert np.isfinite(net[0].weight.data().astype("float32").asnumpy()).all()
+
+
+def test_quantization_roundtrip_accuracy():
+    from mxnet_tpu.contrib import quantization as q
+
+    w = np.random.randn(16, 32).astype(np.float32)
+    qw, scale = q.quantize_array(w, axis=0)
+    deq = np.asarray(q.dequantize_array(qw, scale, dtype=np.float32))
+    # int8 per-channel quantization: relative error bounded by ~scale/2
+    assert np.abs(deq - w).max() < np.abs(w).max() / 64
+
+
+def test_quantize_net_keeps_function():
+    from mxnet_tpu.contrib import quantization as q
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = nd.array(np.random.rand(4, 6).astype(np.float32))
+    before = net(x).asnumpy()
+    _, scales = q.quantize_net(net)
+    assert scales  # at least the two weights
+    after = net(x).asnumpy()
+    assert np.abs(before - after).max() < 0.25 * max(np.abs(before).max(), 1)
+
+
+def test_config_env_layer(monkeypatch):
+    from mxnet_tpu import config
+
+    assert config.get("safe_accumulation") is True
+    monkeypatch.setenv("MXNET_SAFE_ACCUMULATION", "0")
+    assert config.get("safe_accumulation") is False
+    config.set("flash_attention", False)
+    assert config.get("flash_attention") is False
+    config.set("flash_attention", True)
+    assert "MXNET_" in config.describe("use_fusion")
+
+
+def test_test_utils_numeric_gradient():
+    from mxnet_tpu import test_utils as tu
+
+    tu.check_numeric_gradient(lambda x: (x * x).sum(), [np.random.rand(3, 2).astype(np.float32)])
+    tu.check_consistency(lambda x: nd.tanh(x * 2), [np.random.rand(2, 2).astype(np.float32)])
+
+
+def test_speedometer_and_checkpoint_callbacks(tmp_path):
+    import logging
+
+    from mxnet_tpu.callback import Speedometer, do_checkpoint
+
+    sp = Speedometer(batch_size=4, frequent=1)
+
+    class P:
+        epoch, nbatch, eval_metric = 0, 1, None
+
+    sp(P())
+    sp(P())  # second call logs
+
+    cb = do_checkpoint(str(tmp_path / "cp"))
+    cb(0, None, {"w": nd.ones((2,))}, {})
+    import os
+
+    assert os.path.exists(str(tmp_path / "cp-0001.params"))
+
+
+def test_horovod_namespace():
+    import mxnet_tpu.horovod as hvd
+
+    hvd.init()
+    assert hvd.rank() == 0 and hvd.size() == 1
+    out = hvd.allreduce(nd.ones((3,)))
+    np.testing.assert_allclose(out.asnumpy(), np.ones(3))
