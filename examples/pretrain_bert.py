@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Driver config #3: BERT base/large pretraining (GluonNLP scripts/bert
+shape). Synthetic corpus; dp x tp mesh; bf16; checkpoint/resume."""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, optimizer
+from mxnet_tpu.models import bert
+from mxnet_tpu.parallel import MeshConfig, TrainStep, make_mesh
+from mxnet_tpu.parallel.sharding import DEFAULT_BERT_RULES
+
+
+def make_batch(batch, seq, masked, vocab, rs):
+    return (nd.array(rs.randint(0, vocab, (batch, seq)), dtype="int32"),
+            nd.array(rs.randint(0, 2, (batch, seq)), dtype="int32"),
+            nd.full((batch,), seq, dtype="int32"),
+            nd.array(rs.randint(0, seq, (batch, masked)), dtype="int32"),
+            nd.array(rs.randint(0, vocab, (batch, masked)), dtype="int32"),
+            nd.ones((batch, masked)),
+            nd.array(rs.randint(0, 2, (batch,)), dtype="int32"))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="bert_base",
+                    choices=list(bert.bert_configs))
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seq-length", type=int, default=128)
+    ap.add_argument("--num-masked", type=int, default=20)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--lr", type=float, default=1e-4)
+    ap.add_argument("--optimizer", default="lamb", choices=["lamb", "adam"])
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--ckpt-dir", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    n = len(jax.devices())
+    mesh = make_mesh(MeshConfig(dp=n // args.tp, tp=args.tp)) if n > 1 else None
+
+    vocab = bert.bert_configs[args.model]["vocab_size"]
+    net = bert.get_bert(args.model, pretrain_head=True, max_length=args.seq_length)
+    net.initialize()
+    rs = np.random.RandomState(0)
+    batch = make_batch(args.batch_size, args.seq_length, args.num_masked, vocab, rs)
+    _ = net(*batch[:4])
+    if args.dtype == "bfloat16":
+        from mxnet_tpu.contrib import amp
+
+        amp.init("bfloat16")
+        amp.convert_model(net)
+
+    def loss_fn(out, labels, weights, nsp_labels):
+        mlm, nsp = out
+        return bert.pretrain_loss(mlm.astype("float32"), nsp.astype("float32"),
+                                  labels, weights, nsp_labels)
+
+    opt = (optimizer.LAMB(learning_rate=args.lr) if args.optimizer == "lamb"
+           else optimizer.Adam(learning_rate=args.lr))
+    step = TrainStep(net, loss_fn, opt, mesh=mesh, rules=DEFAULT_BERT_RULES,
+                     n_model_inputs=4)
+    if args.ckpt_dir:
+        if step.restore(args.ckpt_dir):
+            print(f"resumed from step {int(step.optimizer.num_update)}")
+
+    loss = step(*batch)  # compile
+    t0 = time.time()
+    for i in range(args.steps):
+        batch = make_batch(args.batch_size, args.seq_length, args.num_masked, vocab, rs)
+        loss = step(*batch)
+    jax.block_until_ready(step.params)
+    dt = time.time() - t0
+    print(f"{args.model}: {args.steps * args.batch_size / dt:.1f} seq/s, "
+          f"final loss {float(np.asarray(jax.device_get(loss))):.4f}")
+    if args.ckpt_dir:
+        step.save(args.ckpt_dir)
+
+
+if __name__ == "__main__":
+    main()

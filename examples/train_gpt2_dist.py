@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Driver config #5: GPT-2 345M data-parallel (horovod-style) training.
+
+Single host: GSPMD dp mesh. Multi host: launch via
+``python tools/launch.py -n W python examples/train_gpt2_dist.py`` — each
+process joins jax.distributed and the mesh spans hosts (DCN collectives).
+"""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu.horovod as hvd
+from mxnet_tpu import nd, optimizer
+from mxnet_tpu.models import gpt2
+from mxnet_tpu.parallel import MeshConfig, TrainStep, make_mesh
+from mxnet_tpu.parallel.sharding import DEFAULT_BERT_RULES
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt2_345m", choices=list(gpt2.gpt2_configs))
+    ap.add_argument("--batch-size", type=int, default=8, help="per-process")
+    ap.add_argument("--seq-length", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=20)
+    args = ap.parse_args()
+
+    hvd.init()
+    import jax
+
+    n = len(jax.devices())
+    mesh = make_mesh(MeshConfig(dp=n)) if n > 1 else None
+
+    vocab = gpt2.gpt2_configs[args.model]["vocab_size"]
+    net = gpt2.get_gpt2(args.model, max_length=args.seq_length)
+    net.initialize()
+    rs = np.random.RandomState(hvd.rank())
+    ids = nd.array(rs.randint(0, vocab, (args.batch_size, args.seq_length)),
+                   dtype="int32")
+    _ = net(ids)
+    from mxnet_tpu.contrib import amp
+
+    amp.convert_model(net)
+
+    def loss_fn(logits, labels):
+        return gpt2.lm_loss(logits.astype("float32"), labels)
+
+    step = TrainStep(net, loss_fn, optimizer.Adam(learning_rate=1e-4),
+                     mesh=mesh, rules=DEFAULT_BERT_RULES)
+    loss = step(ids, ids)  # compile (labels = inputs for the smoke loop)
+    t0 = time.time()
+    for _ in range(args.steps):
+        loss = step(ids, ids)
+    jax.block_until_ready(step.params)
+    dt = time.time() - t0
+    tput = args.steps * args.batch_size * args.seq_length / dt
+    if hvd.rank() == 0:
+        print(f"{args.model} world={hvd.size()}: {tput:.0f} tok/s/proc, "
+              f"loss={float(np.asarray(jax.device_get(loss))):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Driver config #2: ResNet-50 data-parallel training
+(reference shape: example/image-classification/train_imagenet.py with
+kvstore='device'; data parallelism here = GSPMD batch sharding over the mesh
+inside one compiled train step)."""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, optimizer
+from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+from mxnet_tpu.parallel import MeshConfig, TrainStep, make_mesh
+
+
+def synthetic_batches(batch, steps, shape=(3, 224, 224), classes=1000):
+    rs = np.random.RandomState(0)
+    for _ in range(steps):
+        yield (nd.array(rs.rand(batch, *shape).astype(np.float32)),
+               nd.array(rs.randint(0, classes, batch)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--layers", type=int, default=50)
+    ap.add_argument("--dp", type=int, default=0, help="data-parallel degree "
+                    "(0 = all devices)")
+    ap.add_argument("--image-size", type=int, default=224)
+    args = ap.parse_args()
+
+    import jax
+
+    n = args.dp or len(jax.devices())
+    mesh = make_mesh(MeshConfig(dp=n)) if n > 1 else None
+
+    net = get_resnet(1, args.layers, classes=1000)
+    net.initialize(mx.init.MSRAPrelu())
+    x0, y0 = next(synthetic_batches(args.batch_size, 1,
+                                    (3, args.image_size, args.image_size)))
+    _ = net(x0)
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = TrainStep(net, lambda out, y: loss_fn(out, y),
+                     optimizer.SGD(learning_rate=0.1, momentum=0.9, wd=1e-4),
+                     mesh=mesh)
+    t0, seen = time.time(), 0
+    for i, (x, y) in enumerate(synthetic_batches(args.batch_size, args.steps,
+                                                 (3, args.image_size, args.image_size))):
+        loss = step(x, y)
+        seen += args.batch_size
+        if i == 0:
+            t0, seen = time.time(), 0  # skip compile
+    import jax as j
+
+    j.block_until_ready(step.params)
+    dt = time.time() - t0
+    print(f"resnet{args.layers} dp={n}: {seen / dt:.1f} img/s "
+          f"(loss={float(np.asarray(j.device_get(loss))):.3f})")
+
+
+if __name__ == "__main__":
+    main()

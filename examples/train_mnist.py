@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Driver config #1: LeNet on MNIST via Gluon HybridSequential, hybridized.
+(reference shape: example/gluon/mnist.py)"""
+import argparse
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.data.vision import MNIST
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=2e-3)
+    ap.add_argument("--no-hybridize", action="store_true")
+    args = ap.parse_args()
+
+    train_data = gluon.data.DataLoader(
+        MNIST(train=True).transform_first(lambda d: d.astype("float32") / 255.0),
+        batch_size=args.batch_size, shuffle=True)
+    val_data = gluon.data.DataLoader(
+        MNIST(train=False).transform_first(lambda d: d.astype("float32") / 255.0),
+        batch_size=args.batch_size)
+
+    net = gluon.model_zoo.get_model("lenet")
+    net.initialize(mx.init.Xavier())
+    if not args.no_hybridize:
+        net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        metric = mx.metric.Accuracy()
+        for data, label in train_data:
+            x = data.transpose((0, 3, 1, 2))
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update(label, out)
+        name, acc = metric.get()
+        val = mx.metric.Accuracy()
+        for data, label in val_data:
+            val.update(label, net(data.transpose((0, 3, 1, 2))))
+        print(f"epoch {epoch}: train {name}={acc:.4f} val={val.get()[1]:.4f} "
+              f"loss={float(loss.mean().asnumpy()):.4f}")
+    net.export("lenet_mnist")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Checkpoint / resume of full training state (SURVEY §5.4).
+
+Two formats:
+  - ``.params`` (reference-compatible dict-of-arrays; ``mx.nd.save/load``)
+    for model-zoo interop;
+  - a *training checkpoint* of (params, opt_state, step) for resume —
+    orbax-backed async+sharded when orbax is importable, npz otherwise.
+
+Failure recovery story (SURVEY §5.3): restart from latest checkpoint —
+``latest_checkpoint`` scans the directory; TrainStep.save/restore wire it up.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["save_train_state", "load_train_state", "latest_checkpoint"]
+
+
+def _orbax():
+    # orbax async/sharded checkpointing is opt-in for now (multi-host runs);
+    # the npz path is the default single-controller format
+    if os.environ.get("MXNET_TPU_USE_ORBAX") != "1":
+        return None
+    try:
+        import orbax.checkpoint as ocp
+
+        return ocp
+    except Exception:
+        return None
+
+
+def save_train_state(directory: str, step: int, params, opt_state,
+                     extra: Optional[dict] = None) -> str:
+    """Write checkpoint ``directory/ckpt-{step}``; returns the path."""
+    import jax
+
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"ckpt-{step}")
+    ocp = _orbax()
+    state = {"params": params, "opt_state": opt_state}
+    if ocp is not None:
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.abspath(path), state, force=True)
+        ckptr.wait_until_finished()
+    else:  # flat npz fallback
+        flat, treedef = jax.tree_util.tree_flatten(state)
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, "arrays.npz"),
+                 **{str(i): np.asarray(a) for i, a in enumerate(flat)})
+        with open(os.path.join(path, "treedef.txt"), "w") as f:
+            f.write(str(treedef))
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"step": step, **(extra or {})}, f)
+    return path
+
+
+def load_train_state(path: str, like=None):
+    """Load a checkpoint; ``like`` = a (params, opt_state) template pytree
+    with target shardings/dtypes (required for the orbax path)."""
+    import jax
+
+    ocp = _orbax()
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if ocp is not None and not os.path.exists(os.path.join(path, "arrays.npz")):
+        ckptr = ocp.StandardCheckpointer()
+        template = None
+        if like is not None:
+            template = {"params": like[0], "opt_state": like[1]}
+        state = ckptr.restore(os.path.abspath(path), template)
+    else:
+        data = np.load(os.path.join(path, "arrays.npz"))
+        flat = [data[str(i)] for i in range(len(data.files))]
+        assert like is not None, "npz restore requires a template pytree"
+        template = {"params": like[0], "opt_state": like[1]}
+        treedef = jax.tree_util.tree_structure(template)
+        state = jax.tree_util.tree_unflatten(treedef, flat)
+    return state["params"], state["opt_state"], meta["step"]
+
+
+def latest_checkpoint(directory: str) -> Optional[str]:
+    if not os.path.isdir(directory):
+        return None
+    best, best_step = None, -1
+    for name in os.listdir(directory):
+        m = re.fullmatch(r"ckpt-(\d+)", name)
+        if m and int(m.group(1)) > best_step:
+            best, best_step = os.path.join(directory, name), int(m.group(1))
+    return best

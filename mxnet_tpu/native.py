@@ -1,0 +1,173 @@
+"""ctypes bindings to the native runtime library (``native/``).
+
+The reference's rule — one flat C ABI under every binding — is kept: the
+library exports ``MXTPU*`` functions with int/handle returns and a
+thread-local ``MXTPUGetLastError``. Python stays fully functional without
+the library (pure-Python fallbacks); when present, RecordIO reads go through
+the C++ engine with its threaded prefetcher.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+__all__ = ["lib", "available", "ensure_built", "NativeRecordReader",
+           "NativeRecordWriter", "NativePrefetchReader"]
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _lib_path():
+    return os.path.join(os.path.dirname(__file__), "_native", "libmxtpu.so")
+
+
+def ensure_built(quiet=True) -> bool:
+    """Build the native library with make if a toolchain is available."""
+    if os.path.exists(_lib_path()):
+        return True
+    native_dir = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+    if not os.path.isdir(native_dir):
+        return False
+    try:
+        subprocess.run(["make", "-C", native_dir], check=True,
+                       capture_output=quiet, timeout=120)
+        return os.path.exists(_lib_path())
+    except Exception:
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    if not ensure_built():
+        return None
+    try:
+        L = ctypes.CDLL(_lib_path())
+    except OSError:
+        return None
+    L.MXTPUGetLastError.restype = ctypes.c_char_p
+    L.MXTPURecordWriterCreate.restype = ctypes.c_void_p
+    L.MXTPURecordWriterCreate.argtypes = [ctypes.c_char_p]
+    L.MXTPURecordWriterWrite.restype = ctypes.c_int64
+    L.MXTPURecordWriterWrite.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    L.MXTPURecordWriterFree.argtypes = [ctypes.c_void_p]
+    L.MXTPURecordReaderCreate.restype = ctypes.c_void_p
+    L.MXTPURecordReaderCreate.argtypes = [ctypes.c_char_p]
+    L.MXTPURecordReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    L.MXTPURecordReaderNext.restype = ctypes.c_int64
+    L.MXTPURecordReaderNext.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+    L.MXTPURecordReaderFree.argtypes = [ctypes.c_void_p]
+    L.MXTPUPrefetchCreate.restype = ctypes.c_void_p
+    L.MXTPUPrefetchCreate.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                                      ctypes.c_uint64, ctypes.c_int, ctypes.c_uint64]
+    L.MXTPUPrefetchNext.restype = ctypes.c_int64
+    L.MXTPUPrefetchNext.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+    L.MXTPUPrefetchFree.argtypes = [ctypes.c_void_p]
+    _LIB = L
+    return _LIB
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+class NativeRecordWriter:
+    def __init__(self, path):
+        L = lib()
+        if L is None:
+            raise RuntimeError("native library unavailable")
+        self._L = L
+        self._h = L.MXTPURecordWriterCreate(path.encode())
+        if not self._h:
+            raise IOError(L.MXTPUGetLastError().decode())
+
+    def write(self, buf: bytes) -> int:
+        pos = self._L.MXTPURecordWriterWrite(self._h, buf, len(buf))
+        if pos < 0:
+            raise IOError(self._L.MXTPUGetLastError().decode())
+        return pos
+
+    def close(self):
+        if self._h:
+            self._L.MXTPURecordWriterFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordReader:
+    def __init__(self, path):
+        L = lib()
+        if L is None:
+            raise RuntimeError("native library unavailable")
+        self._L = L
+        self._h = L.MXTPURecordReaderCreate(path.encode())
+        if not self._h:
+            raise IOError(L.MXTPUGetLastError().decode())
+
+    def seek(self, pos: int):
+        self._L.MXTPURecordReaderSeek(self._h, pos)
+
+    def read(self):
+        ptr = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._L.MXTPURecordReaderNext(self._h, ctypes.byref(ptr))
+        if n == -2:
+            return None
+        if n < 0:
+            raise IOError(self._L.MXTPUGetLastError().decode())
+        return ctypes.string_at(ptr, n)
+
+    def close(self):
+        if self._h:
+            self._L.MXTPURecordReaderFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativePrefetchReader:
+    """Multi-threaded in-order record prefetcher over known offsets."""
+
+    def __init__(self, path, offsets, num_threads=4, queue_cap=64):
+        L = lib()
+        if L is None:
+            raise RuntimeError("native library unavailable")
+        self._L = L
+        arr = (ctypes.c_int64 * len(offsets))(*offsets)
+        self._h = L.MXTPUPrefetchCreate(path.encode(), arr, len(offsets),
+                                        num_threads, queue_cap)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        ptr = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._L.MXTPUPrefetchNext(self._h, ctypes.byref(ptr))
+        if n == -2:
+            self.close()
+            raise StopIteration
+        return ctypes.string_at(ptr, n)
+
+    def close(self):
+        if self._h:
+            self._L.MXTPUPrefetchFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

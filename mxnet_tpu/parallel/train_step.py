@@ -149,6 +149,33 @@ class TrainStep:
         for p in self._plist:
             p._nd._data = self.params[p.name]
 
+    # -- checkpoint / resume (SURVEY §5.4 recovery story) --------------------
+    def save(self, directory):
+        from ..checkpoint import save_train_state
+
+        return save_train_state(directory, int(self.optimizer.num_update),
+                                self.params, self.opt_state)
+
+    def restore(self, directory):
+        from ..checkpoint import latest_checkpoint, load_train_state
+
+        path = latest_checkpoint(directory)
+        if path is None:
+            return False
+        params, opt_state, step = load_train_state(
+            path, like=(self.params, self.opt_state))
+        import jax.numpy as jnp
+
+        self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        self.opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
+        self.step_count = jnp.asarray(step, jnp.int32)
+        self.optimizer.num_update = step
+        if self.param_sharding is not None:
+            self.params = {k: jax.device_put(v, self.param_sharding[k])
+                           for k, v in self.params.items()}
+        self.sync()
+        return True
+
     def lower_hlo(self, *batch):
         raws = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b) for b in batch)
         self._n_batch = len(raws)

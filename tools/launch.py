@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Multi-process launcher (reference: ``tools/launch.py`` + dmlc_tracker).
+
+The reference spawned scheduler/server/worker processes and exported
+``DMLC_*`` env vars for ps-lite. Here there are only *workers*: each process
+is one jax.distributed participant; the coordinator is worker 0. Same UX::
+
+    python tools/launch.py -n 4 python train.py --kv-store dist_sync
+
+Local mode forks N processes on this host (the reference's ``--launcher
+local`` CI topology, SURVEY §4 fixture #5); ssh mode prints per-host
+commands (zero-egress environments can't ssh out, so it stops at the plan).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(n: int, command: list[str]) -> int:
+    port = free_port()
+    coord = f"127.0.0.1:{port}"
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update({
+            "MXNET_TPU_COORDINATOR": coord,
+            "MXNET_TPU_NPROC": str(n),
+            "MXNET_TPU_PROCID": str(rank),
+            # reference-compat aliases so DMLC-era scripts keep working
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(n),
+            "DMLC_WORKER_ID": str(rank),
+        })
+        procs.append(subprocess.Popen(command, env=env))
+    code = 0
+    for p in procs:
+        code = p.wait() or code
+    return code
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference compat; there is no server "
+                         "role (state is sharded with workers)")
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    if args.launcher == "local":
+        sys.exit(launch_local(args.num_workers, args.command))
+    # ssh plan (zero-egress: print what would run per host)
+    hosts = open(args.hostfile).read().split() if args.hostfile else ["host%d" % i for i in range(args.num_workers)]
+    port = free_port()
+    for rank, host in enumerate(hosts[: args.num_workers]):
+        print(f"ssh {host} MXNET_TPU_COORDINATOR={hosts[0]}:{port} "
+              f"MXNET_TPU_NPROC={args.num_workers} MXNET_TPU_PROCID={rank} "
+              + " ".join(args.command))
+
+
+if __name__ == "__main__":
+    main()
