@@ -87,6 +87,7 @@ class _TraceState(threading.local):
         self.active = False
         self.updates = []  # list[(Parameter, raw)]
         self.force_eager = False  # deferred-init pass: children must not jit
+        self.symbolic = False  # export pass: hybrid_forward sees the sym namespace
 
 
 _TRACE = _TraceState()
@@ -346,6 +347,11 @@ class HybridBlock(Block):
 
     # -- hybrid_forward plumbing --------------------------------------------
     def forward(self, x, *args, **kwargs):
+        if _TRACE.symbolic:
+            from .. import symbol as sym_mod
+
+            params = {name: p.var() for name, p in self._reg_params.items()}
+            return self.hybrid_forward(sym_mod, x, *args, **params, **kwargs)
         params = {}
         try:
             for name, p in self._reg_params.items():
@@ -366,7 +372,8 @@ class HybridBlock(Block):
 
     # -- staged call --------------------------------------------------------
     def __call__(self, *args, **kwargs):
-        if not self._active or _TRACE.active or _TRACE.force_eager or kwargs:
+        if (not self._active or _TRACE.active or _TRACE.force_eager
+                or _TRACE.symbolic or kwargs):
             return super().__call__(*args, **kwargs)
         return self._call_cached(*args)
 
@@ -446,36 +453,80 @@ class HybridBlock(Block):
         return jax.jit(pure), rebuild_cell, nstate_cell
 
     # -- deployment (reference: HybridBlock.export -> symbol.json + params) --
-    def export(self, path, epoch=0):
-        import json
+    def trace_symbol(self, *input_names):
+        """Trace this block's forward into a Symbol graph (parameters become
+        named variables). The reference got the same artifact from the
+        CachedOp's nnvm graph."""
+        from .. import symbol as sym_mod
 
-        params = self._collect_params_with_prefix()
-        fname = f"{path}-{epoch:04d}.params"
+        input_names = input_names or ("data",)
+        saved = _TRACE.symbolic
+        _TRACE.symbolic = True
+        try:
+            out = Block.__call__(self, *[sym_mod.var(n) for n in input_names])
+        finally:
+            _TRACE.symbolic = saved
+        return out
+
+    def export(self, path, epoch=0, input_names=("data",)):
+        """Write ``path-symbol.json`` + ``path-{epoch}.params`` (reference
+        deploy format: arg:-prefixed names)."""
         from ..serialization import save_ndarrays
 
-        save_ndarrays(fname, {("arg:" + k): p.data() for k, p in params.items()
-                              if p._nd is not None})
-        meta = {
-            "format": "mxnet_tpu-hybrid-v1",
-            "class": self.__class__.__name__,
-            "params": {k: {"shape": list(p.shape), "dtype": str(p.dtype)}
-                       for k, p in params.items()},
-        }
-        with open(f"{path}-symbol.json", "w") as f:
-            json.dump(meta, f, indent=2)
+        out = self.trace_symbol(*input_names)
+        if isinstance(out, (tuple, list)):
+            from .. import symbol as sym_mod
+
+            out = sym_mod.Group(list(out))
+        out.save(f"{path}-symbol.json")
+        fname = f"{path}-{epoch:04d}.params"
+        by_name = {p.name: p for p in self.collect_params().values()
+                   if p._nd is not None}
+        save_ndarrays(fname, {("arg:" + k): p.data() for k, p in by_name.items()})
         return f"{path}-symbol.json", fname
 
 
 class SymbolBlock(Block):
-    """Runs an exported artifact (reference: deploy symbol.json + params)."""
+    """Runs an exported symbol.json graph (reference: deploy path —
+    ``SymbolBlock.imports(sym, ['data'], params_file)``)."""
 
-    def __init__(self, outputs=None, inputs=None, params=None):
+    def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="symbolblock_", params=None)
-        self._fn = outputs
+        from .. import symbol as sym_mod
+
+        self._out_symbol = outputs
+        self._input_names = [i.name if isinstance(i, sym_mod.Symbol) else i
+                             for i in (inputs if isinstance(inputs, (list, tuple))
+                                       else [inputs])]
+        arg_names = outputs.list_arguments()
+        for name in arg_names:
+            if name in self._input_names:
+                continue
+            p = Parameter(name, allow_deferred_init=True)
+            self._params._params[name] = p
+            if params and name in params:
+                p.set_data(params[name])
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
-        raise NotImplementedError(
-            "SymbolBlock.imports of reference-format symbol.json graphs lands "
-            "with the symbol executor (mxnet_tpu.symbol); exported "
-            "mxnet_tpu models reload via their Block class + load_parameters")
+        from .. import symbol as sym_mod
+        from ..serialization import load_ndarrays
+
+        out = sym_mod.load(symbol_file)
+        params = {}
+        if param_file:
+            loaded = load_ndarrays(param_file)
+            params = {k.removeprefix("arg:").removeprefix("aux:"): v
+                      for k, v in loaded.items()}
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        return SymbolBlock(out, input_names, params)
+
+    def forward(self, *args):
+        from .. import symbol as sym_mod
+
+        env = dict(zip(self._input_names, args))
+        for name, p in self._params.items():
+            if p._nd is not None:
+                env[name] = p.data()
+        return sym_mod.eval_symbol(self._out_symbol, env)

@@ -6,6 +6,17 @@ from .resnet import (  # noqa: F401
 )
 from .alexnet import AlexNet, alexnet  # noqa: F401
 from .lenet import LeNet, lenet  # noqa: F401
+from .vgg import (  # noqa: F401
+    VGG, vgg11, vgg13, vgg16, vgg19, vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn,
+)
+from .mobilenet import (  # noqa: F401
+    MobileNet, MobileNetV2, mobilenet1_0, mobilenet0_5, mobilenet0_25,
+    mobilenet_v2_1_0, mobilenet_v2_0_5,
+)
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .densenet import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+)
 
 _models = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1, "resnet50_v1": resnet50_v1,
@@ -13,6 +24,14 @@ _models = {
     "resnet18_v2": resnet18_v2, "resnet34_v2": resnet34_v2, "resnet50_v2": resnet50_v2,
     "resnet101_v2": resnet101_v2, "resnet152_v2": resnet152_v2,
     "alexnet": alexnet, "lenet": lenet,
+    "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+    "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn, "vgg19_bn": vgg19_bn,
+    "mobilenet1.0": mobilenet1_0, "mobilenet0.5": mobilenet0_5,
+    "mobilenet0.25": mobilenet0_25, "mobilenetv2_1.0": mobilenet_v2_1_0,
+    "mobilenetv2_0.5": mobilenet_v2_0_5,
+    "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
 }
 
 

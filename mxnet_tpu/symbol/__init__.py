@@ -70,6 +70,12 @@ class Symbol:
                           self._nout, i)
         return self
 
+    def __iter__(self):
+        # tuple-unpacking of multi-output ops: out, mean, var = F.BatchNorm(...)
+        if self._nout > 1:
+            return iter(self[i] for i in range(self._nout))
+        raise TypeError("single-output Symbol is not iterable")
+
     # -- arithmetic ----------------------------------------------------------
     def _bin(self, other, opname, scalar_op, rscalar_op=None):
         if isinstance(other, Symbol):
@@ -88,10 +94,27 @@ class Symbol:
     def __pow__(self, o): return self._bin(o, "power", "_power_scalar")
     def __neg__(self): return _apply("negative", [self], {})
 
-    def reshape(self, shape): return _apply("reshape", [self], {"shape": shape})
-    def transpose(self, axes=None): return _apply("transpose", [self], {"axes": axes})
+    def reshape(self, *shape, **kw):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _apply("reshape", [self], {"shape": shape})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _apply("transpose", [self], {"axes": axes or None})
+
     def sum(self, axis=None, keepdims=False): return _apply("sum", [self], {"axis": axis, "keepdims": keepdims})
     def mean(self, axis=None, keepdims=False): return _apply("mean", [self], {"axis": axis, "keepdims": keepdims})
+    def max(self, axis=None, keepdims=False): return _apply("max", [self], {"axis": axis, "keepdims": keepdims})
+    def flatten(self): return _apply("flatten", [self], {})
+    def expand_dims(self, axis): return _apply("expand_dims", [self], {"axis": axis})
+    def squeeze(self, axis=None): return _apply("squeeze", [self], {"axis": axis})
+    def swapaxes(self, dim1, dim2): return _apply("swapaxes", [self], {"dim1": dim1, "dim2": dim2})
+    def slice_axis(self, axis, begin, end): return _apply("slice_axis", [self], {"axis": axis, "begin": begin, "end": end})
+    def astype(self, dtype): return _apply("cast", [self], {"dtype": str(dtype)})
+    def softmax(self, axis=-1): return _apply("softmax", [self], {"axis": axis})
+    def log_softmax(self, axis=-1): return _apply("log_softmax", [self], {"axis": axis})
 
     def __repr__(self):
         return f"<Symbol {self._name}>"
@@ -334,6 +357,27 @@ def load_json(json_str):
 def load(fname):
     with open(fname) as f:
         return load_json(f.read())
+
+
+def eval_symbol(symbol: Symbol, env: dict):
+    """Evaluate a Symbol graph over NDArray bindings through the imperative
+    invoke path — autograd-recordable, so imported SymbolBlocks fine-tune."""
+    from ..ndarray import NDArray, invoke
+
+    memo = {}
+
+    def ev(s: Symbol):
+        if s._op is None:
+            v = env[s._name]
+            return v if isinstance(v, NDArray) else NDArray(v)
+        key = (s._op, s._name)
+        if key not in memo:
+            ins = tuple(ev(i) for i in s._inputs)
+            out = invoke(_registry.get(s._op), ins, dict(s._kwargs))
+            memo[key] = out if isinstance(out, tuple) else (out,)
+        return memo[key][s._out_index]
+
+    return ev(symbol)
 
 
 class Executor:

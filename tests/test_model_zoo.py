@@ -1,0 +1,22 @@
+"""Model zoo forward shapes (reference: tests/python/unittest/test_gluon_model_zoo.py)."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import gluon, nd
+
+
+@pytest.mark.parametrize("name,size", [
+    ("resnet34_v2", 32), ("vgg11", 32), ("vgg11_bn", 32),
+    ("mobilenet0.25", 32), ("mobilenetv2_0.5", 32),
+    ("squeezenet1.1", 64), ("densenet121", 32), ("alexnet", 224),
+])
+def test_zoo_forward(name, size):
+    net = gluon.model_zoo.get_model(name, classes=11)
+    net.initialize()
+    out = net(nd.ones((1, 3, size, size)))
+    assert out.shape == (1, 11), name
+
+
+def test_zoo_unknown_model():
+    with pytest.raises(ValueError, match="not in zoo"):
+        gluon.model_zoo.get_model("resnext9000")
