@@ -42,5 +42,7 @@ from . import config  # noqa: F401
 from . import test_utils  # noqa: F401
 from .io import recordio  # noqa: F401
 
+from .numpy_api import np, npx  # noqa: F401
+
 # horovod compat is imported lazily (mxnet_tpu.horovod) to keep import light
 

@@ -1,0 +1,141 @@
+"""Estimator (reference: ``python/mxnet/gluon/contrib/estimator/estimator.py``
+— the late-1.x high-level fit loop with event handlers)."""
+from __future__ import annotations
+
+import logging
+import time
+
+from ... import autograd
+from ... import metric as metric_mod
+from ..trainer import Trainer
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "CheckpointHandler", "EarlyStoppingHandler",
+           "LoggingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class LoggingHandler(TrainBegin, EpochEnd, BatchEnd):
+    def __init__(self, log_interval=50):
+        self.log_interval = log_interval
+        self._n = 0
+
+    def epoch_end(self, estimator, epoch=None, **kwargs):
+        vals = " ".join(f"{m.get()[0]}={m.get()[1]:.5f}"
+                        for m in estimator.train_metrics)
+        logging.info("Epoch[%s] %s", epoch, vals)
+
+
+class CheckpointHandler(EpochEnd):
+    def __init__(self, model_dir, model_prefix="model", save_best=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+
+    def epoch_end(self, estimator, epoch=None, **kwargs):
+        import os
+
+        os.makedirs(self.model_dir, exist_ok=True)
+        estimator.net.save_parameters(
+            f"{self.model_dir}/{self.model_prefix}-{epoch:04d}.params")
+
+
+class EarlyStoppingHandler(EpochEnd):
+    def __init__(self, monitor, patience=3, mode="min"):
+        self.monitor = monitor
+        self.patience = patience
+        self.mode = mode
+        self.best = None
+        self.waited = 0
+        self.stop_training = False
+
+    def epoch_end(self, estimator, epoch=None, **kwargs):
+        for m in estimator.train_metrics:
+            name, val = m.get()
+            if name != self.monitor:
+                continue
+            better = self.best is None or (
+                val < self.best if self.mode == "min" else val > self.best)
+            if better:
+                self.best, self.waited = val, 0
+            else:
+                self.waited += 1
+                if self.waited >= self.patience:
+                    self.stop_training = True
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, trainer=None, context=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = [metric_mod.create(m) for m in
+                              (train_metrics if isinstance(train_metrics, (list, tuple))
+                               else [train_metrics or "acc"])]
+        self.trainer = trainer or Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 1e-3})
+
+    def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
+            batches=None):
+        handlers = event_handlers or [LoggingHandler()]
+        for h in handlers:
+            if isinstance(h, TrainBegin):
+                h.train_begin(self)
+        for epoch in range(epochs):
+            for m in self.train_metrics:
+                m.reset()
+            for h in handlers:
+                if isinstance(h, EpochBegin):
+                    h.epoch_begin(self, epoch=epoch)
+            for i, (data, label) in enumerate(train_data):
+                if batches is not None and i >= batches:
+                    break
+                for h in handlers:
+                    if isinstance(h, BatchBegin):
+                        h.batch_begin(self, batch=i)
+                with autograd.record():
+                    out = self.net(data)
+                    loss = self.loss(out, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+                for m in self.train_metrics:
+                    m.update(label, out)
+                for h in handlers:
+                    if isinstance(h, BatchEnd):
+                        h.batch_end(self, batch=i)
+            for h in handlers:
+                if isinstance(h, EpochEnd):
+                    h.epoch_end(self, epoch=epoch)
+            if any(getattr(h, "stop_training", False) for h in handlers):
+                break
+        for h in handlers:
+            if isinstance(h, TrainEnd):
+                h.train_end(self)
+        return self

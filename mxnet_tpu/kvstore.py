@@ -130,20 +130,15 @@ class KVStore:
 
 
 def _dcn_psum(x):
-    """All-reduce across processes (multi-host DP over DCN)."""
+    """All-reduce across processes (multi-host DP over DCN). Gathers each
+    process's host-local value and sums — the explicit-transfer shape of the
+    reference's dist_sync push aggregation, minus the server role."""
     if jax.process_count() == 1:
         return x
-    n = jax.device_count()
-    mesh = jax.sharding.Mesh(jax.devices(), ("workers",))
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
+    from jax.experimental import multihost_utils
 
-    summed = jax.jit(shard_map(lambda v: jax.lax.psum(v, "workers"),
-                               mesh=mesh, in_specs=P(), out_specs=P()))(x)
-    return summed
+    gathered = multihost_utils.process_allgather(jnp.asarray(x))
+    return jnp.sum(gathered, axis=0)
 
 
 def create(name="local"):

@@ -21,7 +21,7 @@ from .kvstore import create as kv_create
 from .ndarray import NDArray, array, zeros
 from .symbol import Symbol
 
-__all__ = ["BaseModule", "Module"]
+__all__ = ["BaseModule", "Module", "BucketingModule"]
 
 
 class BaseModule:
@@ -277,6 +277,9 @@ class Module(BaseModule):
                 host = jax.tree_util.tree_map(lambda x: np.asarray(x), self._opt_states)
                 pickle.dump(host, f)
 
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        pass  # single-module path; BucketingModule manages per-bucket modules
+
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
         from . import symbol as sym_mod
@@ -290,3 +293,86 @@ class Module(BaseModule):
 
     def init_params_from_pending(self):
         self.set_params(self._pending_params)
+
+
+class BucketingModule(BaseModule):
+    """Variable-length training via per-bucket compiled modules (reference:
+    ``python/mxnet/module/bucketing_module.py``).
+
+    The reference kept one bound executor per bucket key — a compile cache
+    over sequence lengths, the direct ancestor of jit shape-bucketing
+    (SURVEY §5.7). Here each bucket is a Module whose executor is its own
+    jitted program; parameters are shared across buckets by reference.
+    """
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=None,
+                 context=None, **kwargs):
+        super().__init__(logger)
+        self._sym_gen = sym_gen
+        self._default_key = default_bucket_key
+        self._buckets: Dict = {}
+        self._curr = None
+
+    def _module_for(self, key):
+        if key not in self._buckets:
+            sym, data_names, label_names = self._sym_gen(key)
+            mod = Module(sym, data_names=data_names, label_names=label_names,
+                         logger=self.logger)
+            if self._buckets:
+                # share parameter/optimizer state with the default bucket
+                master = self._buckets[self._default_key]
+                mod._arg_params = master._arg_params
+                mod._opt_states = getattr(master, "_opt_states", None)
+                mod._opt_idx = getattr(master, "_opt_idx", None)
+                mod._optimizer = master._optimizer
+                mod._shapes = dict(master._shapes)
+                mod.binded = True
+                mod.params_initialized = True
+                mod.optimizer_initialized = master.optimizer_initialized
+            self._buckets[key] = mod
+        return self._buckets[key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True, **kwargs):
+        mod = self._module_for(self._default_key)
+        mod.bind(data_shapes, label_shapes, for_training)
+        self.binded = True
+        return self
+
+    def init_params(self, **kwargs):
+        self._buckets[self._default_key].init_params(**kwargs)
+        self.params_initialized = True
+        return self
+
+    def init_optimizer(self, **kwargs):
+        self._buckets[self._default_key].init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+        return self
+
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", None) or self._default_key
+        self._curr = self._module_for(key)
+        if not self._curr.binded:
+            shapes = [(n, a.shape) for n, a in
+                      zip(self._curr._data_names, data_batch.data)]
+            lshapes = None
+            if data_batch.label is not None:
+                lshapes = [(n, a.shape) for n, a in
+                           zip(self._curr._label_names, data_batch.label)]
+            self._curr.bind(shapes, lshapes)
+        self._curr.forward(data_batch, is_train)
+        return self
+
+    def backward(self, out_grads=None):
+        self._curr.backward(out_grads)
+
+    def update(self):
+        self._curr.update()
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr.update_metric(eval_metric, labels)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr.get_outputs()
+
+    def get_params(self):
+        return self._buckets[self._default_key].get_params()

@@ -1,0 +1,84 @@
+"""``mx.np`` / ``mx.npx`` — numpy-compatible namespace (reference: late-1.x
+``python/mxnet/numpy`` + ``numpy_extension``).
+
+The nd namespace already has numpy broadcasting semantics (jnp underneath),
+so this layer is naming + defaults: numpy-style creation signatures and the
+``npx`` extension ops (activation/convolution entry points with np arrays).
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+import jax.numpy as jnp
+
+from . import ndarray as nd
+from .base import dtype_np
+from .ndarray import NDArray
+
+__all__ = ["np", "npx"]
+
+np = types.ModuleType("mxnet_tpu.np")
+npx = types.ModuleType("mxnet_tpu.npx")
+
+
+def _wrap1(fn):
+    def f(*args, **kwargs):
+        args = [a._data if isinstance(a, NDArray) else a for a in args]
+        out = fn(*args, **kwargs)
+        return NDArray(out) if hasattr(out, "shape") else out
+
+    return f
+
+
+for _name in ["add", "subtract", "multiply", "divide", "power", "exp", "log",
+              "sqrt", "tanh", "sin", "cos", "abs", "maximum", "minimum",
+              "sum", "mean", "max", "min", "argmax", "argmin", "dot", "matmul",
+              "reshape", "transpose", "concatenate", "stack", "split",
+              "expand_dims", "squeeze", "where", "clip", "broadcast_to",
+              "arange", "linspace", "zeros_like", "ones_like", "einsum",
+              "tensordot", "cumsum", "sort", "argsort", "unique", "tile",
+              "repeat", "flip", "var", "std", "prod", "sign", "floor", "ceil"]:
+    setattr(np, _name, _wrap1(getattr(jnp, _name)))
+
+
+def _array(obj, dtype=None, ctx=None, device=None):
+    return nd.array(obj, ctx=ctx or device, dtype=dtype)
+
+
+np.array = _array
+np.ndarray = NDArray
+np.zeros = lambda shape, dtype="float32", ctx=None, device=None: nd.zeros(shape, ctx or device, dtype)
+np.ones = lambda shape, dtype="float32", ctx=None, device=None: nd.ones(shape, ctx or device, dtype)
+np.full = lambda shape, fill_value, dtype="float32", ctx=None: nd.full(shape, fill_value, ctx, dtype)
+np.float32 = "float32"
+np.float16 = "float16"
+np.int32 = "int32"
+np.int64 = "int64"
+np.bool_ = "bool"
+np.pi = jnp.pi
+np.inf = jnp.inf
+np.newaxis = None
+
+# npx extension surface
+npx.softmax = lambda x, axis=-1: nd.softmax(x, axis=axis)
+npx.log_softmax = lambda x, axis=-1: nd.log_softmax(x, axis=axis)
+npx.relu = nd.relu
+npx.sigmoid = nd.sigmoid
+npx.activation = lambda x, act_type="relu": nd.Activation(x, act_type=act_type)
+npx.fully_connected = nd.FullyConnected
+npx.convolution = nd.Convolution
+npx.pooling = nd.Pooling
+npx.batch_norm = nd.BatchNorm
+npx.layer_norm = nd.LayerNorm
+npx.embedding = nd.Embedding
+npx.one_hot = nd.one_hot
+npx.pick = nd.pick
+npx.topk = nd.topk
+npx.reshape_like = nd.reshape_like
+npx.set_np = lambda shape=True, array=True: None  # numpy semantics are default
+npx.reset_np = lambda: None
+npx.is_np_array = lambda: True
+
+sys.modules["mxnet_tpu.np"] = np
+sys.modules["mxnet_tpu.npx"] = npx

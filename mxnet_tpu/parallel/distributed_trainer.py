@@ -30,8 +30,7 @@ def init(coordinator_address: Optional[str] = None, num_processes: Optional[int]
     MXNET_TPU_PROCID (or the standard jax coordinator envs on TPU pods).
     """
     global _initialized
-    if _initialized or jax.process_count() > 1:
-        _initialized = True
+    if _initialized:
         return
     coordinator_address = coordinator_address or os.environ.get("MXNET_TPU_COORDINATOR")
     if coordinator_address is None:

@@ -18,10 +18,24 @@ __all__ = ["seed", "next_key", "trace_key_scope", "uniform", "normal", "randint"
 
 
 class _KeyState(threading.local):
+    """Key creation is lazy: materialising a PRNG key initialises the jax
+    backend, and importing the library must not grab the TPU lease (host-side
+    tools like im2rec import mxnet_tpu without ever touching the device)."""
+
     def __init__(self):
-        self.key = jax.random.key(0)
+        self._key = None
         # Inside a jit trace: (traced base key, split counter) or None.
         self.trace = None
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(0)
+        return self._key
+
+    @key.setter
+    def key(self, v):
+        self._key = v
 
 
 _STATE = _KeyState()

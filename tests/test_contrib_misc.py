@@ -1,0 +1,107 @@
+"""Estimator, BucketingModule, np/npx namespace, image augmenters, im2rec."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, sym
+from mxnet_tpu.gluon import nn
+
+
+def test_estimator_fit():
+    from mxnet_tpu.gluon.contrib import Estimator
+    from mxnet_tpu.gluon.contrib.estimator import CheckpointHandler, LoggingHandler
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    X = np.random.rand(64, 6).astype(np.float32)
+    Y = np.random.randint(0, 3, 64)
+    loader = gluon.data.DataLoader(gluon.data.ArrayDataset(X, Y), batch_size=16)
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(), train_metrics="acc")
+    est.fit(loader, epochs=2)
+    assert est.train_metrics[0].num_inst > 0
+
+
+def test_bucketing_module_shares_params():
+    from mxnet_tpu.io.io import DataBatch
+    from mxnet_tpu.module import BucketingModule
+
+    def sym_gen(seq_len):
+        x = sym.var("data")
+        w = sym.var("w")
+        out = sym.FullyConnected(x, w, None, num_hidden=4, no_bias=True)
+        return sym.sum(out * out), ("data",), ()
+
+    bm = BucketingModule(sym_gen, default_bucket_key=8)
+    bm.bind(data_shapes=[("data", (2, 8))])
+    bm.init_params()
+    bm.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.01})
+
+    b8 = DataBatch([nd.ones((2, 8))], bucket_key=8)
+    bm.forward(b8, is_train=True)
+    bm.backward()
+    bm.update()
+    # note: buckets with different feature dims need distinct params; this
+    # checks the cache returns per-key modules sharing state for same shapes
+    bm.forward(b8, is_train=False)
+    out = bm.get_outputs()[0]
+    assert np.isfinite(out.asnumpy()).all()
+    assert len(bm._buckets) == 1
+
+
+def test_np_namespace():
+    from mxnet_tpu import np as mnp, npx
+
+    a = mnp.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mnp.ones((2, 2))
+    c = mnp.matmul(a, b)
+    np.testing.assert_allclose(c.asnumpy(), [[3, 3], [7, 7]])
+    s = npx.softmax(a)
+    assert abs(float(s.sum().asnumpy()) - 2.0) < 1e-5
+    assert mnp.zeros((2, 3)).shape == (2, 3)
+
+
+def test_image_augmenters():
+    from mxnet_tpu import image
+
+    img = nd.array((np.random.rand(40, 50, 3) * 255).astype(np.uint8))
+    r = image.resize_short(img, 32)
+    assert min(r.shape[:2]) == 32
+    c, _ = image.center_crop(r, (24, 24))
+    assert c.shape[:2] == (24, 24)
+    augs = image.CreateAugmenter((3, 24, 24), resize=28, rand_crop=True,
+                                 rand_mirror=True, mean=np.zeros(3, np.float32))
+    out = img
+    for aug in augs:
+        out = aug(out)
+    assert out.shape[:2] == (24, 24)
+
+
+def test_im2rec_roundtrip(tmp_path):
+    import subprocess
+    import sys
+
+    root = tmp_path / "imgs"
+    root.mkdir()
+    lst = tmp_path / "data.lst"
+    rows = []
+    for i in range(3):
+        arr = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+        np.save(root / f"im{i}.npy", arr)  # no PIL: files read raw
+        rows.append(f"{i}\t{i % 2}\t" + f"im{i}.npy")
+    lst.write_text("\n".join(rows) + "\n")
+    prefix = str(tmp_path / "pack")
+    import os
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")  # host tool: never touch TPU
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # skip axon PJRT registration entirely
+    res = subprocess.run([sys.executable, "tools/im2rec.py", prefix, str(root),
+                          "--list", str(lst)], capture_output=True, text=True,
+                         env=env)
+    assert res.returncode == 0, res.stderr
+    from mxnet_tpu.io.recordio import IndexedRecordIO, unpack
+
+    rec = IndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(rec.keys) == 3
+    header, _ = unpack(rec.read_idx(1))
+    assert header.label == 1.0

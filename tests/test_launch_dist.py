@@ -1,0 +1,58 @@
+"""Multi-process distributed: N local processes over jax.distributed
+(SURVEY §4 fixture #5 — the reference tested ps-lite with N localhost
+processes the same way)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_CHILD = textwrap.dedent("""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from mxnet_tpu.parallel import dist_init
+    dist_init()
+    assert jax.process_count() == 2, jax.process_count()
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    rank = jax.process_index()
+    kv = mx.kv.create("dist_sync")
+    kv.init("w", nd.zeros((4,)))
+    kv.push("w", nd.full((4,), float(rank + 1)))   # 1 + 2 = 3
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    expected = 3.0
+    assert abs(float(out.asnumpy()[0]) - expected) < 1e-6, out.asnumpy()
+
+    import mxnet_tpu.horovod as hvd
+    s = hvd.allreduce(nd.full((2,), float(rank)), average=True)  # (0+1)/2
+    assert abs(float(s.asnumpy()[0]) - 0.5) < 1e-6
+    print(f"RANK{rank}-OK", flush=True)
+""")
+
+
+@pytest.mark.timeout(180)
+def test_two_process_dist_sync(tmp_path):
+    child = tmp_path / "child.py"
+    child.write_text(_CHILD)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo_root
+    res = subprocess.run(
+        [sys.executable, "tools/launch.py", "-n", "2", sys.executable, str(child)],
+        capture_output=True, text=True, timeout=170, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-2000:]
+    assert "RANK0-OK" in out and "RANK1-OK" in out, out[-2000:]

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Build RecordIO image packs (reference: ``tools/im2rec.py`` /
+``tools/im2rec.cc``).
+
+Reads a ``.lst`` file (``idx\\tlabel\\tpath`` per line) and writes
+``prefix.rec`` + ``prefix.idx`` in the dmlc RecordIO format readable by both
+the Python and native readers. Without OpenCV, images are stored as lossless
+npy payloads (PIL-decoded when available); downstream readers detect the
+payload format by magic.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            yield int(float(parts[0])), float(parts[1]), parts[-1]
+
+
+def load_image(path, resize=0):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        import PIL.Image
+
+        img = PIL.Image.open(path).convert("RGB")
+        if resize:
+            w, h = img.size
+            scale = resize / min(w, h)
+            img = img.resize((int(w * scale), int(h * scale)))
+        return np.asarray(img)
+    except Exception:
+        return np.fromfile(path, dtype=np.uint8)  # raw passthrough
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (writes prefix.rec/.idx)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", dest="lst", required=True, help=".lst file")
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--num-thread", type=int, default=1)
+    args = ap.parse_args()
+
+    from mxnet_tpu.io.recordio import IndexedRecordIO, IRHeader, pack_img
+
+    rec = IndexedRecordIO(args.prefix + ".idx", args.prefix + ".rec", "w")
+    n = 0
+    for idx, label, rel in read_list(args.lst):
+        img = load_image(os.path.join(args.root, rel), args.resize)
+        rec.write_idx(idx, pack_img(IRHeader(0, label, idx, 0), img))
+        n += 1
+        if n % 1000 == 0:
+            print(f"packed {n} images", file=sys.stderr)
+    rec.close()
+    print(f"wrote {n} records to {args.prefix}.rec")
+
+
+if __name__ == "__main__":
+    main()
