@@ -49,16 +49,39 @@ class LoggingHandler(TrainBegin, EpochEnd, BatchEnd):
         self.log_interval = log_interval
         self._n = 0
 
+    def batch_end(self, estimator, batch=None, **kwargs):
+        self._n += 1
+        if self.log_interval and self._n % self.log_interval == 0:
+            vals = " ".join(f"{m.get()[0]}={m.get()[1]:.5f}"
+                            for m in estimator.train_metrics)
+            logging.info("Batch[%s] %s", batch, vals)
+
     def epoch_end(self, estimator, epoch=None, **kwargs):
         vals = " ".join(f"{m.get()[0]}={m.get()[1]:.5f}"
                         for m in estimator.train_metrics)
+        if estimator.val_metrics:
+            vals += " " + " ".join(f"val_{m.get()[0]}={m.get()[1]:.5f}"
+                                   for m in estimator.val_metrics)
         logging.info("Epoch[%s] %s", epoch, vals)
 
 
 class CheckpointHandler(EpochEnd):
-    def __init__(self, model_dir, model_prefix="model", save_best=False):
+    def __init__(self, model_dir, model_prefix="model", save_best=False,
+                 monitor=None, mode="max"):
         self.model_dir = model_dir
         self.model_prefix = model_prefix
+        self.save_best = save_best
+        self.monitor = monitor  # default: first val metric, else first train
+        self.mode = mode
+        self.best = None
+
+    def _monitored_value(self, estimator):
+        metrics = estimator.val_metrics or estimator.train_metrics
+        for m in metrics:
+            name, val = m.get()
+            if self.monitor is None or name == self.monitor:
+                return val
+        return None
 
     def epoch_end(self, estimator, epoch=None, **kwargs):
         import os
@@ -66,6 +89,14 @@ class CheckpointHandler(EpochEnd):
         os.makedirs(self.model_dir, exist_ok=True)
         estimator.net.save_parameters(
             f"{self.model_dir}/{self.model_prefix}-{epoch:04d}.params")
+        if self.save_best:
+            val = self._monitored_value(estimator)
+            better = val is not None and (self.best is None or (
+                val > self.best if self.mode == "max" else val < self.best))
+            if better:
+                self.best = val
+                estimator.net.save_parameters(
+                    f"{self.model_dir}/{self.model_prefix}-best.params")
 
 
 class EarlyStoppingHandler(EpochEnd):
@@ -93,14 +124,33 @@ class EarlyStoppingHandler(EpochEnd):
 
 
 class Estimator:
-    def __init__(self, net, loss, train_metrics=None, trainer=None, context=None):
+    def __init__(self, net, loss, train_metrics=None, trainer=None, context=None,
+                 val_metrics=None):
         self.net = net
         self.loss = loss
-        self.train_metrics = [metric_mod.create(m) for m in
-                              (train_metrics if isinstance(train_metrics, (list, tuple))
-                               else [train_metrics or "acc"])]
+        specs = (train_metrics if isinstance(train_metrics, (list, tuple))
+                 else [train_metrics or "acc"])
+        self.train_metrics = [metric_mod.create(m) for m in specs]
+        if val_metrics is not None:
+            self.val_metrics = [metric_mod.create(m) for m in val_metrics]
+        else:  # fresh instances so val accumulation never aliases train
+            self.val_metrics = [metric_mod.create(m) if isinstance(m, str)
+                                else type(metric_mod.create(m))()
+                                for m in specs]
         self.trainer = trainer or Trainer(net.collect_params(), "adam",
                                           {"learning_rate": 1e-3})
+
+    def evaluate(self, val_data, batches=None):
+        """Run the validation loop, updating ``self.val_metrics``."""
+        for m in self.val_metrics:
+            m.reset()
+        for i, (data, label) in enumerate(val_data):
+            if batches is not None and i >= batches:
+                break
+            out = self.net(data)
+            for m in self.val_metrics:
+                m.update(label, out)
+        return {m.get()[0]: m.get()[1] for m in self.val_metrics}
 
     def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
             batches=None):
@@ -130,6 +180,8 @@ class Estimator:
                 for h in handlers:
                     if isinstance(h, BatchEnd):
                         h.batch_end(self, batch=i)
+            if val_data is not None:
+                self.evaluate(val_data, batches=batches)
             for h in handlers:
                 if isinstance(h, EpochEnd):
                     h.epoch_end(self, epoch=epoch)

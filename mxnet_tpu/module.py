@@ -318,16 +318,16 @@ class BucketingModule(BaseModule):
             sym, data_names, label_names = self._sym_gen(key)
             mod = Module(sym, data_names=data_names, label_names=label_names,
                          logger=self.logger)
-            if self._buckets:
-                # share parameter/optimizer state with the default bucket
+            if self._default_key in self._buckets and key != self._default_key:
+                # share parameter/optimizer state with the default bucket by
+                # reference; the bucket still binds itself (in forward) so it
+                # gets its own shapes/_for_training instead of the master's
                 master = self._buckets[self._default_key]
                 mod._arg_params = master._arg_params
                 mod._opt_states = getattr(master, "_opt_states", None)
                 mod._opt_idx = getattr(master, "_opt_idx", None)
                 mod._optimizer = master._optimizer
-                mod._shapes = dict(master._shapes)
-                mod.binded = True
-                mod.params_initialized = True
+                mod.params_initialized = master.params_initialized
                 mod.optimizer_initialized = master.optimizer_initialized
             self._buckets[key] = mod
         return self._buckets[key]

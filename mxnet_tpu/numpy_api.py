@@ -22,11 +22,16 @@ np = types.ModuleType("mxnet_tpu.np")
 npx = types.ModuleType("mxnet_tpu.npx")
 
 
+def _wrap_out(out):
+    if isinstance(out, (list, tuple)):  # e.g. split, unique w/ extras
+        return type(out)(_wrap_out(o) for o in out)
+    return NDArray(out) if hasattr(out, "shape") else out
+
+
 def _wrap1(fn):
     def f(*args, **kwargs):
         args = [a._data if isinstance(a, NDArray) else a for a in args]
-        out = fn(*args, **kwargs)
-        return NDArray(out) if hasattr(out, "shape") else out
+        return _wrap_out(fn(*args, **kwargs))
 
     return f
 

@@ -1,0 +1,354 @@
+"""Contrib vision/detection operators.
+
+Covers the reference's ``src/operator/contrib/`` detection kernels
+(``roi_align.cc``, ``multibox_prior.cc``, ``multibox_detection.cc``,
+``bounding_box.cc`` (box_nms/box_iou), ``boolean_mask.cc``,
+``deformable_convolution.cc``) as jax compositions.
+
+TPU design notes:
+  - ROIAlign / DeformableConvolution are gather + bilinear-blend programs:
+    the sampling coordinates are computed vectorised, the 4-corner gathers
+    become XLA ``gather`` ops, and the final reduction/matmul lands on the
+    MXU. No per-ROI CUDA thread loops.
+  - box_nms keeps a *static* output shape (scores of suppressed boxes set to
+    -1, matching MXNet's convention) so it stays jit-compatible; the
+    suppression loop is a ``lax.fori_loop`` over the topk boxes.
+  - boolean_mask is inherently dynamic-shaped; it executes eagerly (returns
+    a host-sized result) exactly like the reference's CPU-sync op did.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..registry import alias, register
+
+
+# --------------------------------------------------------------------------
+# bilinear sampling helper (shared by ROIAlign / DeformableConvolution)
+# --------------------------------------------------------------------------
+def _bilinear_gather(feat, y, x):
+    """Sample feat[C,H,W] at fractional (y, x) grids of identical shape.
+
+    Out-of-range samples contribute 0, matching the reference kernels'
+    boundary handling (roi_align.cc bilinear_interpolate).
+    """
+    C, H, W = feat.shape
+    valid = (y > -1.0) & (y < H) & (x > -1.0) & (x < W)
+    y = jnp.clip(y, 0.0, H - 1)
+    x = jnp.clip(x, 0.0, W - 1)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    ly, lx = y - y0, x - x0
+    hy, hx = 1.0 - ly, 1.0 - lx
+    # flatten spatial for a single gather per corner
+    flat = feat.reshape(C, H * W)
+
+    def take(yi, xi):
+        idx = (yi * W + xi).reshape(-1)
+        return flat[:, idx].reshape((C,) + y.shape)
+
+    val = (take(y0, x0) * (hy * hx) + take(y0, x1) * (hy * lx)
+           + take(y1, x0) * (ly * hx) + take(y1, x1) * (ly * lx))
+    return val * valid.astype(feat.dtype)
+
+
+# --------------------------------------------------------------------------
+# ROIAlign (reference: src/operator/contrib/roi_align.cc ROIAlignForward)
+# --------------------------------------------------------------------------
+@register("_contrib_ROIAlign")
+def roi_align(data, rois, pooled_size=None, spatial_scale=1.0, sample_ratio=-1,
+              position_sensitive=False, aligned=False):
+    """ROI Align. data: (N,C,H,W); rois: (R,5) [batch_idx, x1, y1, x2, y2].
+
+    ``position_sensitive=True`` gives PSROIAlign (R-FCN): channel
+    ``c*ph*pw + bin`` feeds output channel ``c`` at that bin.
+
+    Sampling-grid deviation from the reference: ``sample_ratio <= 0`` uses a
+    static upper-bound grid ``ceil(H/pooled_h) x ceil(W/pooled_w)`` for every
+    ROI instead of the reference's per-ROI adaptive count — XLA needs static
+    shapes, and over-sampling an average only refines it.
+    """
+    pooled_h, pooled_w = (int(pooled_size[0]), int(pooled_size[1]))
+    N, C, H, W = data.shape
+    rois = rois.astype(data.dtype)
+    offset = 0.5 if aligned else 0.0
+    if int(sample_ratio) > 0:
+        sr_h = sr_w = int(sample_ratio)
+    else:
+        sr_h = max(1, -(-H // pooled_h))
+        sr_w = max(1, -(-W // pooled_w))
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = [roi[i] * spatial_scale - offset for i in range(1, 5)]
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        bin_h = rh / pooled_h
+        bin_w = rw / pooled_w
+        # sr_h x sr_w sample grid per output bin
+        py = jnp.arange(pooled_h, dtype=data.dtype)
+        px = jnp.arange(pooled_w, dtype=data.dtype)
+        sy = (jnp.arange(sr_h, dtype=data.dtype) + 0.5) / sr_h
+        sx = (jnp.arange(sr_w, dtype=data.dtype) + 0.5) / sr_w
+        ys = y1 + (py[:, None] + sy[None, :]) * bin_h        # (ph, sr_h)
+        xs = x1 + (px[:, None] + sx[None, :]) * bin_w        # (pw, sr_w)
+        yg = jnp.broadcast_to(ys[:, None, :, None], (pooled_h, pooled_w, sr_h, sr_w))
+        xg = jnp.broadcast_to(xs[None, :, None, :], (pooled_h, pooled_w, sr_h, sr_w))
+        feat = data[bidx]  # (C,H,W) — dynamic batch index gather
+        vals = _bilinear_gather(feat, yg, xg)                # (C, ph, pw, sr_h, sr_w)
+        vals = vals.mean(axis=(-1, -2))                      # (C, ph, pw)
+        if position_sensitive:
+            cout = C // (pooled_h * pooled_w)
+            vals = vals.reshape(cout, pooled_h, pooled_w, pooled_h, pooled_w)
+            # output channel c, bin (i,j) reads input channel c*ph*pw + i*pw + j
+            vals = jnp.einsum("cijij->cij", vals)
+        return vals
+
+    out = jax.vmap(one_roi)(rois)                            # (R, C', ph, pw)
+    # invalid rois (batch_idx < 0) produce zeros, per reference semantics
+    keep = (rois[:, 0] >= 0).astype(data.dtype)[:, None, None, None]
+    return out * keep
+
+
+# --------------------------------------------------------------------------
+# DeformableConvolution (reference: contrib/deformable_convolution.cc)
+# --------------------------------------------------------------------------
+@register("_contrib_DeformableConvolution")
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=None, num_group=1,
+                           num_deformable_group=1, no_bias=False):
+    """Deformable conv v1: sampling grid displaced by a learned offset map.
+
+    data (N,C,H,W); offset (N, 2*dg*kh*kw, OH, OW) ordered (dg, kh, kw, [y,x])
+    as in the reference kernel; weight (O, C/g, kh, kw).
+    """
+    N, C, H, W = data.shape
+    kh, kw = int(kernel[0]), int(kernel[1])
+    sh, sw = int(stride[0]), int(stride[1])
+    dh, dw = int(dilate[0]), int(dilate[1])
+    ph, pw = int(pad[0]), int(pad[1])
+    OH = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    OW = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    dg = int(num_deformable_group)
+    O = int(num_filter) if num_filter else weight.shape[0]
+    g = int(num_group)
+
+    base_y = (jnp.arange(OH) * sh - ph).astype(data.dtype)       # (OH,)
+    base_x = (jnp.arange(OW) * sw - pw).astype(data.dtype)       # (OW,)
+    ky = (jnp.arange(kh) * dh).astype(data.dtype)                # (kh,)
+    kx = (jnp.arange(kw) * dw).astype(data.dtype)                # (kw,)
+
+    off = offset.reshape(N, dg, kh, kw, 2, OH, OW)
+
+    def one_image(img, offs):
+        # sampling positions: (dg, kh, kw, OH, OW)
+        yy = (base_y[None, None, None, :, None] + ky[None, :, None, None, None]
+              + offs[:, :, :, 0])
+        xx = (base_x[None, None, None, None, :] + kx[None, None, :, None, None]
+              + offs[:, :, :, 1])
+        cg = C // dg  # channels per deformable group
+
+        def sample_group(d):
+            feat = lax.dynamic_slice_in_dim(img, d * cg, cg, axis=0)
+            return _bilinear_gather(feat, yy[d], xx[d])          # (cg,kh,kw,OH,OW)
+
+        cols = jnp.concatenate([sample_group(d) for d in range(dg)], axis=0)
+        return cols                                               # (C,kh,kw,OH,OW)
+
+    cols = jax.vmap(one_image)(data, off)                         # (N,C,kh,kw,OH,OW)
+    # grouped matmul on the MXU: (O, C/g*kh*kw) x (N, C/g*kh*kw, OH*OW)
+    cols = cols.reshape(N, g, (C // g) * kh * kw, OH * OW)
+    wmat = weight.reshape(g, O // g, (C // g) * kh * kw)
+    out = jnp.einsum("gok,ngkp->ngop", wmat, cols).reshape(N, O, OH, OW)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, O, 1, 1)
+    return out
+
+
+# --------------------------------------------------------------------------
+# MultiBoxPrior (reference: contrib/multibox_prior.cc)
+# --------------------------------------------------------------------------
+@register("_contrib_MultiBoxPrior")
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor box generation. data: (N,C,H,W) → (1, H*W*A, 4) corner boxes.
+
+    Widths carry the reference's ``in_h/in_w`` aspect correction
+    (multibox_prior.cc: ``w = size * in_h / in_w * sqrt(ratio)``) so that
+    ratio-1 anchors are square in pixel space on non-square feature maps.
+    """
+    H, W = data.shape[2], data.shape[3]
+    sizes = tuple(float(s) for s in sizes)
+    ratios = tuple(float(r) for r in ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + float(offsets[0])) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + float(offsets[1])) * step_x
+    # MXNet: num_anchors = len(sizes) + len(ratios) - 1
+    # (all sizes with ratios[0], then sizes[0] with ratios[1:])
+    ar = H / W  # in_h / in_w aspect correction on widths
+    whs = [(s * ar * np.sqrt(ratios[0]), s / np.sqrt(ratios[0])) for s in sizes]
+    whs += [(sizes[0] * ar * np.sqrt(r), sizes[0] / np.sqrt(r)) for r in ratios[1:]]
+    wh = jnp.asarray(whs, jnp.float32)                           # (A, 2)
+    A = wh.shape[0]
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")               # (H, W)
+    centers = jnp.stack([cxg, cyg], -1)[:, :, None, :]           # (H,W,1,2)
+    half = wh[None, None, :, :] / 2.0                            # (1,1,A,2)
+    boxes = jnp.concatenate([centers - half, centers + half], -1)  # (H,W,A,4)
+    boxes = boxes.reshape(1, H * W * A, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+# --------------------------------------------------------------------------
+# box_iou / box_nms (reference: contrib/bounding_box.cc)
+# --------------------------------------------------------------------------
+def _pairwise_iou(lhs, rhs, fmt="corner"):
+    if fmt == "center":
+        def to_corner(b):
+            cx, cy, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+            return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        lhs, rhs = to_corner(lhs), to_corner(rhs)
+    tl = jnp.maximum(lhs[..., :, None, :2], rhs[..., None, :, :2])
+    br = jnp.minimum(lhs[..., :, None, 2:], rhs[..., None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_l = ((lhs[..., 2] - lhs[..., 0]) * (lhs[..., 3] - lhs[..., 1]))
+    area_r = ((rhs[..., 2] - rhs[..., 0]) * (rhs[..., 3] - rhs[..., 1]))
+    union = area_l[..., :, None] + area_r[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("_contrib_box_iou")
+def box_iou(lhs, rhs, format="corner"):
+    return _pairwise_iou(lhs, rhs, fmt=format)
+
+
+@register("_contrib_box_nms")
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    """Static-shape NMS: suppressed boxes get score -1 (MXNet convention).
+
+    data: (..., N, K) rows [id?, score, x1, y1, x2, y2, ...].
+    """
+    batched = data.ndim == 3
+    if not batched:
+        data = data[None]
+
+    cs, si, ii = int(coord_start), int(score_index), int(id_index)
+
+    def one(rows):
+        N = rows.shape[0]
+        scores = rows[:, si]
+        valid = scores > valid_thresh
+        if ii >= 0 and background_id >= 0:
+            valid &= rows[:, ii] != background_id
+        order = jnp.argsort(jnp.where(valid, -scores, jnp.inf))
+        k = N if topk < 0 else min(int(topk), N)
+        boxes = rows[order, cs:cs + 4]
+        ious = _pairwise_iou(boxes, boxes, fmt=in_format)
+        same_cls = (jnp.ones((N, N), bool) if (force_suppress or ii < 0)
+                    else rows[order, ii][:, None] == rows[order, ii][None, :])
+        svalid = valid[order]
+
+        def body(i, keep):
+            sup = (ious[i] > overlap_thresh) & same_cls[i] & keep[i] & svalid[i]
+            sup = sup.at[i].set(False)
+            sup = sup & (jnp.arange(N) > i)
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, k, body, svalid)
+        keep = keep & (jnp.arange(N) < k) & svalid
+        new_scores = jnp.where(keep, rows[order, si], -1.0)
+        out_rows = rows[order].at[:, si].set(new_scores)
+        if in_format != out_format:
+            b = out_rows[:, cs:cs + 4]
+            if out_format == "corner":   # center (x,y,w,h) → corner
+                x, y, w, h = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+                b = jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], -1)
+            else:                        # corner → center
+                x1_, y1_, x2_, y2_ = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+                b = jnp.stack([(x1_ + x2_) / 2, (y1_ + y2_) / 2,
+                               x2_ - x1_, y2_ - y1_], -1)
+            out_rows = out_rows.at[:, cs:cs + 4].set(b)
+        return out_rows
+
+    out = jax.vmap(one)(data)
+    return out if batched else out[0]
+
+
+# --------------------------------------------------------------------------
+# MultiBoxDetection (reference: contrib/multibox_detection.cc)
+# --------------------------------------------------------------------------
+@register("_contrib_MultiBoxDetection")
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """Decode SSD predictions → (N, num_anchors, 6) rows [cls, score, 4 box].
+
+    cls_prob (N, num_classes, A), loc_pred (N, A*4), anchor (1, A, 4 corner).
+    """
+    N, _, A = cls_prob.shape
+    loc = loc_pred.reshape(N, A, 4)
+    anc = anchor.reshape(A, 4)
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    v = variances
+    cx = loc[..., 0] * v[0] * aw + acx
+    cy = loc[..., 1] * v[1] * ah + acy
+    w = jnp.exp(loc[..., 2] * v[2]) * aw / 2
+    h = jnp.exp(loc[..., 3] * v[3]) * ah / 2
+    boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], -1)       # (N, A, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    # best non-background class per anchor
+    fg = jnp.concatenate([cls_prob[:, :background_id],
+                          cls_prob[:, background_id + 1:]], axis=1)
+    cls_id = jnp.argmax(fg, axis=1).astype(cls_prob.dtype)        # (N, A)
+    score = jnp.max(fg, axis=1)
+    cls_id = jnp.where(score > threshold, cls_id, -1.0)
+    score = jnp.where(score > threshold, score, -1.0)
+    rows = jnp.concatenate([cls_id[..., None], score[..., None], boxes], -1)
+    return box_nms(rows, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                   force_suppress=force_suppress)
+
+
+# --------------------------------------------------------------------------
+# boolean_mask (reference: contrib/boolean_mask.cc — dynamic shape, CPU sync)
+# boolean_mask itself lives in ops/core.py; expose the contrib name too.
+# --------------------------------------------------------------------------
+alias("boolean_mask", "_contrib_boolean_mask")
+
+
+@register("_contrib_index_array")
+def index_array(data, axes=None):
+    """Per-element index coordinates: output shape data.shape + (len(axes),).
+
+    Matches reference contrib/index_array.cc semantics: the grid always spans
+    the FULL data shape; ``axes`` only selects which coordinates are emitted.
+    (Deviation: int32 output — jax runs with x64 disabled; the reference
+    emits int64.)
+    """
+    shape = data.shape
+    axes = tuple(range(len(shape))) if axes is None else tuple(int(a) for a in axes)
+    grids = jnp.meshgrid(*[jnp.arange(n) for n in shape], indexing="ij")
+    return jnp.stack([grids[a] for a in axes], axis=-1).astype(jnp.int32)
+
+
+@register("_contrib_getnnz")
+def getnnz(data, axis=None):
+    return jnp.sum((data != 0).astype(jnp.int32), axis=axis)

@@ -36,6 +36,9 @@ def init(coordinator_address: Optional[str] = None, num_processes: Optional[int]
     if coordinator_address is None:
         _initialized = True  # single process
         return
+    if jax.distributed.is_initialized():
+        _initialized = True  # someone (pod runtime, user) already bootstrapped
+        return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes or int(os.environ.get("MXNET_TPU_NPROC", "1")),

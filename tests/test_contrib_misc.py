@@ -22,6 +22,59 @@ def test_estimator_fit():
     assert est.train_metrics[0].num_inst > 0
 
 
+def test_estimator_validation_and_save_best(tmp_path):
+    from mxnet_tpu.gluon.contrib import Estimator
+    from mxnet_tpu.gluon.contrib.estimator import CheckpointHandler
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    rs = np.random.RandomState(0)
+    X = rs.rand(32, 6).astype(np.float32)
+    Y = rs.randint(0, 3, 32)
+    loader = gluon.data.DataLoader(gluon.data.ArrayDataset(X, Y), batch_size=16)
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(), train_metrics="acc")
+    ckpt = CheckpointHandler(str(tmp_path), save_best=True)
+    est.fit(loader, val_data=loader, epochs=2, event_handlers=[ckpt])
+    # validation actually ran and best checkpoint was written
+    assert est.val_metrics[0].num_inst > 0
+    assert est.val_metrics[0] is not est.train_metrics[0]
+    assert (tmp_path / "model-best.params").exists()
+
+
+def test_bucketing_module_nondefault_bucket_forward():
+    from mxnet_tpu.io.io import DataBatch
+    from mxnet_tpu.module import BucketingModule
+
+    def sym_gen(seq_len):
+        x = sym.var("data")
+        w = sym.var("w")
+        out = sym.FullyConnected(x, w, None, num_hidden=4, no_bias=True)
+        return sym.sum(out * out), ("data",), ()
+
+    bm = BucketingModule(sym_gen, default_bucket_key=8)
+    bm.bind(data_shapes=[("data", (2, 8))])
+    bm.init_params()
+    bm.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.01})
+    bm.forward(DataBatch([nd.ones((2, 8))], bucket_key=8), is_train=True)
+    # a shared non-default bucket must bind itself with its own shapes and
+    # forward cleanly with is_train omitted (regression: used to crash on
+    # the unset _for_training of a never-bound shared module)
+    bm.forward(DataBatch([nd.ones((2, 8)) * 2.0], bucket_key=16))
+    out = bm.get_outputs()[0]
+    assert np.isfinite(out.asnumpy()).all()
+    assert len(bm._buckets) == 2
+    assert bm._buckets[16]._arg_params is bm._buckets[8]._arg_params
+
+
+def test_np_split_returns_ndarrays():
+    from mxnet_tpu import np as mnp
+
+    parts = mnp.split(mnp.ones((4, 2)), 2)
+    assert len(parts) == 2
+    assert all(p.asnumpy().shape == (2, 2) for p in parts)
+
+
 def test_bucketing_module_shares_params():
     from mxnet_tpu.io.io import DataBatch
     from mxnet_tpu.module import BucketingModule

@@ -1,0 +1,189 @@
+"""Contrib detection/vision ops vs numpy oracles.
+
+Mirrors the reference's tests/python/unittest/test_contrib_operator.py
+(ROIAlign, MultiBoxPrior, box_nms/box_iou, boolean_mask) style: forward vs a
+straightforward numpy reimplementation.
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_roi_align_whole_image_identity_mean():
+    # A ROI covering exactly one pixel bin reproduces that pixel.
+    data = np.arange(2 * 3 * 8 * 8, dtype=np.float32).reshape(2, 3, 8, 8)
+    rois = np.array([[0, 0, 0, 7, 7], [1, 2, 2, 6, 6]], np.float32)
+    out = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(4, 4), spatial_scale=1.0,
+                              sample_ratio=2).asnumpy()
+    assert out.shape == (2, 3, 4, 4)
+    # constant-feature invariance: sampling a constant map returns the constant
+    const = np.full((1, 1, 8, 8), 3.5, np.float32)
+    roi = np.array([[0, 1, 1, 6, 6]], np.float32)
+    out2 = nd.contrib.ROIAlign(nd.array(const), nd.array(roi),
+                               pooled_size=(3, 3), spatial_scale=1.0,
+                               sample_ratio=2).asnumpy()
+    assert_almost_equal(out2, np.full((1, 1, 3, 3), 3.5), rtol=1e-5, atol=1e-5)
+
+
+def test_roi_align_negative_batch_idx_zeroed():
+    data = np.random.RandomState(0).rand(1, 2, 6, 6).astype(np.float32)
+    rois = np.array([[-1, 0, 0, 5, 5]], np.float32)
+    out = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    assert np.all(out == 0)
+
+
+def test_multibox_prior_counts_and_range():
+    x = nd.zeros((1, 3, 4, 5))
+    clipped = nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2),
+                                       clip=True).asnumpy()
+    # num anchors per pixel = len(sizes) + len(ratios) - 1 = 3
+    assert clipped.shape == (1, 4 * 5 * 3, 4)
+    assert clipped.min() >= 0.0 and clipped.max() <= 1.0
+    out = nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2)).asnumpy()
+    # center of the first anchor at pixel (0,0): offsets 0.5 → (0.1, 0.125)
+    b = out[0, 0]
+    cx, cy = (b[0] + b[2]) / 2, (b[1] + b[3]) / 2
+    assert_almost_equal(np.array([cx, cy]), np.array([0.5 / 5, 0.5 / 4]),
+                        rtol=1e-5, atol=1e-5)
+    # width carries the in_h/in_w aspect correction: 0.5 * 4/5
+    assert_almost_equal(np.array([b[2] - b[0], b[3] - b[1]]),
+                        np.array([0.5 * 4 / 5, 0.5]), rtol=1e-5, atol=1e-5)
+
+
+def test_box_iou_oracle():
+    rs = np.random.RandomState(1)
+    a = rs.rand(5, 2).astype(np.float32)
+    lhs = np.concatenate([a, a + rs.rand(5, 2).astype(np.float32)], axis=1)
+    b = rs.rand(7, 2).astype(np.float32)
+    rhs = np.concatenate([b, b + rs.rand(7, 2).astype(np.float32)], axis=1)
+    out = nd.contrib.box_iou(nd.array(lhs), nd.array(rhs)).asnumpy()
+
+    def iou(p, q):
+        tl = np.maximum(p[:2], q[:2])
+        br = np.minimum(p[2:], q[2:])
+        wh = np.maximum(br - tl, 0)
+        inter = wh[0] * wh[1]
+        u = ((p[2] - p[0]) * (p[3] - p[1]) + (q[2] - q[0]) * (q[3] - q[1])
+             - inter)
+        return inter / u if u > 0 else 0.0
+
+    ref = np.array([[iou(p, q) for q in rhs] for p in lhs], np.float32)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_box_nms_suppresses_overlaps():
+    # three boxes: two heavily overlapping, one distinct
+    rows = np.array([
+        [0, 0.9, 0.0, 0.0, 0.5, 0.5],
+        [0, 0.8, 0.01, 0.01, 0.5, 0.5],   # suppressed by row 0
+        [0, 0.7, 0.6, 0.6, 0.9, 0.9],
+    ], np.float32)
+    out = nd.contrib.box_nms(nd.array(rows), overlap_thresh=0.5).asnumpy()
+    scores = sorted(out[:, 1].tolist(), reverse=True)
+    assert scores[0] == pytest.approx(0.9)
+    assert scores[1] == pytest.approx(0.7)
+    assert scores[2] == -1.0
+
+
+def test_box_nms_class_aware():
+    # same overlap but different class ids → both survive w/o force_suppress
+    rows = np.array([
+        [0, 0.9, 0.0, 0.0, 0.5, 0.5],
+        [1, 0.8, 0.01, 0.01, 0.5, 0.5],
+    ], np.float32)
+    out = nd.contrib.box_nms(nd.array(rows), overlap_thresh=0.5,
+                             id_index=0).asnumpy()
+    assert (out[:, 1] > 0).sum() == 2
+    out_f = nd.contrib.box_nms(nd.array(rows), overlap_thresh=0.5, id_index=0,
+                               force_suppress=True).asnumpy()
+    assert (out_f[:, 1] > 0).sum() == 1
+
+
+def test_multibox_detection_decodes():
+    # one anchor, zero offsets → decoded box == anchor, class argmax picked
+    cls_prob = np.array([[[0.1], [0.2], [0.7]]], np.float32)  # (1, 3 cls, 1 A)
+    loc_pred = np.zeros((1, 4), np.float32)
+    anchor = np.array([[[0.2, 0.2, 0.6, 0.6]]], np.float32)
+    out = nd.contrib.MultiBoxDetection(nd.array(cls_prob), nd.array(loc_pred),
+                                       nd.array(anchor)).asnumpy()
+    assert out.shape == (1, 1, 6)
+    cls_id, score = out[0, 0, 0], out[0, 0, 1]
+    assert cls_id == 1.0  # class 2 → index 1 among non-background
+    assert score == pytest.approx(0.7)
+    assert_almost_equal(out[0, 0, 2:], np.array([0.2, 0.2, 0.6, 0.6]),
+                        rtol=1e-5, atol=1e-5)
+
+
+def test_boolean_mask():
+    data = np.arange(12, dtype=np.float32).reshape(4, 3)
+    index = np.array([1, 0, 1, 0], np.float32)
+    out = nd.contrib.boolean_mask(nd.array(data), nd.array(index)).asnumpy()
+    assert_almost_equal(out, data[[0, 2]], rtol=0, atol=0)
+
+
+def test_roi_align_position_sensitive():
+    # PSROIAlign on a constant-per-channel map: output channel c at bin (i,j)
+    # must equal the constant of input channel c*ph*pw + i*pw + j.
+    ph = pw = 2
+    C = 2 * ph * pw
+    data = np.arange(C, dtype=np.float32).reshape(1, C, 1, 1) * np.ones(
+        (1, C, 8, 8), np.float32)
+    rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+    out = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(ph, pw), spatial_scale=1.0,
+                              sample_ratio=2, position_sensitive=True).asnumpy()
+    assert out.shape == (1, 2, ph, pw)
+    ref = np.arange(C, dtype=np.float32).reshape(2, ph, pw)
+    assert_almost_equal(out[0], ref, rtol=1e-5, atol=1e-5)
+
+
+def test_box_nms_format_conversion():
+    rows = np.array([[0, 0.9, 0.5, 0.5, 0.2, 0.4]], np.float32)  # center fmt
+    out = nd.contrib.box_nms(nd.array(rows), in_format="center",
+                             out_format="corner").asnumpy()
+    assert_almost_equal(out[0, 2:], np.array([0.4, 0.3, 0.6, 0.7]),
+                        rtol=1e-5, atol=1e-5)
+
+
+def test_index_array_full_shape():
+    x = nd.zeros((2, 3, 4))
+    out = nd.contrib.index_array(x, axes=(1,)).asnumpy()
+    assert out.shape == (2, 3, 4, 1)
+    assert out[1, 2, 3, 0] == 2
+    full = nd.contrib.index_array(x).asnumpy()
+    assert full.shape == (2, 3, 4, 3)
+    assert tuple(full[1, 2, 3]) == (1, 2, 3)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    rs = np.random.RandomState(2)
+    x = rs.rand(2, 4, 7, 7).astype(np.float32)
+    w = rs.rand(6, 4, 3, 3).astype(np.float32)
+    b = rs.rand(6).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 7, 7), np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), nd.array(b),
+        kernel=(3, 3), pad=(1, 1), num_filter=6).asnumpy()
+    ref = nd.Convolution(nd.array(x), nd.array(w), nd.array(b), kernel=(3, 3),
+                         pad=(1, 1), num_filter=6).asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_shift():
+    # offset of exactly (0, +1) everywhere == conv over x shifted left by 1
+    rs = np.random.RandomState(3)
+    x = rs.rand(1, 2, 6, 6).astype(np.float32)
+    w = rs.rand(3, 2, 1, 1).astype(np.float32)
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[:, 1] = 1.0  # x-offset +1
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(1, 1), num_filter=3,
+        no_bias=True).asnumpy()
+    x_shift = np.concatenate([x[..., 1:], np.zeros_like(x[..., :1])], axis=-1)
+    ref = nd.Convolution(nd.array(x_shift), nd.array(w), kernel=(1, 1),
+                         num_filter=3, no_bias=True).asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
