@@ -2,6 +2,7 @@
 — the late-1.x high-level fit loop with event handlers)."""
 from __future__ import annotations
 
+import copy
 import logging
 import time
 
@@ -59,9 +60,10 @@ class LoggingHandler(TrainBegin, EpochEnd, BatchEnd):
     def epoch_end(self, estimator, epoch=None, **kwargs):
         vals = " ".join(f"{m.get()[0]}={m.get()[1]:.5f}"
                         for m in estimator.train_metrics)
-        if estimator.val_metrics:
+        live_val = [m for m in estimator.val_metrics if getattr(m, "num_inst", 0)]
+        if live_val:
             vals += " " + " ".join(f"val_{m.get()[0]}={m.get()[1]:.5f}"
-                                   for m in estimator.val_metrics)
+                                   for m in live_val)
         logging.info("Epoch[%s] %s", epoch, vals)
 
 
@@ -76,7 +78,10 @@ class CheckpointHandler(EpochEnd):
         self.best = None
 
     def _monitored_value(self, estimator):
-        metrics = estimator.val_metrics or estimator.train_metrics
+        # val metrics only count once validation actually ran (no val_data ->
+        # never-updated metrics report NaN, which would freeze save_best)
+        live_val = [m for m in estimator.val_metrics if getattr(m, "num_inst", 0)]
+        metrics = live_val or estimator.train_metrics
         for m in metrics:
             name, val = m.get()
             if self.monitor is None or name == self.monitor:
@@ -133,10 +138,13 @@ class Estimator:
         self.train_metrics = [metric_mod.create(m) for m in specs]
         if val_metrics is not None:
             self.val_metrics = [metric_mod.create(m) for m in val_metrics]
-        else:  # fresh instances so val accumulation never aliases train
-            self.val_metrics = [metric_mod.create(m) if isinstance(m, str)
-                                else type(metric_mod.create(m))()
-                                for m in specs]
+        else:  # cloned instances so val accumulation never aliases train,
+            # preserving configuration (top_k, feval, ...) of each metric
+            self.val_metrics = []
+            for m in self.train_metrics:
+                c = copy.deepcopy(m)
+                c.reset()
+                self.val_metrics.append(c)
         self.trainer = trainer or Trainer(net.collect_params(), "adam",
                                           {"learning_rate": 1e-3})
 

@@ -32,6 +32,8 @@ class KVStore:
         self._store: Dict = {}
         self._updater = None
         self._optimizer = None
+        self._compression = None
+        self._residual: Dict = {}
         self.is_distributed = kv_type.startswith("dist")
         self._num_workers = 1
         if self.is_distributed:
@@ -39,9 +41,11 @@ class KVStore:
 
     # -- core API ------------------------------------------------------------
     def init(self, key, value):
+        from .ndarray.sparse import BaseSparseNDArray
+
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
-            self._store[k] = NDArray(jnp.asarray(v._data))
+            self._store[k] = v.copy() if isinstance(v, BaseSparseNDArray) else NDArray(jnp.asarray(v._data))
 
     def push(self, key, value, priority=0):
         keys, values = self._normalize(key, value)
@@ -54,6 +58,8 @@ class KVStore:
                     agg = agg + x._data
             else:
                 agg = v._data
+            if self._compression is not None:
+                agg = self._compress(k, agg)
             if self.is_distributed:
                 agg = _dcn_psum(agg)
             if self._updater is not None:
@@ -64,11 +70,19 @@ class KVStore:
                                          else self._store[k]._data + agg)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        from .ndarray.sparse import BaseSparseNDArray
+
         keys, outs = self._normalize(key, out)
         for k, o in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized in kvstore")
             val = self._store[k]
+            if isinstance(val, BaseSparseNDArray):
+                # dense pull of a sparse-stored value densifies; reference
+                # requires row_sparse_pull for rsp keys unless ignored
+                if not ignore_sparse:
+                    raise MXNetError(f"key {k} has sparse storage; use row_sparse_pull")
+                val = val.todense()
             if isinstance(o, (list, tuple)):
                 for x in o:
                     x._data = val._data
@@ -81,13 +95,56 @@ class KVStore:
         self.pull(key, out if out is not None else value, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        raise MXNetError("row_sparse storage is not supported on TPU (SURVEY §2.2); "
-                         "use dense parameters")
+        """Pull only the rows in ``row_ids`` (reference:
+        ``KVStoreLocal::PullRowSparse``, ``src/kvstore/kvstore_local.h``) —
+        the embedding-table path where workers fetch just the rows their
+        batch touches."""
+        from .ndarray import sparse as _sp
+
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        keys, outs = self._normalize(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids] * len(keys)
+        for k, o, rid in zip(keys, outs, rids):
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized in kvstore")
+            val = self._store[k]
+            if isinstance(val, _sp.RowSparseNDArray):
+                got = _sp.retain(val, rid)
+            else:
+                # dense table: gather the requested rows directly (no
+                # densify/compaction pass) — the per-step embedding hot path
+                rid_raw = jnp.unique(jnp.asarray(
+                    rid._data if isinstance(rid, NDArray) else rid, jnp.int32))
+                got = _sp.RowSparseNDArray(val._data[rid_raw], (rid_raw,), val.shape)
+            for x in (o if isinstance(o, (list, tuple)) else [o]):
+                x._data, x._aux, x._shape = got._data, got._aux, got._shape
+        return None
 
     def set_gradient_compression(self, compression_params):
-        # 2-bit push compression targeted PCIe/ethernet; ICI/DCN collectives
-        # don't need it. Accepted and ignored for script compat.
-        self._compression = dict(compression_params)
+        """2-bit gradient compression with error-feedback residual
+        (reference: ``src/kvstore/gradient_compression.cc``). On TPU the
+        quantise→transport→dequantise pipeline collapses into one compiled
+        quantise step before the DCN all-reduce: values beyond ±threshold
+        send ±threshold, the rest send 0, and the quantisation error is
+        carried in a per-key residual added to the next push."""
+        params = dict(compression_params)
+        ctype = params.get("type", "2bit")
+        if ctype not in ("2bit", "none"):
+            raise MXNetError(f"unsupported gradient compression type {ctype!r}")
+        self._compression = None if ctype == "none" else {
+            "type": "2bit", "threshold": float(params.get("threshold", 0.5))}
+        self._residual.clear()
+
+    def _compress(self, k, agg):
+        thr = self._compression["threshold"]
+        res = self._residual.get(k)
+        acc = agg if res is None else agg + res
+        q = jnp.where(acc >= thr, jnp.asarray(thr, acc.dtype),
+                      jnp.where(acc <= -thr, jnp.asarray(-thr, acc.dtype),
+                                jnp.zeros((), acc.dtype)))
+        self._residual[k] = acc - q
+        return q
 
     def set_optimizer(self, optimizer):
         from .optimizer import get_updater

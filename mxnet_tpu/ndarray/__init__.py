@@ -196,9 +196,11 @@ class NDArray:
         return self
 
     def tostype(self, stype):
-        if stype != "default":
-            raise MXNetError("sparse storage types are not supported on TPU")
-        return self
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+
+        return _sp.cast_storage(self, stype)
 
     # -- indexing -----------------------------------------------------------
     def __getitem__(self, key):
@@ -341,6 +343,9 @@ def _raw_index(key):
 # op invocation (the analog of MXImperativeInvokeEx)
 # --------------------------------------------------------------------------
 def invoke(opdef, args, kwargs):
+    # sparse inputs densify at the op boundary (logical-tensor semantics);
+    # sparse-aware fast paths live in nd.sparse.{dot,add,retain} explicitly
+    args = tuple(a.todense() if hasattr(a, "_to_dense_raw") else a for a in args)
     arr_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
     raw_args = [_raw(a) for a in args]
     # NDArray kwargs (masks etc.) are unwrapped but not taped — gradients flow
@@ -516,3 +521,5 @@ def _contrib_getattr(name):
 
 contrib.__getattr__ = _contrib_getattr
 sys.modules[contrib.__name__] = contrib
+
+from . import sparse  # noqa: E402  (row_sparse/csr storage — needs NDArray defined)

@@ -1,0 +1,319 @@
+"""``mx.nd.sparse`` — row_sparse and csr storage types.
+
+Reference: ``src/ndarray/ndarray.cc`` (storage types on NDArray::Chunk),
+``src/operator/tensor/cast_storage-inl.h`` (CastStorage dense<->rsp/csr),
+``src/operator/tensor/dot-inl.h`` (dot(csr, dense)),
+``python/mxnet/ndarray/sparse.py`` (RowSparseNDArray / CSRNDArray surface).
+
+TPU design stance (SURVEY §2.2): the MXU wants dense, large, static-shaped
+tiles, so sparse here is a *storage/bandwidth* format, not a compute format:
+the index structure lives alongside a compacted data buffer, compute paths
+either (a) stay sparse where TPU-friendly primitives exist — row gather /
+scatter-add / segment-sum, which XLA lowers well — or (b) densify at the op
+boundary. This matches the dominant MXNet uses of sparse: embedding-style
+row_sparse gradients (gather/scatter) and csr feature matrices feeding
+``dot(csr, dense)``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError, dtype_np
+from . import NDArray, _invoke_name, _raw, _wrap
+
+__all__ = [
+    "BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+    "row_sparse_array", "csr_matrix", "cast_storage", "retain", "dot",
+    "zeros", "array", "add", "subtract", "multiply",
+]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common surface of the two sparse storage types.
+
+    Subclasses keep ``_data`` as the *dense logical view is NOT materialised*;
+    instead ``_data`` holds the compacted value buffer and the index arrays
+    live in ``_aux``. ``shape``/``dtype`` describe the logical dense tensor.
+    """
+
+    __slots__ = ("_aux", "_shape")
+
+    def __init__(self, data, aux, shape):
+        NDArray.__init__(self, data)
+        self._aux = tuple(jnp.asarray(a) for a in aux)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def size(self):
+        return int(_np.prod(self._shape)) if self._shape else 1
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def data(self):
+        return _wrap(self._data)
+
+    def asnumpy(self):
+        return _np.asarray(jax.device_get(self._to_dense_raw()))
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+    def todense(self):
+        return _wrap(self._to_dense_raw())
+
+    def astype(self, dtype, copy=True):
+        return type(self)(jnp.asarray(self._data, dtype_np(dtype)), self._aux, self._shape)
+
+    def copy(self):
+        return type(self)(jnp.copy(self._data), tuple(jnp.copy(a) for a in self._aux), self._shape)
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} {'x'.join(map(str, self._shape))} "
+                f"@{self.context}>")
+
+    # dense-only NDArray surface that has no sparse meaning
+    def __getitem__(self, key):
+        if isinstance(self, CSRNDArray) and isinstance(key, slice):
+            # csr supports row slicing (reference: ndarray/sparse.py CSRNDArray.__getitem__)
+            start, stop, step = key.indices(self._shape[0])
+            if step != 1:
+                raise MXNetError("CSRNDArray only supports step=1 row slices")
+            indptr = self._aux[1]
+            lo, hi = int(indptr[start]), int(indptr[stop])
+            return CSRNDArray(self._data[lo:hi],
+                              (self._aux[0][lo:hi], indptr[start:stop + 1] - indptr[start]),
+                              (stop - start, self._shape[1]))
+        raise MXNetError(f"{type(self).__name__} does not support this indexing")
+
+    def __setitem__(self, key, value):
+        raise MXNetError(f"{type(self).__name__} is immutable; use dense NDArray")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """2-D+ tensor where only a subset of axis-0 slices are non-zero.
+
+    ``data``: (nnz_rows, *shape[1:]) compacted rows; ``indices``: sorted
+    int32 row ids (the reference uses int64; JAX default x64-off picks i32).
+    The storage format of embedding gradients in the
+    reference (``src/operator/tensor/indexing_op.cc`` EmbeddingOpBackward
+    w/ rsp output).
+    """
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        return _wrap(self._aux[0])
+
+    def _to_dense_raw(self):
+        dense = jnp.zeros(self._shape, self._data.dtype)
+        if self._data.shape[0] == 0:
+            return dense
+        return dense.at[self._aux[0]].set(self._data)
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """2-D compressed-sparse-row matrix: data/indices (col ids)/indptr."""
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indices(self):
+        return _wrap(self._aux[0])
+
+    @property
+    def indptr(self):
+        return _wrap(self._aux[1])
+
+    def _to_dense_raw(self):
+        rows, cols = self._shape
+        dense = jnp.zeros((rows, cols), self._data.dtype)
+        if self._data.shape[0] == 0:
+            return dense
+        row_ids = _row_ids_from_indptr(self._aux[1], self._data.shape[0])
+        return dense.at[row_ids, self._aux[0]].set(self._data)
+
+
+def _row_ids_from_indptr(indptr, nnz):
+    """Expand csr indptr to a per-nnz row-id vector (searchsorted trick)."""
+    return jnp.searchsorted(indptr[1:], jnp.arange(nnz), side="right").astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# creation
+# --------------------------------------------------------------------------
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """``row_sparse_array((data, indices), shape=...)`` or from dense/ndarray."""
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 2 and not _np.isscalar(arg1[0]):
+        data, indices = arg1
+        data = jnp.asarray(_raw(data) if isinstance(data, NDArray) else data,
+                           dtype_np(dtype) if dtype else None)
+        indices = jnp.asarray(_raw(indices) if isinstance(indices, NDArray) else indices,
+                              jnp.int32)
+        if shape is None:
+            shape = (int(indices.max()) + 1 if indices.size else 0,) + tuple(data.shape[1:])
+        order = jnp.argsort(indices)
+        return RowSparseNDArray(data[order], (indices[order],), shape)
+    # dense input
+    return cast_storage(arg1 if isinstance(arg1, NDArray) else NDArray(jnp.asarray(arg1)),
+                        "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """``csr_matrix((data, indices, indptr), shape=...)`` or from dense."""
+    if isinstance(arg1, CSRNDArray):
+        return arg1
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
+        data, indices, indptr = (jnp.asarray(_raw(a) if isinstance(a, NDArray) else a)
+                                 for a in arg1)
+        data = data.astype(dtype_np(dtype)) if dtype else data
+        if shape is None:
+            raise MXNetError("csr_matrix from (data, indices, indptr) requires shape")
+        return CSRNDArray(data, (indices.astype(jnp.int32), indptr.astype(jnp.int32)), shape)
+    return cast_storage(arg1 if isinstance(arg1, NDArray) else NDArray(jnp.asarray(arg1)), "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    dt = dtype_np(dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + shape[1:], dt),
+                                (jnp.zeros((0,), jnp.int32),), shape)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dt),
+                          (jnp.zeros((0,), jnp.int32), jnp.zeros((shape[0] + 1,), jnp.int32)),
+                          shape)
+    if stype == "default":
+        from . import zeros as _dzeros
+
+        return _dzeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, BaseSparseNDArray):
+        return source_array.astype(dtype) if dtype else source_array.copy()
+    raise MXNetError("mx.nd.sparse.array expects a sparse input; "
+                     "use csr_matrix/row_sparse_array to construct")
+
+
+# --------------------------------------------------------------------------
+# storage casts (reference: cast_storage-inl.h)
+# --------------------------------------------------------------------------
+def cast_storage(arr, stype):
+    cur = arr.stype
+    if stype == cur:
+        return arr
+    if stype == "default":
+        return arr.todense()
+    # any -> dense numpy -> target (host-side compaction: index discovery is
+    # data-dependent, so it cannot live inside a traced program anyway)
+    dense = _np.asarray(arr.asnumpy())
+    if stype == "row_sparse":
+        if dense.ndim < 2:
+            raise MXNetError("row_sparse requires ndim >= 2")
+        nz = _np.flatnonzero(_np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))
+        return RowSparseNDArray(jnp.asarray(dense[nz]), (jnp.asarray(nz, dtype=_np.int32),),
+                                dense.shape)
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise MXNetError("csr requires ndim == 2")
+        rows, cols = _np.nonzero(dense)
+        indptr = _np.zeros(dense.shape[0] + 1, _np.int32)
+        _np.add.at(indptr, rows + 1, 1)
+        indptr = _np.cumsum(indptr)
+        return CSRNDArray(jnp.asarray(dense[rows, cols]),
+                          (jnp.asarray(cols, dtype=_np.int32), jnp.asarray(indptr)),
+                          dense.shape)
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+# --------------------------------------------------------------------------
+# sparse ops
+# --------------------------------------------------------------------------
+def retain(rsp, indices):
+    """``sparse_retain``: keep only the given rows (reference:
+    src/operator/tensor/sparse_retain-inl.h)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    want = jnp.asarray(_raw(indices) if isinstance(indices, NDArray) else indices, jnp.int32)
+    # membership of stored rows in `want` (both small host-side typically)
+    stored = rsp._aux[0]
+    keep = jnp.isin(stored, want)
+    keep_np = _np.asarray(jax.device_get(keep))
+    idx = _np.flatnonzero(keep_np)
+    return RowSparseNDArray(rsp._data[idx], (stored[idx],), rsp._shape)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """dot with sparse lhs. csr×dense uses segment-sum over nnz (XLA
+    scatter-add — TPU-friendly); rsp falls back through gather."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) and not isinstance(rhs, BaseSparseNDArray):
+        rraw = _raw(rhs)
+        if transpose_b:
+            rraw = rraw.T
+        nnz = lhs._data.shape[0]
+        row_ids = _row_ids_from_indptr(lhs._aux[1], nnz)
+        col_ids = lhs._aux[0]
+        if transpose_a:
+            # out[c, :] += data * rhs[row_ids, :] scattered at col_ids
+            contrib = lhs._data[:, None] * rraw[row_ids]
+            out = jnp.zeros((lhs._shape[1], rraw.shape[1]), contrib.dtype)
+            out = out.at[col_ids].add(contrib)
+        else:
+            contrib = lhs._data[:, None] * rraw[col_ids]
+            out = jnp.zeros((lhs._shape[0], rraw.shape[1]), contrib.dtype)
+            out = out.at[row_ids].add(contrib)
+        return _wrap(out)
+    if isinstance(lhs, BaseSparseNDArray):
+        lhs = lhs.todense()
+    if isinstance(rhs, BaseSparseNDArray):
+        rhs = rhs.todense()
+    return _invoke_name("dot", (lhs, rhs), {"transpose_a": transpose_a,
+                                            "transpose_b": transpose_b})
+
+
+def _ewise(name, lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray) and name == "add":
+        # rsp + rsp stays rsp (union of rows) — the gradient-aggregation path
+        ids = jnp.union1d(lhs._aux[0], rhs._aux[0])
+        ids_np = _np.asarray(jax.device_get(ids))
+        dense = jnp.zeros((ids_np.shape[0],) + lhs._shape[1:], lhs._data.dtype)
+        pos_l = _np.searchsorted(ids_np, _np.asarray(jax.device_get(lhs._aux[0])))
+        pos_r = _np.searchsorted(ids_np, _np.asarray(jax.device_get(rhs._aux[0])))
+        dense = dense.at[jnp.asarray(pos_l)].add(lhs._data)
+        dense = dense.at[jnp.asarray(pos_r)].add(rhs._data)
+        return RowSparseNDArray(dense, (ids,), lhs._shape)
+    l = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    r = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return _invoke_name(name, (l, r), {})
+
+
+def add(lhs, rhs):
+    return _ewise("add", lhs, rhs)
+
+
+def subtract(lhs, rhs):
+    return _ewise("subtract", lhs, rhs)
+
+
+def multiply(lhs, rhs):
+    return _ewise("multiply", lhs, rhs)

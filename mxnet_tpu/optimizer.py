@@ -109,13 +109,41 @@ class Optimizer:
 
     # -- imperative protocol (Trainer / KVStore updater) ---------------------
     def update(self, index, weight, grad, state):
+        from .ndarray.sparse import RowSparseNDArray
+
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         t = self._index_update_count[index]
+        if isinstance(grad, RowSparseNDArray):
+            return self._update_lazy(weight, grad, state, lr, wd, t)
         new_w, new_state = self.update_raw(weight._data, grad._data, state,
                                            jnp.float32(lr), jnp.float32(wd), jnp.int32(t))
         weight._data = new_w
         return new_state
+
+    def _update_lazy(self, weight, grad, state, lr, wd, t):
+        """Lazy update for row_sparse gradients (reference: sgd lazy_update in
+        ``src/operator/optimizer_op.cc`` SGDUpdateRspImpl): only the rows
+        present in the gradient are read, updated, and scattered back — the
+        embedding-table path. Gather→row-update→scatter lowers to XLA
+        gather/scatter, keeping the touched-rows working set on-chip."""
+        rows = grad._aux[0]
+
+        def _gather(leaf):
+            if hasattr(leaf, "shape") and leaf.ndim >= 1 and leaf.shape[:1] == weight._data.shape[:1]:
+                return leaf[rows]
+            return leaf
+
+        def _scatter(full, part):
+            if hasattr(full, "shape") and full.ndim >= 1 and full.shape[:1] == weight._data.shape[:1]:
+                return full.at[rows].set(part)
+            return part
+
+        sub_state = jax.tree_util.tree_map(_gather, state)
+        new_w_rows, new_sub = self.update_raw(weight._data[rows], grad._data, sub_state,
+                                              jnp.float32(lr), jnp.float32(wd), jnp.int32(t))
+        weight._data = weight._data.at[rows].set(new_w_rows)
+        return jax.tree_util.tree_map(_scatter, state, new_sub) if state is not None else new_sub
 
     def update_multi(self, indices, weights, grads, states):
         """Fused whole-pytree update (one XLA program for all params)."""

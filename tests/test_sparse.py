@@ -1,0 +1,175 @@
+"""Sparse storage types (reference: tests/python/unittest/test_sparse_ndarray.py
+and test_sparse_operator.py — numpy as the universal oracle)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_dense_rows(shape, density=0.3):
+    a = np.random.uniform(-1, 1, shape).astype(np.float32)
+    keep = np.random.uniform(size=shape[0]) < density
+    a[~keep] = 0
+    return a
+
+
+def test_cast_storage_row_sparse_roundtrip():
+    a = _rand_dense_rows((10, 4))
+    rsp = nd.array(a).tostype("row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert rsp.shape == (10, 4)
+    np.testing.assert_array_equal(rsp.asnumpy(), a)
+    back = rsp.tostype("default")
+    assert back.stype == "default"
+    np.testing.assert_array_equal(back.asnumpy(), a)
+
+
+def test_cast_storage_csr_roundtrip():
+    a = np.random.uniform(-1, 1, (6, 8)).astype(np.float32)
+    a[a < 0.3] = 0
+    csr = nd.array(a).tostype("csr")
+    assert csr.stype == "csr"
+    np.testing.assert_array_equal(csr.asnumpy(), a)
+    # structure invariants
+    indptr = csr.indptr.asnumpy()
+    assert indptr[0] == 0 and indptr[-1] == csr.data.shape[0]
+    np.testing.assert_array_equal(csr.tostype("default").asnumpy(), a)
+
+
+def test_row_sparse_array_from_tuple():
+    data = np.arange(6, dtype=np.float32).reshape(3, 2)
+    idx = np.array([4, 1, 7])
+    rsp = sparse.row_sparse_array((data, idx), shape=(9, 2))
+    dense = np.zeros((9, 2), np.float32)
+    dense[idx] = data
+    np.testing.assert_array_equal(rsp.asnumpy(), dense)
+    # indices come back sorted (reference invariant)
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 4, 7])
+
+
+def test_csr_matrix_from_tuple_and_row_slice():
+    #  [[1 0 2], [0 0 0], [0 3 0]]
+    csr = sparse.csr_matrix((np.array([1., 2., 3.], np.float32),
+                             np.array([0, 2, 1]), np.array([0, 2, 2, 3])),
+                            shape=(3, 3))
+    expect = np.array([[1, 0, 2], [0, 0, 0], [0, 3, 0]], np.float32)
+    np.testing.assert_array_equal(csr.asnumpy(), expect)
+    sl = csr[1:3]
+    np.testing.assert_array_equal(sl.asnumpy(), expect[1:3])
+
+
+def test_sparse_retain():
+    a = _rand_dense_rows((8, 3), density=1.0)
+    rsp = sparse.row_sparse_array(nd.array(a))
+    kept = sparse.retain(rsp, nd.array([1, 5], dtype="int64"))
+    expect = np.zeros_like(a)
+    expect[[1, 5]] = a[[1, 5]]
+    np.testing.assert_array_equal(kept.asnumpy(), expect)
+
+
+@pytest.mark.parametrize("transpose_a", [False, True])
+def test_csr_dot_dense(transpose_a):
+    a = np.random.uniform(-1, 1, (5, 7)).astype(np.float32)
+    a[np.abs(a) < 0.5] = 0
+    b = np.random.uniform(-1, 1, (5 if transpose_a else 7, 4)).astype(np.float32)
+    csr = nd.array(a).tostype("csr")
+    out = sparse.dot(csr, nd.array(b), transpose_a=transpose_a)
+    expect = (a.T if transpose_a else a) @ b
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_rsp_add_rsp():
+    a = _rand_dense_rows((10, 3))
+    b = _rand_dense_rows((10, 3))
+    out = sparse.add(nd.array(a).tostype("row_sparse"), nd.array(b).tostype("row_sparse"))
+    assert out.stype == "row_sparse"
+    np.testing.assert_allclose(out.asnumpy(), a + b, rtol=1e-6)
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (4, 2))
+    assert z.stype == "row_sparse" and z.shape == (4, 2)
+    assert np.all(z.asnumpy() == 0)
+    zc = sparse.zeros("csr", (3, 5))
+    assert zc.stype == "csr" and np.all(zc.asnumpy() == 0)
+
+
+def test_sparse_save_load(tmp_path):
+    a = _rand_dense_rows((6, 2))
+    b = np.random.uniform(size=(3, 3)).astype(np.float32)
+    b[b < 0.5] = 0
+    fname = str(tmp_path / "mixed.params")
+    nd.save(fname, {"rsp": nd.array(a).tostype("row_sparse"),
+                    "csr": nd.array(b).tostype("csr"),
+                    "dense": nd.array(b)})
+    loaded = nd.load(fname)
+    assert loaded["rsp"].stype == "row_sparse"
+    assert loaded["csr"].stype == "csr"
+    assert loaded["dense"].stype == "default"
+    np.testing.assert_array_equal(loaded["rsp"].asnumpy(), a)
+    np.testing.assert_array_equal(loaded["csr"].asnumpy(), b)
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = np.random.uniform(size=(8, 4)).astype(np.float32)
+    kv.init("emb", nd.array(w))
+    out = sparse.zeros("row_sparse", (8, 4))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([2, 6], dtype="int64"))
+    expect = np.zeros_like(w)
+    expect[[2, 6]] = w[[2, 6]]
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+
+def test_optimizer_lazy_update_rows_only():
+    """Rows absent from a row_sparse grad must NOT be touched (lazy update,
+    reference sgd_update w/ lazy_update=True)."""
+    opt = mx.optimizer.SGD(learning_rate=0.5, momentum=0.9, rescale_grad=1.0, wd=0.0)
+    w = nd.array(np.ones((6, 3), np.float32))
+    state = opt.create_state(0, w)
+    g = sparse.row_sparse_array((np.full((2, 3), 2.0, np.float32), np.array([1, 4])),
+                                shape=(6, 3))
+    state = opt.update(0, w, g, state)
+    got = w.asnumpy()
+    np.testing.assert_allclose(got[[0, 2, 3, 5]], 1.0)
+    np.testing.assert_allclose(got[[1, 4]], 1.0 - 0.5 * 2.0)
+    # second update exercises momentum state scatter
+    state = opt.update(0, w, g, state)
+    got2 = w.asnumpy()
+    np.testing.assert_allclose(got2[[0, 2, 3, 5]], 1.0)
+    assert np.all(got2[[1, 4]] < got[[1, 4]])
+
+
+def test_gradient_compression_2bit():
+    """Error-feedback 2-bit compression (reference: gradient_compression.cc):
+    quantized push sends ±threshold/0; residual carries the error so the
+    running sum converges to the true gradient sum."""
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", nd.zeros((4,)))
+    # |g| <= threshold keeps the residual bounded; above threshold the 2-bit
+    # scheme saturates at one ±threshold per push (same as the reference)
+    g = np.array([0.3, 0.45, -0.5, 0.1], np.float32)
+    total = np.zeros(4, np.float32)
+    out = nd.zeros((4,))
+    steps = 8
+    for _ in range(steps):
+        kv.push("w", nd.array(g))
+        kv.pull("w", out=out)
+        q = out.asnumpy()
+        # every transmitted value is one of {-thr, 0, +thr}
+        assert set(np.round(np.abs(q) / 0.5).astype(int)) <= {0, 1}
+        total += q
+    # error feedback: cumulative quantized sum tracks the true sum to within
+    # one residual (±threshold) per element
+    np.testing.assert_allclose(total, g * steps, atol=0.5 + 1e-6)
+
+
+def test_sparse_errors():
+    with pytest.raises(MXNetError):
+        nd.array(np.ones((3,))).tostype("row_sparse")  # ndim < 2
+    with pytest.raises(MXNetError):
+        sparse.csr_matrix((np.ones(1), np.zeros(1), np.array([0, 1])))  # no shape
