@@ -35,6 +35,7 @@ from .attribute import AttrScope  # noqa: F401
 from . import models  # noqa: F401
 from . import module  # noqa: F401
 from . import module as mod  # noqa: F401
+from . import operator  # noqa: F401
 from . import callback  # noqa: F401
 from . import contrib  # noqa: F401
 from . import image  # noqa: F401

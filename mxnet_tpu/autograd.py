@@ -214,3 +214,53 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
 
 def get_symbol(x):
     raise NotImplementedError("autograd.get_symbol: use mxnet_tpu.symbol tracing instead")
+
+
+class Function:
+    """User-defined differentiable function (reference:
+    ``python/mxnet/autograd.py`` class Function / ``MXCustomFunctionRecord``).
+
+    ``forward`` defines the primal on NDArray handles, ``backward`` the VJP;
+    both are packaged into one ``jax.custom_vjp`` so the pair traces into
+    compiled programs. The backward pass re-executes ``forward`` (functional
+    re-derivation instead of the reference's saved-NDArray refs), so state
+    stashed on ``self`` in ``forward`` is visible to ``backward``."""
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray, invoke
+        from .registry import OpDef
+
+        fn_self = self
+
+        def _run_fwd(raws):
+            outs = fn_self.forward(*[NDArray(r) for r in raws])
+            outs = outs if isinstance(outs, (list, tuple)) else (outs,)
+            return tuple(o._data for o in outs)
+
+        @jax.custom_vjp
+        def fn(*raws):
+            outs = _run_fwd(raws)
+            return outs if len(outs) > 1 else outs[0]
+
+        def fwd(*raws):
+            outs = _run_fwd(raws)
+            return (outs if len(outs) > 1 else outs[0]), raws
+
+        def bwd(raws, gs):
+            _run_fwd(raws)  # re-derive any state stashed on self
+            gs = gs if isinstance(gs, tuple) else (gs,)
+            in_grads = fn_self.backward(*[NDArray(g) for g in gs])
+            in_grads = in_grads if isinstance(in_grads, (list, tuple)) else (in_grads,)
+            return tuple(g._data for g in in_grads)
+
+        fn.defvjp(fwd, bwd)
+        nout = len(jax.tree_util.tree_leaves(
+            jax.eval_shape(fn, *[i._data for i in inputs])))
+        opdef = OpDef(name=type(self).__name__, fn=fn, nout=nout)
+        return invoke(opdef, inputs, {})

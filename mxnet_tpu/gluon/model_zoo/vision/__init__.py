@@ -17,6 +17,7 @@ from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
 from .densenet import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201,
 )
+from .inception import Inception3, inception_v3  # noqa: F401
 
 _models = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1, "resnet50_v1": resnet50_v1,
@@ -32,6 +33,7 @@ _models = {
     "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
     "densenet121": densenet121, "densenet161": densenet161,
     "densenet169": densenet169, "densenet201": densenet201,
+    "inceptionv3": inception_v3,
 }
 
 

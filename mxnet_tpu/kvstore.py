@@ -48,8 +48,32 @@ class KVStore:
             self._store[k] = v.copy() if isinstance(v, BaseSparseNDArray) else NDArray(jnp.asarray(v._data))
 
     def push(self, key, value, priority=0):
+        from .ndarray import sparse as _sp
+
         keys, values = self._normalize(key, value)
         for k, v in zip(keys, values):
+            # row_sparse pushes stay sparse end-to-end so the optimizer's
+            # lazy row update path triggers (reference: KVStoreLocal::PushImpl
+            # rsp branch); dist/compression paths densify explicitly.
+            if isinstance(v, (list, tuple)) and v and isinstance(v[0], _sp.RowSparseNDArray):
+                agg_sp = v[0]
+                for x in v[1:]:
+                    agg_sp = _sp.add(agg_sp, x)
+                v = agg_sp
+            if isinstance(v, _sp.RowSparseNDArray):
+                if self.is_distributed or self._compression is not None:
+                    v = v.todense()
+                elif self._updater is not None:
+                    self._updater(k, v, self._store[k])
+                    continue
+                else:
+                    store = self._store[k]
+                    if isinstance(store, _sp.RowSparseNDArray):
+                        self._store[k] = _sp.add(store, v)
+                    else:
+                        store._data = store._data.at[v._aux[0]].add(
+                            jnp.asarray(v._data, store._data.dtype))
+                    continue
             if isinstance(v, (list, tuple)):
                 # multi-device push: the reference reduced replicas here; a
                 # jax.Array is already one logical value, so sum the list.
@@ -108,6 +132,10 @@ class KVStore:
         for k, o, rid in zip(keys, outs, rids):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized in kvstore")
+            for x in (o if isinstance(o, (list, tuple)) else [o]):
+                if not isinstance(x, _sp.RowSparseNDArray):
+                    raise MXNetError("row_sparse_pull requires row_sparse out "
+                                     "arrays (reference: KVStoreLocal::PullRowSparse)")
             val = self._store[k]
             if isinstance(val, _sp.RowSparseNDArray):
                 got = _sp.retain(val, rid)

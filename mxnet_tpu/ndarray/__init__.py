@@ -418,6 +418,19 @@ def __getattr__(name):  # late-registered ops (e.g. contrib modules)
         raise AttributeError(f"module 'mx.nd' has no attribute {name!r}") from None
 
 
+def Custom(*args, op_type=None, **kwargs):
+    """Invoke a registered user-defined operator (reference:
+    ``mx.nd.Custom`` routed through ``src/operator/custom/custom.cc``)."""
+    from ..operator import make_custom_fn
+    from ..registry import OpDef
+
+    if op_type is None:
+        raise MXNetError("nd.Custom requires op_type=")
+    fn, nout = make_custom_fn(op_type, kwargs)
+    opdef = OpDef(name=f"Custom:{op_type}", fn=fn, nout=nout)
+    return invoke(opdef, args, {})
+
+
 # --------------------------------------------------------------------------
 # creation functions
 # --------------------------------------------------------------------------

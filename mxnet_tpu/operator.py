@@ -1,0 +1,152 @@
+"""``mx.operator`` — user-defined operators (CustomOp).
+
+Reference: ``python/mxnet/operator.py`` + ``src/operator/custom/custom.cc``.
+There the user's Python ``forward``/``backward`` are called back from the
+engine on a dedicated GIL-aware thread; here the TPU-native shape is
+``jax.custom_vjp``: the user's ``forward`` defines the primal, the user's
+``backward`` defines the VJP, and both trace into the surrounding XLA
+program — so a CustomOp composes with ``hybridize()``/``jit`` instead of
+punching an engine-callback hole the compiler cannot see through.
+
+The user's code runs on NDArray handles whose buffers may be tracers, so it
+must stay inside the ``mx.nd`` op surface (the overwhelmingly common case in
+reference CustomOps). NumPy round-trips (``asnumpy``) cannot trace; such ops
+belong behind ``jax.pure_callback`` — see ``HostCallbackOp`` below, the
+escape hatch matching the reference's host-side execution semantics.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+import jax
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_class"]
+
+
+class CustomOp:
+    """Base class of user ops (reference: ``mx.operator.CustomOp``)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the write/add/null request."""
+        if req == "null":
+            return
+        raw = src._data if hasattr(src, "_data") else src
+        if req == "add":
+            dst._data = dst._data + raw
+        else:  # write / inplace
+            dst._data = raw
+
+
+class CustomOpProp:
+    """Shape/type inference + operator factory (reference: CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, shapes, dtypes) -> CustomOp:
+        raise NotImplementedError
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        return list(out_grad) + list(in_data) + list(out_data)
+
+
+_CUSTOM_PROPS: Dict[str, Type[CustomOpProp]] = {}
+
+
+def register(reg_name):
+    """Decorator registering a CustomOpProp under ``op_type=reg_name``."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError(f"{prop_cls} must subclass CustomOpProp")
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_prop_class(op_type):
+    try:
+        return _CUSTOM_PROPS[op_type]
+    except KeyError:
+        raise MXNetError(
+            f"custom op {op_type!r} is not registered; "
+            f"known: {sorted(_CUSTOM_PROPS)}") from None
+
+
+def _dtype_name(dt):
+    name = _np.dtype(dt).name if not str(dt) == "bfloat16" else "bfloat16"
+    return name
+
+
+def make_custom_fn(op_type, kwargs):
+    """Build (pure_fn, nout) for ``nd.Custom``: a ``jax.custom_vjp`` whose
+    primal/vjp run the user's forward/backward on NDArray views."""
+    from .ndarray import NDArray
+
+    prop = get_prop_class(op_type)(**{k: str(v) for k, v in kwargs.items()})
+    n_in = len(prop.list_arguments())
+    n_out = len(prop.list_outputs())
+
+    def _run_forward(raws, is_train):
+        in_shapes = [list(r.shape) for r in raws]
+        in_shapes, out_shapes, _aux_shapes = prop.infer_shape(in_shapes)
+        in_types = [_dtype_name(r.dtype) for r in raws]
+        _, out_types, _ = prop.infer_type(in_types)
+        op = prop.create_operator(None, in_shapes + out_shapes, in_types + out_types)
+        in_data = [NDArray(r) for r in raws]
+        from .base import dtype_np
+
+        out_data = [NDArray(jax.numpy.zeros(tuple(s), dtype_np(t)))
+                    for s, t in zip(out_shapes, out_types)]
+        op.forward(is_train, ["write"] * n_out, in_data, out_data, [])
+        return op, in_data, out_data
+
+    @jax.custom_vjp
+    def fn(*raws):
+        _, _, out_data = _run_forward(raws, True)
+        outs = tuple(o._data for o in out_data)
+        return outs if n_out > 1 else outs[0]
+
+    def fwd(*raws):
+        _, _, out_data = _run_forward(raws, True)
+        outs = tuple(o._data for o in out_data)
+        # residual carries only the inputs: backward re-derives outputs, so
+        # saving them would pin dead buffers across the fwd->bwd gap
+        return (outs if n_out > 1 else outs[0]), raws
+
+    def bwd(raws, gs):
+        gs = gs if isinstance(gs, tuple) else (gs,)
+        # a fresh operator instance re-derives forward state for backward
+        op, in_data, out_data = _run_forward(raws, True)
+        in_grad = [a._empty_like() for a in in_data]
+        op.backward(["write"] * n_in, [NDArray(g) for g in gs], in_data,
+                    out_data, in_grad, [])
+        return tuple(g._data for g in in_grad)
+
+    fn.defvjp(fwd, bwd)
+    return fn, n_out

@@ -1,0 +1,152 @@
+"""User-defined operators (reference: tests/python/unittest/test_operator.py
+test_custom_op + test_autograd.py Function tests)."""
+import jax
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.base import MXNetError
+
+
+class Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        y = 1.0 / (1.0 + nd.exp(-x))
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1.0 - y))
+
+
+@mx.operator.register("test_sigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sigmoid()
+
+
+def test_custom_op_forward():
+    x = np.random.uniform(-2, 2, (3, 4)).astype(np.float32)
+    out = nd.Custom(nd.array(x), op_type="test_sigmoid")
+    np.testing.assert_allclose(out.asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-6)
+
+
+def test_custom_op_backward():
+    x = np.random.uniform(-2, 2, (5,)).astype(np.float32)
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        y = nd.Custom(a, op_type="test_sigmoid")
+        loss = y.sum()
+    loss.backward()
+    s = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(a.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_custom_op_user_backward_wins():
+    """The user's backward defines the VJP — not jax autodiff of forward."""
+
+    class DoubleFwdFakeBwd(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], in_data[0] * 2.0)
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            # deliberately NOT the true gradient (true would be 2*g)
+            self.assign(in_grad[0], req[0], out_grad[0] * 100.0)
+
+    @mx.operator.register("test_fake_bwd")
+    class Prop(mx.operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return DoubleFwdFakeBwd()
+
+    a = nd.array(np.ones(3, np.float32))
+    a.attach_grad()
+    with autograd.record():
+        y = nd.Custom(a, op_type="test_fake_bwd")
+    y.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), 100.0 * np.ones(3))
+
+
+def test_custom_op_multi_output():
+    class SplitHalf(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            x = in_data[0]
+            n = x.shape[0] // 2
+            self.assign(out_data[0], req[0], x[:n])
+            self.assign(out_data[1], req[1], x[n:])
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0], nd.concat(out_grad[0], out_grad[1], dim=0))
+
+    @mx.operator.register("test_split_half")
+    class Prop(mx.operator.CustomOpProp):
+        def list_outputs(self):
+            return ["top", "bottom"]
+
+        def infer_shape(self, in_shape):
+            (n, d) = in_shape[0]
+            return in_shape, [[n // 2, d], [n - n // 2, d]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return SplitHalf()
+
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    a = nd.array(x)
+    a.attach_grad()
+    with autograd.record():
+        top, bot = nd.Custom(a, op_type="test_split_half")
+        loss = (top * 2).sum() + (bot * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(top.asnumpy(), x[:2])
+    np.testing.assert_allclose(bot.asnumpy(), x[2:])
+    expect = np.concatenate([np.full((2, 3), 2.0), np.full((2, 3), 3.0)])
+    np.testing.assert_allclose(a.grad.asnumpy(), expect)
+
+
+def test_custom_op_traces_under_jit():
+    """CustomOps compose with jit (the design win over engine callbacks)."""
+    fn, _ = mx.operator.make_custom_fn("test_sigmoid", {})
+    jfn = jax.jit(fn)
+    x = np.random.uniform(-1, 1, (4,)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(jfn(x)), 1 / (1 + np.exp(-x)), rtol=1e-6)
+
+
+def test_custom_op_unregistered():
+    with pytest.raises(MXNetError):
+        nd.Custom(nd.zeros((2,)), op_type="nope_not_registered")
+
+
+def test_autograd_function():
+    class ScaledTanh(autograd.Function):
+        def forward(self, x):
+            y = x.tanh() * 2.0
+            self.saved_y = y
+            return y
+
+        def backward(self, dy):
+            y = self.saved_y
+            return dy * (2.0 - (y * y) / 2.0)  # 2*(1-tanh^2) = 2 - y^2/2
+
+    x = np.random.uniform(-1, 1, (6,)).astype(np.float32)
+    a = nd.array(x)
+    a.attach_grad()
+    f = ScaledTanh()
+    with autograd.record():
+        y = f(a)
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), 2 * np.tanh(x), rtol=1e-6)
+    np.testing.assert_allclose(a.grad.asnumpy(), 2 * (1 - np.tanh(x) ** 2),
+                               rtol=1e-5, atol=1e-6)

@@ -9,6 +9,7 @@ from mxnet_tpu import gluon, nd
     ("resnet34_v2", 32), ("vgg11", 32), ("vgg11_bn", 32),
     ("mobilenet0.25", 32), ("mobilenetv2_0.5", 32),
     ("squeezenet1.1", 64), ("densenet121", 32), ("alexnet", 224),
+    ("inceptionv3", 299),
 ])
 def test_zoo_forward(name, size):
     net = gluon.model_zoo.get_model(name, classes=11)

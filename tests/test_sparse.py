@@ -168,6 +168,45 @@ def test_gradient_compression_2bit():
     np.testing.assert_allclose(total, g * steps, atol=0.5 + 1e-6)
 
 
+def test_kvstore_sparse_push_no_updater():
+    """rsp push scatter-adds into a dense-stored table."""
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.zeros((6, 2)))
+    g = sparse.row_sparse_array((np.ones((2, 2), np.float32), np.array([1, 4])),
+                                shape=(6, 2))
+    kv.push("emb", g)
+    kv.push("emb", g)
+    out = nd.zeros((6, 2))
+    kv.pull("emb", out=out)
+    expect = np.zeros((6, 2), np.float32)
+    expect[[1, 4]] = 2.0
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_kvstore_sparse_push_lazy_optimizer():
+    """rsp push through set_optimizer triggers the lazy row update (cold rows
+    stay untouched) — the unreachable-path repro from review."""
+    kv = mx.kv.create("device")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    w = np.ones((6, 2), np.float32)
+    kv.init("emb", nd.array(w))
+    g = sparse.row_sparse_array((np.full((2, 2), 0.25, np.float32), np.array([0, 3])),
+                                shape=(6, 2))
+    kv.push("emb", g)
+    out = nd.zeros((6, 2))
+    kv.pull("emb", out=out)
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[[1, 2, 4, 5]], 1.0)
+    np.testing.assert_allclose(got[[0, 3]], 0.75)
+
+
+def test_kvstore_row_sparse_pull_requires_sparse_out():
+    kv = mx.kv.create("local")
+    kv.init("emb", nd.zeros((4, 2)))
+    with pytest.raises(MXNetError, match="row_sparse out"):
+        kv.row_sparse_pull("emb", out=nd.zeros((4, 2)), row_ids=nd.array([1]))
+
+
 def test_sparse_errors():
     with pytest.raises(MXNetError):
         nd.array(np.ones((3,))).tostype("row_sparse")  # ndim < 2
