@@ -80,8 +80,10 @@ def bert_flops(batch, seq, masked, num_layers, units, hidden, vocab):
 
 def main():
     on_tpu = _tpu_ready()
-    # bench config: BERT-large, seq 128 (phase-1 pretraining shape)
-    name, batch, seq, masked = ("bert_large", 16, 128, 20) if on_tpu else (
+    # bench config: BERT-large, seq 128 (phase-1 pretraining shape); batch 64
+    # is the measured MFU knee on one v5e chip (16->0.31, 32->0.35, 64->0.42,
+    # 128->0.39) — the OOM fallback below halves it if a smaller chip balks
+    name, batch, seq, masked = ("bert_large", 64, 128, 20) if on_tpu else (
         "bert_mini", 4, 64, 8)
     tried = []
     ts = None

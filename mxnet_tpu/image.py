@@ -14,8 +14,9 @@ import jax.numpy as jnp
 from .ndarray import NDArray, array
 
 __all__ = ["imresize", "resize_short", "center_crop", "random_crop",
-           "color_normalize", "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",
-           "RandomCropAug", "CenterCropAug", "ResizeAug", "CreateAugmenter"]
+           "color_normalize", "batchify_images", "HorizontalFlipAug", "CastAug",
+           "ColorNormalizeAug", "RandomCropAug", "CenterCropAug", "ResizeAug",
+           "CreateAugmenter"]
 
 
 def _raw(x):
@@ -23,8 +24,22 @@ def _raw(x):
 
 
 def imresize(src, w, h, interp=1):
+    # uint8 host images take the native C++ kernel (same half-pixel linear
+    # semantics, no device round-trip mid-pipeline); everything else —
+    # including tracers under jit — goes through jax.image.resize. The dtype
+    # check reads metadata only; asnumpy happens on the native path alone.
+    from . import native as _native
+
+    raw0 = src._data if isinstance(src, NDArray) else src
+    is_tracer = isinstance(raw0, jax.core.Tracer)
+    if (not is_tracer and np.dtype(getattr(raw0, "dtype", np.float32)) == np.uint8
+            and getattr(raw0, "ndim", 0) == 3 and _native.available()):
+        src_np = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+        return NDArray(_native.image_resize(src_np, h, w))
     x = _raw(src).astype(jnp.float32)
-    out = jax.image.resize(x, (h, w, x.shape[2]), method="linear")
+    # antialias=False = plain bilinear, the reference's cv2.INTER_LINEAR
+    # semantics (src/io/image_aug_default.cc) and the native kernel's
+    out = jax.image.resize(x, (h, w, x.shape[2]), method="linear", antialias=False)
     return NDArray(out.astype(_raw(src).dtype))
 
 
@@ -51,6 +66,25 @@ def random_crop(src, size, interp=1):
     x0 = np.random.randint(0, w - cw + 1)
     y0 = np.random.randint(0, h - ch + 1)
     return src[y0:y0 + ch, x0:x0 + cw], (x0, y0, cw, ch)
+
+
+def batchify_images(batch, mean=None, std=None, nthreads=4):
+    """Host-side batch staging: (N,H,W,C) uint8 -> (N,C,H,W) float32 with
+    per-channel normalize, before a single ``device_put``. Dispatches to the
+    threaded C++ kernel (native/src/runtime.cc BatchToCHWFloat — the
+    ``PrefetcherIter`` batch-assembly role) when the library is built."""
+    from . import native as _native
+
+    arr = np.asarray(batch)
+    if arr.dtype == np.uint8 and arr.ndim == 4 and _native.available():
+        return NDArray(_native.batch_to_chw_float(arr, mean=mean, std=std,
+                                                  nthreads=nthreads))
+    out = arr.astype(np.float32)
+    if mean is not None:
+        out = out - np.asarray(mean, np.float32)
+    if std is not None:
+        out = out / np.asarray(std, np.float32)
+    return NDArray(out.transpose(0, 3, 1, 2))
 
 
 def color_normalize(src, mean, std=None):

@@ -14,7 +14,8 @@ import subprocess
 from typing import Optional
 
 __all__ = ["lib", "available", "ensure_built", "NativeRecordReader",
-           "NativeRecordWriter", "NativePrefetchReader"]
+           "NativeRecordWriter", "NativePrefetchReader", "image_resize",
+           "image_crop", "image_flip_h", "batch_to_chw_float", "storage_stats"]
 
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
@@ -68,8 +69,118 @@ def lib() -> Optional[ctypes.CDLL]:
     L.MXTPUPrefetchNext.restype = ctypes.c_int64
     L.MXTPUPrefetchNext.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
     L.MXTPUPrefetchFree.argtypes = [ctypes.c_void_p]
+    # runtime.cc: pooled storage + image kernels + batch assembly
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    L.MXTPUStorageAlloc.restype = ctypes.c_void_p
+    L.MXTPUStorageAlloc.argtypes = [ctypes.c_uint64]
+    L.MXTPUStorageFree.argtypes = [ctypes.c_void_p]
+    L.MXTPUStorageStats.argtypes = [ctypes.POINTER(ctypes.c_uint64)]
+    L.MXTPUImageResize.argtypes = [u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                   u8p, ctypes.c_int, ctypes.c_int]
+    L.MXTPUImageCrop.restype = ctypes.c_int
+    L.MXTPUImageCrop.argtypes = [u8p] + [ctypes.c_int] * 5 + [u8p, ctypes.c_int, ctypes.c_int]
+    L.MXTPUImageFlipH.argtypes = [u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p]
+    L.MXTPUBatchToCHWFloat.argtypes = [u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                       ctypes.c_int, f32p, f32p, f32p, ctypes.c_int]
     _LIB = L
     return _LIB
+
+
+def _require_lib():
+    L = lib()
+    if L is None:
+        raise RuntimeError("native library not built; run `make -C native` "
+                           "(requires a C++ toolchain) or use the pure-Python path")
+    return L
+
+
+def _u8p(arr):
+    import numpy as np
+
+    return np.ascontiguousarray(arr, dtype=np.uint8).ctypes.data_as(
+        ctypes.POINTER(ctypes.c_uint8))
+
+
+def image_resize(src, oh, ow):
+    """Bilinear uint8 HWC resize via the native kernel (jax.image.resize
+    'linear' coordinate semantics)."""
+    import numpy as np
+
+    L = _require_lib()
+    src = np.ascontiguousarray(src, dtype=np.uint8)
+    h, w, c = src.shape
+    dst = np.empty((oh, ow, c), np.uint8)
+    L.MXTPUImageResize(_u8p(src), h, w, c,
+                       dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), oh, ow)
+    return dst
+
+
+def image_flip_h(src):
+    import numpy as np
+
+    L = _require_lib()
+    src = np.ascontiguousarray(src, dtype=np.uint8)
+    h, w, c = src.shape
+    dst = np.empty_like(src)
+    L.MXTPUImageFlipH(_u8p(src), h, w, c,
+                      dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return dst
+
+
+def image_crop(src, y0, x0, ch, cw):
+    import numpy as np
+
+    L = _require_lib()
+    src = np.ascontiguousarray(src, dtype=np.uint8)
+    h, w, c = src.shape
+    dst = np.empty((ch, cw, c), np.uint8)
+    if L.MXTPUImageCrop(_u8p(src), h, w, c, int(y0), int(x0),
+                        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                        ch, cw) != 0:
+        raise ValueError("crop window out of bounds")
+    return dst
+
+
+def batch_to_chw_float(batch_hwc_u8, mean=None, std=None, nthreads=4):
+    """(N,H,W,C) uint8 -> (N,C,H,W) float32 with per-channel (x-mean)/std,
+    threaded in C++ — the host-side hot loop feeding device_put. Scalar
+    mean/std broadcast; per-channel lists must have length C (the C kernel
+    indexes mean[ch] blindly)."""
+    import numpy as np
+
+    L = _require_lib()
+    src = np.ascontiguousarray(batch_hwc_u8, dtype=np.uint8)
+    n, h, w, c = src.shape
+
+    def _chanvec(v, what):
+        if v is None:
+            return None
+        arr = np.broadcast_to(np.asarray(v, np.float32), (c,)) if np.ndim(v) == 0 \
+            else np.asarray(v, np.float32)
+        if arr.shape != (c,):
+            raise ValueError(f"{what} must be a scalar or length-{c} per-channel "
+                             f"sequence, got shape {arr.shape}")
+        return np.ascontiguousarray(arr)
+
+    mean_v = _chanvec(mean, "mean")
+    std_v = _chanvec(std, "std")
+    dst = np.empty((n, c, h, w), np.float32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    mean_p = mean_v.ctypes.data_as(f32p) if mean_v is not None else None
+    std_inv = np.ascontiguousarray(1.0 / std_v) if std_v is not None else None
+    std_p = std_inv.ctypes.data_as(f32p) if std_inv is not None else None
+    L.MXTPUBatchToCHWFloat(_u8p(src), n, h, w, c, mean_p, std_p,
+                           dst.ctypes.data_as(f32p), nthreads)
+    return dst
+
+
+def storage_stats():
+    """(in_use_bytes, pooled_bytes, hits, misses) of the native host pool."""
+    L = _require_lib()
+    out = (ctypes.c_uint64 * 4)()
+    L.MXTPUStorageStats(out)
+    return tuple(out)
 
 
 def available() -> bool:

@@ -3,11 +3,14 @@
 The reference's three comm stacks (ps-lite ZMQ, NCCL, CUDA p2p comm trees)
 collapse into *one* mechanism here: a ``jax.sharding.Mesh`` + sharding
 annotations, with GSPMD emitting all collectives over ICI/DCN. This package
-adds the parallelism the reference never had (TP, SP/CP ring attention) as
-first-class capabilities, per the build contract.
+adds the parallelism the reference never had (TP, SP/CP ring attention,
+GPipe-style PP, expert-parallel MoE) as first-class capabilities, per the
+build contract.
 """
 from .mesh import MeshConfig, make_mesh, local_mesh  # noqa: F401
 from .sharding import ShardingRules, named_sharding, shard_params  # noqa: F401
 from .train_step import TrainStep  # noqa: F401
 from .distributed_trainer import DistributedTrainer, init as dist_init  # noqa: F401
 from . import ring_attention  # noqa: F401
+from .pipeline import pipeline_apply, stack_stage_params, stage_sharding  # noqa: F401
+from .moe import moe_ffn, init_moe_params, moe_param_specs  # noqa: F401

@@ -1,0 +1,110 @@
+"""Mixture-of-Experts with expert parallelism over an ``ep`` mesh axis.
+
+New capability relative to the reference (MXNet 1.x has no MoE / EP). The
+TPU-native shape, after Switch-Transformer / mesh-tensorflow:
+
+  - expert FFN weights carry a leading expert axis sharded ``P('ep', ...)``;
+  - tokens are sharded over the same axis (dp == ep here, the common fused
+    layout); inside ``shard_map`` each device top-1 routes its local tokens,
+    packs them into per-expert capacity slots (einsum dispatch — dense
+    one-hot math the MXU eats directly, no host-side sorting), and a pair of
+    ``all_to_all`` collectives carries tokens to their expert's device and
+    back over ICI;
+  - dropped tokens (capacity overflow) pass through with zero contribution,
+    the standard Switch behavior; an auxiliary load-balance loss
+    (mean_prob · mean_assignment · E) is returned for the trainer to add.
+"""
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["moe_ffn", "init_moe_params", "moe_param_specs"]
+
+
+def init_moe_params(key, d_model: int, d_hidden: int, num_experts: int,
+                    dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(key, 3)
+    s1 = 1.0 / math.sqrt(d_model)
+    s2 = 1.0 / math.sqrt(d_hidden)
+    return {
+        "gate": jax.random.normal(k1, (d_model, num_experts), dtype) * s1,
+        "w1": jax.random.normal(k2, (num_experts, d_model, d_hidden), dtype) * s1,
+        "w2": jax.random.normal(k3, (num_experts, d_hidden, d_model), dtype) * s2,
+    }
+
+
+def moe_param_specs(axis: str = "ep"):
+    return {"gate": P(), "w1": P(axis, None, None), "w2": P(axis, None, None)}
+
+
+def _route(x, gate_w, num_experts, capacity):
+    """Top-1 switch routing for local tokens [n, d] -> dispatch/combine
+    tensors + aux loss terms (all dense, static-shaped)."""
+    logits = x @ gate_w                                   # [n, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                   # [n]
+    prob = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert, num_experts, dtype=jnp.float32)  # [n, E]
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0       # [n, E], -1 elsewhere
+    pos_in_expert = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [n]
+    keep = (pos_in_expert < capacity) & (pos_in_expert >= 0)
+    pos_oh = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)  # [n, C]
+    # dispatch[n, e, c] = 1 iff token n goes to slot c of expert e
+    dispatch = onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
+    combine = dispatch * prob[:, None, None]
+    # Switch aux loss: E * sum_e mean_prob_e * mean_frac_e
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(onehot, axis=0)
+    aux = num_experts * jnp.sum(me * ce)
+    return dispatch, combine, aux
+
+
+def moe_ffn(x, params, mesh: Mesh, axis: str = "ep",
+            capacity_factor: float = 1.25,
+            activation=jax.nn.gelu) -> Tuple[jax.Array, jax.Array]:
+    """Apply the expert-parallel MoE FFN.
+
+    x: [B, T, d] (token dims sharded over ``axis`` outside or replicated —
+    shard_map partitions dim 0 here). Returns (out [B, T, d], aux_loss)."""
+    E = params["w1"].shape[0]
+    D = mesh.shape[axis]
+    if E % D:
+        raise ValueError(f"num_experts {E} must divide over mesh axis {axis}={D}")
+    B, T, d = x.shape
+    if B % D:
+        raise ValueError(f"batch {B} must be divisible by ep={D}")
+    n_local = (B // D) * T
+    capacity = int(math.ceil(n_local / E * capacity_factor))
+
+    def per_device(x_loc, gate_w, w1_loc, w2_loc):
+        # x_loc [B/D, T, d]; w1_loc [E/D, d, h]; w2_loc [E/D, h, d]
+        xt = x_loc.reshape(-1, d)                          # [n, d]
+        dispatch, combine, aux = _route(xt, gate_w, E, capacity)
+        # pack: [E, C, d] tokens bound for each (global) expert
+        packed = jnp.einsum("nec,nd->ecd", dispatch, xt.astype(jnp.float32))
+        # all_to_all: split expert dim over devices, gather sender shards ->
+        # [E/D, D*C, d]: this device's experts, tokens from every peer
+        recv = lax.all_to_all(packed, axis, split_axis=0, concat_axis=1,
+                              tiled=True)
+        h = activation(jnp.einsum("ecd,edh->ech", recv, w1_loc.astype(jnp.float32)))
+        y = jnp.einsum("ech,ehd->ecd", h, w2_loc.astype(jnp.float32))
+        # return trip: back to the senders' layout [E, C, d]
+        back = lax.all_to_all(y, axis, split_axis=1, concat_axis=0, tiled=True)
+        out = jnp.einsum("nec,ecd->nd", combine, back)
+        return out.reshape(x_loc.shape).astype(x_loc.dtype), lax.pmean(aux, axis)
+
+    from jax.experimental.shard_map import shard_map
+
+    out, aux = shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(axis), P(), P(axis, None, None), P(axis, None, None)),
+        out_specs=(P(axis), P()), check_rep=False,
+    )(x, params["gate"], params["w1"], params["w2"])
+    return out, aux

@@ -1,0 +1,246 @@
+// Native host runtime: pooled storage manager, image augmentation kernels,
+// parallel batch assembly.
+//
+// TPU-native counterparts of three reference C++ subsystems:
+//   - src/storage/pooled_storage_manager.h (GPUPooledRoundedStorageManager):
+//     here a size-class host pool for batch staging buffers — on TPU the
+//     device allocator belongs to PJRT/XLA, but the host side of the input
+//     pipeline still churns large per-batch buffers every step.
+//   - src/io/image_aug_default.cc: crop / mirror / bilinear-resize on decoded
+//     uint8 HWC images (resize matches jax.image.resize "linear": half-pixel
+//     centers, edge clamp) so the Python and native paths agree bit-close.
+//   - src/io/iter_prefetcher.h batch assembly: HWC u8 -> CHW f32 normalize
+//     over the whole batch with a small thread pool — the per-step host hot
+//     loop that feeds device_put.
+//
+// Exposed through the same flat MXTPU* C ABI as recordio.cc.
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace mxtpu {
+
+// ---------------------------------------------------------------------------
+// Pooled storage manager (size-class rounding, free-list reuse)
+// ---------------------------------------------------------------------------
+class StoragePool {
+ public:
+  static StoragePool& Get() {
+    static StoragePool inst;
+    return inst;
+  }
+
+  void* Alloc(size_t nbytes) {
+    size_t rounded = RoundSize(nbytes);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = free_.find(rounded);
+      if (it != free_.end() && !it->second.empty()) {
+        void* p = it->second.back();
+        it->second.pop_back();
+        pooled_bytes_ -= rounded;
+        in_use_bytes_ += rounded;
+        ++hits_;
+        sizes_[p] = rounded;
+        return p;
+      }
+    }
+    void* p = nullptr;
+    if (posix_memalign(&p, 64, rounded) != 0) return nullptr;
+    std::lock_guard<std::mutex> lk(mu_);
+    ++misses_;
+    in_use_bytes_ += rounded;
+    sizes_[p] = rounded;
+    return p;
+  }
+
+  void Free(void* p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = sizes_.find(p);
+    if (it == sizes_.end()) return;  // not ours
+    size_t rounded = it->second;
+    sizes_.erase(it);
+    in_use_bytes_ -= rounded;
+    pooled_bytes_ += rounded;
+    free_[rounded].push_back(p);
+  }
+
+  void ReleaseAll() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : free_)
+      for (void* p : kv.second) ::free(p);
+    free_.clear();
+    pooled_bytes_ = 0;
+  }
+
+  void Stats(uint64_t* out4) {
+    std::lock_guard<std::mutex> lk(mu_);
+    out4[0] = in_use_bytes_;
+    out4[1] = pooled_bytes_;
+    out4[2] = hits_;
+    out4[3] = misses_;
+  }
+
+ private:
+  static size_t RoundSize(size_t n) {
+    // round to next power of two >= 64 (the reference's "Rounded" manager)
+    size_t r = 64;
+    while (r < n) r <<= 1;
+    return r;
+  }
+
+  std::mutex mu_;
+  std::map<size_t, std::vector<void*>> free_;
+  std::map<void*, size_t> sizes_;
+  uint64_t in_use_bytes_ = 0, pooled_bytes_ = 0, hits_ = 0, misses_ = 0;
+};
+
+// ---------------------------------------------------------------------------
+// image kernels (uint8 HWC)
+// ---------------------------------------------------------------------------
+// jax.image.resize 'linear' semantics: src coordinate of output pixel i is
+// (i + 0.5) * (in / out) - 0.5, clamped; bilinear blend of the two nearest.
+void BilinearResize(const uint8_t* src, int h, int w, int c,
+                    uint8_t* dst, int oh, int ow) {
+  const float sy = static_cast<float>(h) / oh;
+  const float sx = static_cast<float>(w) / ow;
+  for (int oy = 0; oy < oh; ++oy) {
+    float fy = (oy + 0.5f) * sy - 0.5f;
+    fy = std::min(std::max(fy, 0.0f), static_cast<float>(h - 1));
+    int y0 = static_cast<int>(fy);
+    int y1 = std::min(y0 + 1, h - 1);
+    float wy = fy - y0;
+    for (int ox = 0; ox < ow; ++ox) {
+      float fx = (ox + 0.5f) * sx - 0.5f;
+      fx = std::min(std::max(fx, 0.0f), static_cast<float>(w - 1));
+      int x0 = static_cast<int>(fx);
+      int x1 = std::min(x0 + 1, w - 1);
+      float wx = fx - x0;
+      const uint8_t* p00 = src + (static_cast<size_t>(y0) * w + x0) * c;
+      const uint8_t* p01 = src + (static_cast<size_t>(y0) * w + x1) * c;
+      const uint8_t* p10 = src + (static_cast<size_t>(y1) * w + x0) * c;
+      const uint8_t* p11 = src + (static_cast<size_t>(y1) * w + x1) * c;
+      uint8_t* out = dst + (static_cast<size_t>(oy) * ow + ox) * c;
+      for (int ch = 0; ch < c; ++ch) {
+        float top = p00[ch] * (1 - wx) + p01[ch] * wx;
+        float bot = p10[ch] * (1 - wx) + p11[ch] * wx;
+        float v = top * (1 - wy) + bot * wy;
+        out[ch] = static_cast<uint8_t>(std::min(std::max(v + 0.5f, 0.0f), 255.0f));
+      }
+    }
+  }
+}
+
+void Crop(const uint8_t* src, int h, int w, int c, int y0, int x0,
+          uint8_t* dst, int ch_, int cw) {
+  (void)h;
+  for (int y = 0; y < ch_; ++y) {
+    std::memcpy(dst + static_cast<size_t>(y) * cw * c,
+                src + ((static_cast<size_t>(y0) + y) * w + x0) * c,
+                static_cast<size_t>(cw) * c);
+  }
+}
+
+void FlipH(const uint8_t* src, int h, int w, int c, uint8_t* dst) {
+  for (int y = 0; y < h; ++y) {
+    for (int x = 0; x < w; ++x) {
+      std::memcpy(dst + (static_cast<size_t>(y) * w + x) * c,
+                  src + (static_cast<size_t>(y) * w + (w - 1 - x)) * c, c);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// batch assembly: n HWC u8 images -> one NCHW f32 buffer, normalized
+// ---------------------------------------------------------------------------
+void ToCHWFloatOne(const uint8_t* src, int h, int w, int c,
+                   const float* mean, const float* std_inv, float* dst) {
+  const size_t plane = static_cast<size_t>(h) * w;
+  for (int ch = 0; ch < c; ++ch) {
+    const float m = mean ? mean[ch] : 0.0f;
+    const float si = std_inv ? std_inv[ch] : 1.0f;
+    float* out = dst + ch * plane;
+    const uint8_t* in = src + ch;
+    for (size_t i = 0; i < plane; ++i) out[i] = (in[i * c] - m) * si;
+  }
+}
+
+void BatchToCHWFloat(const uint8_t* src, int n, int h, int w, int c,
+                     const float* mean, const float* std_inv, float* dst,
+                     int nthreads) {
+  const size_t img_in = static_cast<size_t>(h) * w * c;
+  const size_t img_out = img_in;
+  nthreads = std::max(1, std::min(nthreads, n));
+  std::atomic<int> next(0);
+  auto worker = [&] {
+    int i;
+    while ((i = next.fetch_add(1)) < n) {
+      ToCHWFloatOne(src + i * img_in, h, w, c, mean, std_inv, dst + i * img_out);
+    }
+  };
+  if (nthreads == 1) {
+    worker();
+    return;
+  }
+  std::vector<std::thread> th;
+  for (int t = 0; t < nthreads; ++t) th.emplace_back(worker);
+  for (auto& t : th) t.join();
+}
+
+}  // namespace mxtpu
+
+extern "C" {
+
+void* MXTPUStorageAlloc(uint64_t nbytes) {
+  return mxtpu::StoragePool::Get().Alloc(nbytes);
+}
+
+int MXTPUStorageFree(void* p) {
+  mxtpu::StoragePool::Get().Free(p);
+  return 0;
+}
+
+int MXTPUStorageReleaseAll() {
+  mxtpu::StoragePool::Get().ReleaseAll();
+  return 0;
+}
+
+int MXTPUStorageStats(uint64_t* out4) {
+  mxtpu::StoragePool::Get().Stats(out4);
+  return 0;
+}
+
+int MXTPUImageResize(const uint8_t* src, int h, int w, int c,
+                     uint8_t* dst, int oh, int ow) {
+  mxtpu::BilinearResize(src, h, w, c, dst, oh, ow);
+  return 0;
+}
+
+int MXTPUImageCrop(const uint8_t* src, int h, int w, int c, int y0, int x0,
+                   uint8_t* dst, int ch, int cw) {
+  if (y0 < 0 || x0 < 0 || y0 + ch > h || x0 + cw > w) return -1;
+  mxtpu::Crop(src, h, w, c, y0, x0, dst, ch, cw);
+  return 0;
+}
+
+int MXTPUImageFlipH(const uint8_t* src, int h, int w, int c, uint8_t* dst) {
+  mxtpu::FlipH(src, h, w, c, dst);
+  return 0;
+}
+
+int MXTPUBatchToCHWFloat(const uint8_t* src, int n, int h, int w, int c,
+                         const float* mean, const float* std_inv, float* dst,
+                         int nthreads) {
+  mxtpu::BatchToCHWFloat(src, n, h, w, c, mean, std_inv, dst, nthreads);
+  return 0;
+}
+
+}  // extern "C"

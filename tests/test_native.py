@@ -80,3 +80,107 @@ def test_native_prefetcher_early_close(tmp_path):
     next(pf)
     next(pf)
     pf.close()  # must not hang or crash with producers mid-flight
+
+
+def test_native_image_kernels_match_numpy():
+    """runtime.cc aug kernels vs numpy/jax oracles."""
+    img = (np.random.rand(17, 23, 3) * 255).astype(np.uint8)
+    np.testing.assert_array_equal(native.image_flip_h(img), img[:, ::-1])
+    np.testing.assert_array_equal(native.image_crop(img, 2, 3, 10, 15),
+                                  img[2:12, 3:18])
+    with pytest.raises(ValueError):
+        native.image_crop(img, 10, 10, 10, 15)
+
+
+def test_native_resize_matches_jax_linear():
+    """Native bilinear == jax.image.resize 'linear' (same half-pixel rule)."""
+    import jax
+    import jax.numpy as jnp
+
+    img = (np.random.rand(31, 19, 3) * 255).astype(np.uint8)
+    got = native.image_resize(img, 14, 10).astype(np.float32)
+    ref = np.asarray(jax.image.resize(jnp.asarray(img, jnp.float32),
+                                      (14, 10, 3), method="linear", antialias=False))
+    # u8 output rounds; allow 1 LSB
+    assert np.max(np.abs(got - np.clip(np.round(ref), 0, 255))) <= 1.0
+
+
+def test_native_batch_to_chw_float():
+    batch = (np.random.rand(6, 8, 8, 3) * 255).astype(np.uint8)
+    mean, std = [10.0, 20.0, 30.0], [2.0, 4.0, 8.0]
+    out = native.batch_to_chw_float(batch, mean=mean, std=std, nthreads=3)
+    expect = ((batch.astype(np.float32) - mean) / std).transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+    # no-normalization path
+    out2 = native.batch_to_chw_float(batch)
+    np.testing.assert_allclose(out2, batch.astype(np.float32).transpose(0, 3, 1, 2))
+
+
+def test_native_storage_pool_reuse():
+    L = native.lib()
+    p1 = L.MXTPUStorageAlloc(1000)
+    L.MXTPUStorageFree(p1)
+    p2 = L.MXTPUStorageAlloc(900)  # same 1024 size class -> pooled hit
+    in_use, pooled, hits, misses = native.storage_stats()
+    assert hits >= 1
+    assert in_use >= 1024
+    L.MXTPUStorageFree(p2)
+    L.MXTPUStorageReleaseAll()
+    in_use, pooled, _, _ = native.storage_stats()
+    assert pooled == 0
+
+
+def test_imresize_native_path_matches_jax():
+    """mx.image.imresize dispatches u8 host arrays to the native kernel and
+    must agree with the jax path it replaces."""
+    from mxnet_tpu import image as mx_image
+
+    img = (np.random.rand(21, 13, 3) * 255).astype(np.uint8)
+    got = mx_image.imresize(img, 9, 7).asnumpy().astype(np.float32)  # w=9, h=7
+    import jax
+    import jax.numpy as jnp
+
+    ref = np.asarray(jax.image.resize(jnp.asarray(img, jnp.float32), (7, 9, 3),
+                                      method="linear", antialias=False))
+    assert np.max(np.abs(got - np.clip(np.round(ref), 0, 255))) <= 1.0
+
+
+def test_batchify_images_native_vs_python():
+    from mxnet_tpu import image as mx_image
+
+    batch = (np.random.rand(5, 6, 6, 3) * 255).astype(np.uint8)
+    got = mx_image.batchify_images(batch, mean=[1, 2, 3], std=[2, 2, 2]).asnumpy()
+    expect = ((batch.astype(np.float32) - [1, 2, 3]) / [2, 2, 2]).transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+    # float input falls back to the numpy path with identical semantics
+    got_f = mx_image.batchify_images(batch.astype(np.float32), mean=[1, 2, 3],
+                                     std=[2, 2, 2]).asnumpy()
+    np.testing.assert_allclose(got_f, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_batchify_scalar_mean_std_broadcasts():
+    """Scalar mean/std broadcast instead of reading past a 1-float buffer."""
+    from mxnet_tpu import image as mx_image
+
+    batch = (np.random.rand(3, 5, 5, 3) * 255).astype(np.uint8)
+    got = mx_image.batchify_images(batch, mean=127.5, std=2.0).asnumpy()
+    expect = ((batch.astype(np.float32) - 127.5) / 2.0).transpose(0, 3, 1, 2)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-4)
+    with pytest.raises(ValueError, match="per-channel"):
+        native.batch_to_chw_float(batch, mean=[1.0, 2.0])
+
+
+def test_imresize_traces_under_jit():
+    """imresize must stay traceable (the pre-native behavior)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import image as mx_image
+    from mxnet_tpu.ndarray import NDArray
+
+    @jax.jit
+    def f(x):
+        return mx_image.imresize(NDArray(x), 4, 4)._data
+
+    out = f(jnp.ones((8, 8, 3), jnp.float32))
+    assert out.shape == (4, 4, 3)
