@@ -36,6 +36,11 @@ from . import models  # noqa: F401
 from . import module  # noqa: F401
 from . import module as mod  # noqa: F401
 from . import operator  # noqa: F401
+from . import rnn  # noqa: F401
+from . import monitor  # noqa: F401
+from .monitor import Monitor  # noqa: F401
+from . import visualization  # noqa: F401
+from . import visualization as viz  # noqa: F401
 from . import callback  # noqa: F401
 from . import contrib  # noqa: F401
 from . import image  # noqa: F401

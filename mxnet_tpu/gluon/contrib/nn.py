@@ -1,0 +1,83 @@
+"""``gluon.contrib.nn`` (reference: ``python/mxnet/gluon/contrib/nn/
+basic_layers.py``): Concurrent/HybridConcurrent, Identity, SparseEmbedding,
+SyncBatchNorm, PixelShuffle2D."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn import BatchNorm, Embedding, HybridSequential
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle2D"]
+
+
+class HybridConcurrent(HybridSequential):
+    """Feed the input to every child, concat outputs on ``axis``."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x, *args):
+        outs = [block(x) for block in self._children.values()]
+        from ... import ndarray as nd_mod
+        from ... import symbol as sym_mod
+
+        F = sym_mod if isinstance(outs[0], sym_mod.Symbol) else nd_mod
+        return F.concat(*outs, dim=self.axis)
+
+
+class Concurrent(HybridConcurrent):
+    """Imperative alias (the reference kept a non-hybrid variant)."""
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(HybridBlock):
+    """Embedding whose gradient is row_sparse (reference: sparse_grad=True
+    Embedding backed by rsp EmbeddingOpBackward). On TPU dense gather is the
+    fast path; the rsp-gradient contract survives through the optimizer's
+    lazy row update (``Optimizer._update_lazy``), so this is a thin alias
+    documenting that semantics rather than a distinct kernel."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.embedding = Embedding(input_dim, output_dim, dtype=dtype)
+
+    def hybrid_forward(self, F, x):
+        return self.embedding(x)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm. In the reference this synchronizes batch
+    statistics across GPUs with a key-value handshake
+    (``src/operator/contrib/sync_batch_norm.cc``); under GSPMD the batch
+    axis is sharded on the mesh and the mean/var reductions inside
+    ``batch_norm`` lower to all-reduces over ICI automatically, so the
+    single-device graph IS the synchronized graph. ``num_devices`` is
+    accepted for API compat and unused."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, **kwargs):
+        super().__init__(momentum=momentum, epsilon=epsilon,
+                         in_channels=in_channels, **kwargs)
+
+
+class PixelShuffle2D(HybridBlock):
+    """(N, C*f1*f2, H, W) -> (N, C, H*f1, W*f2) sub-pixel upsampling."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factors = ((int(factor),) * 2 if not isinstance(factor, (list, tuple))
+                         else tuple(int(f) for f in factor))
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factors
+        n, c_in, h, w = x.shape
+        c = c_in // (f1 * f2)
+        x = x.reshape((n, c, f1, f2, h, w))
+        x = x.transpose((0, 1, 4, 2, 5, 3))  # n c h f1 w f2
+        return x.reshape((n, c, h * f1, w * f2))

@@ -7,7 +7,8 @@ from .block import HybridBlock
 
 __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss",
            "SoftmaxCrossEntropyLoss", "SoftmaxCELoss", "KLDivLoss", "HuberLoss",
-           "HingeLoss", "CosineEmbeddingLoss"]
+           "HingeLoss", "CosineEmbeddingLoss", "SquaredHingeLoss", "LogisticLoss",
+           "TripletLoss", "PoissonNLLLoss", "CTCLoss"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -154,4 +155,103 @@ class CosineEmbeddingLoss(Loss):
             F.sqrt(F.square(input1).sum(axis=1)) * F.sqrt(F.square(input2).sum(axis=1)) + 1e-12)
         label = label.reshape(sim.shape)
         loss = F.where(label == 1, 1 - sim, F.relu(sim - self._margin))
+        return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class SquaredHingeLoss(Loss):
+    """max(0, 1 - pred*label)^2, label in {-1, 1}."""
+
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        loss = F.square(F.relu(self._margin - pred * label))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.reshape((loss.shape[0], -1)).mean(axis=1)
+
+
+class LogisticLoss(Loss):
+    """log(1 + exp(-pred*label)); label_format 'signed' {-1,1} or 'binary' {0,1}."""
+
+    def __init__(self, weight=None, batch_axis=0, label_format="signed", **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        if label_format not in ("signed", "binary"):
+            raise ValueError(f"unknown label_format {label_format!r}")
+        self._label_format = label_format
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = label.reshape(pred.shape)
+        if self._label_format == "binary":
+            label = 2 * label - 1
+        loss = F.relu(-pred * label) + F.log(1 + F.exp(-F.abs(pred * label)))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.reshape((loss.shape[0], -1)).mean(axis=1)
+
+
+class TripletLoss(Loss):
+    """max(0, margin + |a-p|^2 - |a-n|^2) over the trailing axes."""
+
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, positive, negative, sample_weight=None):
+        positive = positive.reshape(pred.shape)
+        negative = negative.reshape(pred.shape)
+        d = (F.square(pred - positive) - F.square(pred - negative))
+        loss = F.relu(d.reshape((d.shape[0], -1)).sum(axis=1) + self._margin)
+        return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    """pred - label*log(pred) (+ Stirling approx when requested); pred is the
+    rate (from_logits=False applies exp)."""
+
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None, epsilon=1e-08):
+        label = label.reshape(pred.shape)
+        if self._from_logits:
+            loss = F.exp(pred) - label * pred
+        else:
+            loss = pred - label * F.log(pred + epsilon)
+        if self._compute_full:
+            stirling = (label * F.log(label + epsilon) - label
+                        + 0.5 * F.log(2 * jnp.pi * (label + epsilon)))
+            stirling = stirling * (label > 1)
+            loss = loss + stirling
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.reshape((loss.shape[0], -1)).mean(axis=1)
+
+
+class CTCLoss(Loss):
+    """CTC over (T, B, C) or layout-specified activations (reference:
+    gluon/loss.py CTCLoss over src/operator/nn/ctc_loss.cc; here the op is
+    the lax.scan alpha recursion registered as ``CTCLoss``)."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        if layout not in ("NTC", "TNC"):
+            raise ValueError(f"unsupported layout {layout!r}")
+        if label_layout not in ("NT", "TN"):
+            raise ValueError(f"unsupported label_layout {label_layout!r}")
+        super().__init__(weight, int(label_layout.find("N")), **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def hybrid_forward(self, F, pred, label, pred_lengths=None, label_lengths=None,
+                       sample_weight=None):
+        if self._layout == "NTC":
+            pred = pred.transpose((1, 0, 2))
+        if self._label_layout == "TN":
+            label = label.transpose((1, 0))
+        loss = F.CTCLoss(pred, label,
+                         data_lengths=pred_lengths, label_lengths=label_lengths,
+                         use_data_lengths=pred_lengths is not None,
+                         use_label_lengths=label_lengths is not None)
         return _apply_weighting(F, loss, self._weight, sample_weight)

@@ -24,18 +24,15 @@ def _raw(x):
 
 
 def imresize(src, w, h, interp=1):
-    # uint8 host images take the native C++ kernel (same half-pixel linear
-    # semantics, no device round-trip mid-pipeline); everything else —
-    # including tracers under jit — goes through jax.image.resize. The dtype
-    # check reads metadata only; asnumpy happens on the native path alone.
+    # host-resident uint8 numpy images (decode-side augmentation, before any
+    # device transfer) take the native C++ kernel; NDArrays — whose buffers
+    # already live on device — and tracers go through jax.image.resize so no
+    # device round-trip is ever introduced.
     from . import native as _native
 
-    raw0 = src._data if isinstance(src, NDArray) else src
-    is_tracer = isinstance(raw0, jax.core.Tracer)
-    if (not is_tracer and np.dtype(getattr(raw0, "dtype", np.float32)) == np.uint8
-            and getattr(raw0, "ndim", 0) == 3 and _native.available()):
-        src_np = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
-        return NDArray(_native.image_resize(src_np, h, w))
+    if (isinstance(src, np.ndarray) and src.dtype == np.uint8 and src.ndim == 3
+            and _native.available()):
+        return NDArray(_native.image_resize(src, h, w))
     x = _raw(src).astype(jnp.float32)
     # antialias=False = plain bilinear, the reference's cv2.INTER_LINEAR
     # semantics (src/io/image_aug_default.cc) and the native kernel's
@@ -77,8 +74,11 @@ def batchify_images(batch, mean=None, std=None, nthreads=4):
 
     arr = np.asarray(batch)
     if arr.dtype == np.uint8 and arr.ndim == 4 and _native.available():
+        # pooled staging buffer is safe: NDArray() copies it to device before
+        # the next same-shape call can overwrite it
         return NDArray(_native.batch_to_chw_float(arr, mean=mean, std=std,
-                                                  nthreads=nthreads))
+                                                  nthreads=nthreads,
+                                                  reuse_staging=True))
     out = arr.astype(np.float32)
     if mean is not None:
         out = out - np.asarray(mean, np.float32)

@@ -1,0 +1,71 @@
+"""``mx.monitor`` — training-time tensor monitor (reference:
+``python/mxnet/monitor.py``): periodically runs a stat function over
+outputs/params/grads and prints a sorted table. The reference hooked the
+executor's per-op outputs via ``MXExecutorSetMonitorCallback``; under XLA
+intermediate activations are fused away, so the monitor observes the module
+boundary tensors (params, grads, outputs) — the ones that exist."""
+from __future__ import annotations
+
+import logging
+import math
+from typing import Callable, List, Tuple
+
+import numpy as np
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(arr: np.ndarray) -> float:
+    return float(np.abs(arr).sum() / max(arr.size, 1))
+
+
+class Monitor:
+    def __init__(self, interval: int, stat_func: Callable = None, pattern=".*",
+                 sort=False):
+        import re
+
+        self.interval = max(1, int(interval))
+        self.stat_func = stat_func or _default_stat
+        self.re = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self.queue: List[Tuple[int, str, float]] = []
+
+    def install(self, module_or_block):
+        self._target = module_or_block
+        return self
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+        self.step += 1
+
+    def toc(self) -> List[Tuple[int, str, float]]:
+        if not self.activated:
+            return []
+        tgt = getattr(self, "_target", None)
+        if tgt is not None:
+            params = (tgt.collect_params() if hasattr(tgt, "collect_params")
+                      else getattr(tgt, "_arg_params", {}) or {})
+            items = params.items() if hasattr(params, "items") else []
+            for name, p in items:
+                if not self.re.match(name):
+                    continue
+                data = p.data() if hasattr(p, "data") else p
+                self.queue.append((self.step, name,
+                                   self.stat_func(np.asarray(data.asnumpy()))))
+                grad = getattr(p, "grad", None)
+                g = grad() if callable(grad) else grad
+                if g is not None:
+                    self.queue.append((self.step, name + "_grad",
+                                       self.stat_func(np.asarray(g.asnumpy()))))
+        self.activated = False
+        res = sorted(self.queue, key=lambda x: x[1]) if self.sort else list(self.queue)
+        return res
+
+    def toc_print(self):
+        for step, name, value in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name,
+                         f"{value:.6g}" if math.isfinite(value) else str(value))

@@ -142,11 +142,38 @@ def image_crop(src, y0, x0, ch, cw):
     return dst
 
 
-def batch_to_chw_float(batch_hwc_u8, mean=None, std=None, nthreads=4):
+_STAGING: dict = {}
+
+
+def _staging_f32(shape):
+    """Reusable float32 staging buffer from the native pool, keyed by shape.
+    Safe to reuse because callers (batchify_images) immediately copy the
+    result to device; the pool backs the per-step churn the reference's
+    pinned-memory pool handled (src/storage/pooled_storage_manager.h)."""
+    import numpy as np
+
+    key = tuple(shape)
+    if key not in _STAGING:
+        L = _require_lib()
+        nbytes = int(np.prod(shape)) * 4
+        ptr = L.MXTPUStorageAlloc(nbytes)
+        if not ptr:
+            return np.empty(shape, np.float32)
+        buf = np.ctypeslib.as_array(
+            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_float)),
+            shape=(int(np.prod(shape)),)).reshape(shape)
+        _STAGING[key] = buf
+    return _STAGING[key]
+
+
+def batch_to_chw_float(batch_hwc_u8, mean=None, std=None, nthreads=4,
+                       reuse_staging=False):
     """(N,H,W,C) uint8 -> (N,C,H,W) float32 with per-channel (x-mean)/std,
     threaded in C++ — the host-side hot loop feeding device_put. Scalar
     mean/std broadcast; per-channel lists must have length C (the C kernel
-    indexes mean[ch] blindly)."""
+    indexes mean[ch] blindly). ``reuse_staging=True`` writes into a pooled
+    buffer that is OVERWRITTEN by the next same-shape call — only for
+    callers that copy the result out (e.g. straight to device) before then."""
     import numpy as np
 
     L = _require_lib()
@@ -165,7 +192,7 @@ def batch_to_chw_float(batch_hwc_u8, mean=None, std=None, nthreads=4):
 
     mean_v = _chanvec(mean, "mean")
     std_v = _chanvec(std, "std")
-    dst = np.empty((n, c, h, w), np.float32)
+    dst = _staging_f32((n, c, h, w)) if reuse_staging else np.empty((n, c, h, w), np.float32)
     f32p = ctypes.POINTER(ctypes.c_float)
     mean_p = mean_v.ctypes.data_as(f32p) if mean_v is not None else None
     std_inv = np.ascontiguousarray(1.0 / std_v) if std_v is not None else None

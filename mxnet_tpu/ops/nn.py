@@ -426,3 +426,81 @@ def bilinear_resize(data, height=None, width=None, scale_height=None, scale_widt
     oh = int(height) if height else int(h * scale_height)
     ow = int(width) if width else int(w * scale_width)
     return jax.image.resize(data, (n, c, oh, ow), method="linear")
+
+
+# --------------------------------------------------------------------------
+# loss ops (reference: src/operator/loss_binary_op.cc smooth_l1 in
+# elemwise_unary_op, src/operator/nn/ctc_loss.cc)
+# --------------------------------------------------------------------------
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    """Huber-style smooth L1 with transition at 1/scalar^2 (the SSD/Faster-
+    RCNN bbox regression loss; reference: smooth_l1 in elemwise ops)."""
+    sigma2 = float(scalar) ** 2
+    a = jnp.abs(data)
+    return jnp.where(a < 1.0 / sigma2, 0.5 * sigma2 * data * data, a - 0.5 / sigma2)
+
+
+@register("CTCLoss", aliases=("ctc_loss", "_contrib_CTCLoss", "_contrib_ctc_loss"))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False, blank_label="first"):
+    """Connectionist temporal classification loss.
+
+    data: (T, B, C) activations (softmax applied internally, like the
+    reference); label: (B, L) class ids, 0-padded when label_lengths absent
+    (blank_label='first': blank id 0, labels are 1-based).
+    Alpha recursion in the log semiring via ``lax.scan`` over time — the
+    lax formulation of the reference's warp-ctc kernel.
+    """
+    T, B, C = data.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)  # [T,B,C]
+    label = label.astype(jnp.int32)
+    blank = 0 if blank_label == "first" else C - 1
+    if label_lengths is not None and use_label_lengths:
+        lab_len = label_lengths.astype(jnp.int32)
+    else:
+        # padding value: 0 for blank_label='first' (labels are 1-based),
+        # -1 for blank_label='last' (0 is a valid class) — reference semantics
+        pad = 0 if blank_label == "first" else -1
+        lab_len = jnp.sum((label != pad).astype(jnp.int32), axis=1)
+    if data_lengths is not None and use_data_lengths:
+        seq_len = data_lengths.astype(jnp.int32)
+    else:
+        seq_len = jnp.full((B,), T, jnp.int32)
+
+    S = 2 * L + 1
+    pos = jnp.arange(S)
+    # ext[b, s]: blank on even s, label[(s-1)//2] on odd s
+    ext = jnp.where(pos[None, :] % 2 == 1,
+                    jnp.take_along_axis(label, jnp.clip((pos[None, :] - 1) // 2, 0, L - 1),
+                                        axis=1),
+                    blank)                                    # [B, S]
+    ext = jnp.clip(ext, 0, C - 1)  # -1 padding is masked by valid_s; keep indices in range
+    neg_inf = jnp.float32(-1e30)
+    # can skip from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_m2)               # [B, S]
+    valid_s = pos[None, :] < (2 * lab_len[:, None] + 1)       # [B, S]
+
+    emit0 = jnp.take_along_axis(logp[0], ext, axis=1)         # [B, S]
+    alpha0 = jnp.where((pos[None, :] < 2) & valid_s, emit0, neg_inf)
+
+    def step(alpha, t):
+        a1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(can_skip, a2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+        emit = jnp.take_along_axis(logp[t], ext, axis=1)
+        new = jnp.where(valid_s, merged + emit, neg_inf)
+        # past the sequence end the lattice freezes
+        new = jnp.where((t < seq_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    # terminal states: S-1 and S-2 for each batch's actual label length
+    send = 2 * lab_len                                        # even terminal (blank)
+    last_blank = jnp.take_along_axis(alpha, send[:, None], axis=1)[:, 0]
+    last_label = jnp.take_along_axis(alpha, jnp.maximum(send - 1, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(last_blank, jnp.where(lab_len > 0, last_label, neg_inf))
+    return -ll

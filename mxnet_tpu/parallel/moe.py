@@ -24,6 +24,23 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """Version-agnostic wrapper: new jax.shard_map uses check_vma, the
+    experimental one check_rep; disable the replication check either way
+    (per-device branches on axis_index are intentionally device-varying)."""
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_vma=False)
+    except TypeError:  # pragma: no cover — older jax
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_rep=False)
+
 __all__ = ["moe_ffn", "init_moe_params", "moe_param_specs"]
 
 
@@ -100,11 +117,9 @@ def moe_ffn(x, params, mesh: Mesh, axis: str = "ep",
         out = jnp.einsum("nec,ecd->nd", combine, back)
         return out.reshape(x_loc.shape).astype(x_loc.dtype), lax.pmean(aux, axis)
 
-    from jax.experimental.shard_map import shard_map
-
     out, aux = shard_map(
         per_device, mesh=mesh,
         in_specs=(P(axis), P(), P(axis, None, None), P(axis, None, None)),
-        out_specs=(P(axis), P()), check_rep=False,
+        out_specs=(P(axis), P()),
     )(x, params["gate"], params["w1"], params["w2"])
     return out, aux

@@ -29,6 +29,23 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """Version-agnostic wrapper: new jax.shard_map uses check_vma, the
+    experimental one check_rep; disable the replication check either way
+    (per-device branches on axis_index are intentionally device-varying)."""
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_vma=False)
+    except TypeError:  # pragma: no cover — older jax
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_rep=False)
+
 __all__ = ["pipeline_apply", "stack_stage_params", "stage_sharding"]
 
 
@@ -95,10 +112,8 @@ def pipeline_apply(stage_fn: Callable, params_stacked, x, mesh: Mesh,
         ys = jnp.where(idx == S - 1, ys, jnp.zeros_like(ys))
         return lax.psum(ys, axis)
 
-    from jax.experimental.shard_map import shard_map
-
     in_specs = (jax.tree_util.tree_map(
         lambda l: P(axis, *([None] * (l.ndim - 1))), params_stacked), P())
-    out = shard_map(per_device, mesh=mesh, in_specs=in_specs, out_specs=P(),
-                    check_rep=False)(params_stacked, xs)
+    out = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                    out_specs=P())(params_stacked, xs)
     return out.reshape(B, *x.shape[1:])

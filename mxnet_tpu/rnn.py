@@ -1,0 +1,205 @@
+"""``mx.rnn`` — legacy symbolic RNN cells (reference: ``python/mxnet/rnn/
+rnn_cell.py``), the API the Module/BucketingModule char-rnn pipelines use.
+
+Cells compose Symbol graphs over the central registry (FullyConnected +
+activations); ``unroll`` lays the time axis out explicitly, which under the
+jit executor compiles to the same fused XLA loop body the ``lax.scan``-based
+``gluon.rnn`` layers produce — bucketing (compile-cache per length) supplies
+the variable-length story, exactly the reference's pairing.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from . import symbol as sym
+from .base import MXNetError
+
+__all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "BidirectionalCell"]
+
+
+class BaseRNNCell:
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._own_params = {}
+
+    def _get_param(self, name):
+        if name not in self._own_params:
+            self._own_params[name] = sym.var(self._prefix + name)
+        return self._own_params[name]
+
+    @property
+    def state_info(self):
+        raise NotImplementedError
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    def _zero_state_like(self, template, num_hidden):
+        """Symbolic zeros [B, num_hidden] derived from a data-dependent
+        template (shape flows through infer-shape instead of a sym.zeros
+        with an unknowable batch)."""
+        probe = sym.slice_axis(template, axis=-1, begin=0, end=1)  # [B, 1]
+        return sym.tile(probe * 0.0, reps=(1, num_hidden))
+
+    def begin_state(self, template=None):
+        raise NotImplementedError
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """inputs: one Symbol [N, T, C] ('NTC') or [T, N, C] ('TNC'), or a
+        list of T Symbols [N, C]. Returns (outputs, states)."""
+        if isinstance(inputs, (list, tuple)):
+            steps = list(inputs)
+        else:
+            t_axis = layout.find("T")
+            steps = [sym.squeeze(sym.slice_axis(inputs, axis=t_axis, begin=t, end=t + 1),
+                                 axis=t_axis) for t in range(length)]
+        states = begin_state if begin_state is not None else self.begin_state(steps[0])
+        outputs = []
+        for x in steps:
+            out, states = self(x, states)
+            outputs.append(out)
+        if merge_outputs:
+            t_axis = 0 if layout == "TNC" else 1
+            outputs = sym.stack(*outputs, axis=t_axis)
+        return outputs, states
+
+
+class RNNCell(BaseRNNCell):
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_"):
+        super().__init__(prefix)
+        self._num_hidden = num_hidden
+        self._activation = activation
+
+    def begin_state(self, template=None):
+        return [self._zero_state_like(template, self._num_hidden)]
+
+    def __call__(self, inputs, states):
+        H = self._num_hidden
+        i2h = sym.FullyConnected(inputs, self._get_param("i2h_weight"),
+                                 self._get_param("i2h_bias"), num_hidden=H)
+        h2h = sym.FullyConnected(states[0], self._get_param("h2h_weight"),
+                                 self._get_param("h2h_bias"), num_hidden=H)
+        out = sym.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(BaseRNNCell):
+    def __init__(self, num_hidden, prefix="lstm_", forget_bias=1.0):
+        super().__init__(prefix)
+        self._num_hidden = num_hidden
+        self._forget_bias = forget_bias
+
+    def begin_state(self, template=None):
+        z = self._zero_state_like(template, self._num_hidden)
+        return [z, z]
+
+    def __call__(self, inputs, states):
+        H = self._num_hidden
+        h, c = states
+        gates = sym.FullyConnected(inputs, self._get_param("i2h_weight"),
+                                   self._get_param("i2h_bias"), num_hidden=4 * H) \
+            + sym.FullyConnected(h, self._get_param("h2h_weight"),
+                                 self._get_param("h2h_bias"), num_hidden=4 * H)
+        i = sym.sigmoid(sym.slice_axis(gates, axis=-1, begin=0, end=H))
+        f = sym.sigmoid(sym.slice_axis(gates, axis=-1, begin=H, end=2 * H)
+                        + self._forget_bias)
+        g = sym.tanh(sym.slice_axis(gates, axis=-1, begin=2 * H, end=3 * H))
+        o = sym.sigmoid(sym.slice_axis(gates, axis=-1, begin=3 * H, end=4 * H))
+        c_new = f * c + i * g
+        h_new = o * sym.tanh(c_new)
+        return h_new, [h_new, c_new]
+
+
+class GRUCell(BaseRNNCell):
+    def __init__(self, num_hidden, prefix="gru_"):
+        super().__init__(prefix)
+        self._num_hidden = num_hidden
+
+    def begin_state(self, template=None):
+        return [self._zero_state_like(template, self._num_hidden)]
+
+    def __call__(self, inputs, states):
+        H = self._num_hidden
+        h = states[0]
+        ig = sym.FullyConnected(inputs, self._get_param("i2h_weight"),
+                                self._get_param("i2h_bias"), num_hidden=3 * H)
+        hg = sym.FullyConnected(h, self._get_param("h2h_weight"),
+                                self._get_param("h2h_bias"), num_hidden=3 * H)
+        ri = sym.slice_axis(ig, axis=-1, begin=0, end=H)
+        zi = sym.slice_axis(ig, axis=-1, begin=H, end=2 * H)
+        ni = sym.slice_axis(ig, axis=-1, begin=2 * H, end=3 * H)
+        rh = sym.slice_axis(hg, axis=-1, begin=0, end=H)
+        zh = sym.slice_axis(hg, axis=-1, begin=H, end=2 * H)
+        nh = sym.slice_axis(hg, axis=-1, begin=2 * H, end=3 * H)
+        r = sym.sigmoid(ri + rh)
+        z = sym.sigmoid(zi + zh)
+        n = sym.tanh(ni + r * nh)
+        out = (1 - z) * n + z * h
+        return out, [out]
+
+
+class SequentialRNNCell(BaseRNNCell):
+    def __init__(self):
+        super().__init__("")
+        self._cells: List[BaseRNNCell] = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+
+    def begin_state(self, template=None):
+        states = []
+        for c in self._cells:
+            states.append(c.begin_state(template))
+        return states
+
+    def __call__(self, inputs, states):
+        next_states = []
+        x = inputs
+        for cell, s in zip(self._cells, states):
+            x, ns = cell(x, s)
+            next_states.append(ns)
+        return x, next_states
+
+
+class BidirectionalCell(BaseRNNCell):
+    def __init__(self, l_cell, r_cell):
+        super().__init__("bi_")
+        self._l, self._r = l_cell, r_cell
+
+    def begin_state(self, template=None):
+        return self._l.begin_state(template) + self._r.begin_state(template)
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell supports unroll() only")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        # begin_state is the concatenation [l_states..., r_states...]
+        # (begin_state() layout); split by each sub-cell's state count
+        l_begin = r_begin = None
+        if begin_state is not None:
+            if not isinstance(inputs, (list, tuple)):
+                probe = sym.squeeze(sym.slice_axis(inputs, axis=layout.find("T"),
+                                                   begin=0, end=1), axis=layout.find("T"))
+            else:
+                probe = inputs[0]
+            n_l = len(self._l.begin_state(probe))
+            l_begin, r_begin = begin_state[:n_l], begin_state[n_l:]
+        l_out, l_states = self._l.unroll(length, inputs, begin_state=l_begin,
+                                         layout=layout, merge_outputs=False)
+        # reverse time for the right cell by unrolling the reversed step list
+        if not isinstance(inputs, (list, tuple)):
+            t_axis = layout.find("T")
+            steps = [sym.squeeze(sym.slice_axis(inputs, axis=t_axis, begin=t, end=t + 1),
+                                 axis=t_axis) for t in range(length)]
+        else:
+            steps = list(inputs)
+        r_out, r_states = self._r.unroll(length, steps[::-1], begin_state=r_begin,
+                                         merge_outputs=False)
+        r_out = r_out[::-1]
+        outs = [sym.concat(lo, ro, dim=-1) for lo, ro in zip(l_out, r_out)]
+        if merge_outputs:
+            outs = sym.stack(*outs, axis=layout.find("T"))
+        return outs, l_states + r_states

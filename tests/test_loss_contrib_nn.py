@@ -1,0 +1,138 @@
+"""New losses (CTC, triplet, poisson, logistic, squared-hinge) and
+gluon.contrib.nn layers (reference: tests/python/unittest/test_loss.py +
+test_gluon_contrib.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.gluon.contrib import nn as cnn
+
+
+def test_ctc_op_matches_torch():
+    import torch
+
+    T, B, C, L = 10, 4, 7, 3
+    acts = np.random.normal(0, 1, (T, B, C)).astype(np.float32)
+    labels = np.random.randint(1, C, (B, L)).astype(np.int32)
+    got = nd.ctc_loss(nd.array(acts), nd.array(labels)).asnumpy()
+    lp = torch.log_softmax(torch.tensor(acts), dim=-1)
+    ref = torch.nn.functional.ctc_loss(
+        lp, torch.tensor(labels.astype(np.int64)),
+        torch.full((B,), T, dtype=torch.long), torch.full((B,), L, dtype=torch.long),
+        blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_block_layouts():
+    T, B, C = 8, 2, 5
+    acts = np.random.normal(size=(B, T, C)).astype(np.float32)  # NTC
+    labels = np.random.randint(1, C, (B, 3)).astype(np.int32)
+    l_ntc = gloss.CTCLoss(layout="NTC")(nd.array(acts), nd.array(labels))
+    l_tnc = gloss.CTCLoss(layout="TNC")(nd.array(acts.transpose(1, 0, 2)),
+                                        nd.array(labels))
+    np.testing.assert_allclose(l_ntc.asnumpy(), l_tnc.asnumpy(), rtol=1e-6)
+    assert (l_ntc.asnumpy() > 0).all()
+
+
+def test_ctc_loss_gradient_flows():
+    acts = nd.array(np.random.normal(size=(6, 2, 5)).astype(np.float32))
+    labels = nd.array(np.random.randint(1, 5, (2, 2)).astype(np.int32))
+    acts.attach_grad()
+    with autograd.record():
+        loss = nd.ctc_loss(acts, labels).sum()
+    loss.backward()
+    g = acts.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_triplet_loss():
+    a = nd.array(np.zeros((4, 8), np.float32))
+    p = nd.array(np.zeros((4, 8), np.float32))
+    n = nd.array(np.ones((4, 8), np.float32))
+    # d(a,p)=0, d(a,n)=8 -> max(0, 1 + 0 - 8) = 0
+    out = gloss.TripletLoss(margin=1)(a, p, n).asnumpy()
+    np.testing.assert_allclose(out, 0.0)
+    # reversed: max(0, 1 + 8 - 0) = 9
+    out2 = gloss.TripletLoss(margin=1)(a, n, p).asnumpy()
+    np.testing.assert_allclose(out2, 9.0)
+
+
+def test_poisson_nll_loss():
+    pred = nd.array(np.array([[1.0, 2.0]], np.float32))
+    label = nd.array(np.array([[3.0, 1.0]], np.float32))
+    out = gloss.PoissonNLLLoss(from_logits=True)(pred, label).asnumpy()
+    expect = np.mean(np.exp([1.0, 2.0]) - np.array([3.0, 1.0]) * np.array([1.0, 2.0]))
+    np.testing.assert_allclose(out, [expect], rtol=1e-5)
+
+
+def test_logistic_and_squared_hinge():
+    pred = nd.array(np.array([[2.0], [-1.5]], np.float32))
+    lab = nd.array(np.array([[1.0], [-1.0]], np.float32))
+    lg = gloss.LogisticLoss()(pred, lab).asnumpy()
+    expect = np.log1p(np.exp(-np.array([2.0, 1.5])))
+    np.testing.assert_allclose(lg, expect, rtol=1e-5)
+    sh = gloss.SquaredHingeLoss()(pred, lab).asnumpy()
+    np.testing.assert_allclose(sh, [0.0, 0.0])
+    sh2 = gloss.SquaredHingeLoss()(pred, nd.array(np.array([[-1.0], [1.0]], np.float32))).asnumpy()
+    np.testing.assert_allclose(sh2, [9.0, 6.25])
+
+
+def test_smooth_l1_op():
+    x = np.linspace(-2, 2, 9).astype(np.float32)
+    out = nd.smooth_l1(nd.array(x), scalar=1.0).asnumpy()
+    expect = np.where(np.abs(x) < 1, 0.5 * x * x, np.abs(x) - 0.5)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_hybrid_concurrent():
+    from mxnet_tpu.gluon import nn
+
+    blk = cnn.HybridConcurrent(axis=-1)
+    blk.add(nn.Dense(3), nn.Dense(5), cnn.Identity())
+    blk.initialize()
+    x = nd.ones((2, 4))
+    out = blk(x)
+    assert out.shape == (2, 3 + 5 + 4)
+
+
+def test_pixel_shuffle_2d():
+    x = np.arange(2 * 8 * 3 * 3, dtype=np.float32).reshape(2, 8, 3, 3)
+    out = cnn.PixelShuffle2D(2)(nd.array(x)).asnumpy()
+    assert out.shape == (2, 2, 6, 6)
+    # torch oracle
+    import torch
+
+    ref = torch.pixel_shuffle(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(out, ref)
+
+
+def test_sync_batch_norm_and_sparse_embedding():
+    sbn = cnn.SyncBatchNorm(in_channels=4, num_devices=8)
+    sbn.initialize()
+    x = nd.array(np.random.normal(size=(2, 4, 5, 5)).astype(np.float32))
+    out = sbn(x)
+    assert out.shape == x.shape
+
+    emb = cnn.SparseEmbedding(10, 6)
+    emb.initialize()
+    idx = nd.array(np.array([[1, 2], [3, 4]]), dtype="int32")
+    out = emb(idx)
+    assert out.shape == (2, 2, 6)
+
+
+def test_ctc_blank_last_inferred_lengths():
+    """blank_label='last': 0 is a valid class; padding is -1 (reference)."""
+    import torch
+
+    T, B, C = 10, 2, 6
+    acts = np.random.normal(size=(T, B, C)).astype(np.float32)
+    labels = np.array([[0, 3, 2], [1, 0, -1]], np.int32)  # row 1 has len 2
+    got = nd.ctc_loss(nd.array(acts), nd.array(labels), blank_label="last").asnumpy()
+    lp = torch.log_softmax(torch.tensor(acts), dim=-1)
+    ref = torch.nn.functional.ctc_loss(
+        lp, torch.tensor(np.array([[0, 3, 2], [1, 0, 0]], np.int64)),
+        torch.full((B,), T, dtype=torch.long), torch.tensor([3, 2]),
+        blank=C - 1, reduction="none").numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)

@@ -1,0 +1,120 @@
+"""Legacy mx.rnn cells, mx.viz, mx.monitor (reference:
+tests/python/unittest/test_rnn.py, test_viz.py, monitor usage in fit)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.base import MXNetError
+
+
+def _bind_and_run(out_sym, feed):
+    ex = out_sym.bind(args={k: nd.array(v) for k, v in feed.items()})
+    return ex.forward()[0].asnumpy()
+
+
+def test_lstm_cell_unroll_matches_manual():
+    """Unrolled symbolic LSTM == step-by-step numpy recurrence."""
+    H, C_in, B, T = 4, 3, 2, 3
+    rs = np.random.RandomState(0)
+    wi = rs.normal(0, 0.2, (4 * H, C_in)).astype(np.float32)
+    wh = rs.normal(0, 0.2, (4 * H, H)).astype(np.float32)
+    bi = rs.normal(0, 0.1, (4 * H,)).astype(np.float32)
+    bh = np.zeros(4 * H, np.float32)
+    x = rs.normal(size=(B, T, C_in)).astype(np.float32)
+
+    cell = mx.rnn.LSTMCell(num_hidden=H, prefix="l0_", forget_bias=0.0)
+    outs, _ = cell.unroll(T, sym.var("data"), layout="NTC", merge_outputs=True)
+    got = _bind_and_run(outs, {"data": x, "l0_i2h_weight": wi, "l0_i2h_bias": bi,
+                               "l0_h2h_weight": wh, "l0_h2h_bias": bh})
+
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    expect = []
+    for t in range(T):
+        g = x[:, t] @ wi.T + bi + h @ wh.T + bh
+        i, f, gg, o = g[:, :H], g[:, H:2 * H], g[:, 2 * H:3 * H], g[:, 3 * H:]
+        c = sigmoid(f) * c + sigmoid(i) * np.tanh(gg)
+        h = sigmoid(o) * np.tanh(c)
+        expect.append(h)
+    np.testing.assert_allclose(got, np.stack(expect, axis=1), rtol=1e-4, atol=1e-5)
+
+
+def test_gru_and_sequential_cells_shapes():
+    seq = mx.rnn.SequentialRNNCell()
+    seq.add(mx.rnn.GRUCell(5, prefix="g0_"))
+    seq.add(mx.rnn.RNNCell(7, prefix="r0_"))
+    outs, states = seq.unroll(4, sym.var("data"), merge_outputs=True)
+    args = outs.list_arguments()
+    feed = {"data": np.random.rand(2, 4, 3).astype(np.float32)}
+    rs = np.random.RandomState(1)
+    shapes = {"g0_i2h_weight": (15, 3), "g0_i2h_bias": (15,),
+              "g0_h2h_weight": (15, 5), "g0_h2h_bias": (15,),
+              "r0_i2h_weight": (7, 5), "r0_i2h_bias": (7,),
+              "r0_h2h_weight": (7, 7), "r0_h2h_bias": (7,)}
+    for k, s in shapes.items():
+        assert k in args, k
+        feed[k] = rs.normal(0, 0.1, s).astype(np.float32)
+    got = _bind_and_run(outs, feed)
+    assert got.shape == (2, 4, 7)
+
+
+def test_bidirectional_cell():
+    bi = mx.rnn.BidirectionalCell(mx.rnn.RNNCell(4, prefix="fw_"),
+                                  mx.rnn.RNNCell(4, prefix="bw_"))
+    outs, _ = bi.unroll(3, sym.var("data"), merge_outputs=True)
+    rs = np.random.RandomState(2)
+    feed = {"data": rs.normal(size=(2, 3, 5)).astype(np.float32)}
+    for p in ("fw_", "bw_"):
+        feed[p + "i2h_weight"] = rs.normal(0, 0.1, (4, 5)).astype(np.float32)
+        feed[p + "i2h_bias"] = np.zeros(4, np.float32)
+        feed[p + "h2h_weight"] = rs.normal(0, 0.1, (4, 4)).astype(np.float32)
+        feed[p + "h2h_bias"] = np.zeros(4, np.float32)
+    got = _bind_and_run(outs, feed)
+    assert got.shape == (2, 3, 8)
+    with pytest.raises(MXNetError):
+        bi(sym.var("x"), [])
+
+
+def test_viz_print_summary_and_dot(capsys):
+    a = sym.var("data")
+    w = sym.var("fc_weight")
+    b = sym.var("fc_bias")
+    out = sym.softmax(sym.FullyConnected(a, w, b, num_hidden=10))
+    total = mx.viz.print_summary(out, shape={"data": (1, 20)})
+    printed = capsys.readouterr().out
+    assert "Total params" in printed
+    assert total == 20 * 10 + 10
+    dot = mx.viz.plot_network(out)
+    assert dot.startswith("digraph") and "FullyConnected" in dot
+
+
+def test_monitor_collects_param_stats():
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    mon = mx.Monitor(interval=2, sort=True).install(net)
+    seen = []
+    for step in range(4):
+        mon.tic()
+        seen.extend(mon.toc())
+    names = {n for _, n, _ in seen}
+    assert any("weight" in n for n in names)
+    # interval=2 -> activated on steps 0 and 2 only
+    steps = {s for s, _, _ in seen}
+    assert len(steps) == 2
+
+
+def test_bidirectional_begin_state_forwarded():
+    """begin_state must reach both sub-cells (stateful/truncated-BPTT)."""
+    bi = mx.rnn.BidirectionalCell(mx.rnn.RNNCell(3, prefix="fw_"),
+                                  mx.rnn.RNNCell(3, prefix="bw_"))
+    data = sym.var("data")
+    states = [sym.var("fw_h0"), sym.var("bw_h0")]
+    outs, _ = bi.unroll(2, data, begin_state=states, merge_outputs=True)
+    args = outs.list_arguments()
+    assert "fw_h0" in args and "bw_h0" in args  # states are live graph inputs
