@@ -1,6 +1,15 @@
 """Benchmark: BERT-large pretraining throughput on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} — on
+EVERY exit path. The round-1 failure mode was the axon TPU plugin hanging
+inside ``jax.devices()`` forever, so all backend contact now happens in
+subprocesses with hard timeouts, and the orchestrating parent process never
+imports jax at all:
+
+  parent (no jax)  --probe-->  subprocess: "which platform?" (timeout)
+                   --run---->  subprocess: bench.py --run tpu|cpu (timeout)
+                   --print-->  the child's JSON line, or a fallback line
+
 Baseline (BASELINE.md): reference-era GluonNLP BERT-large pretraining was
 ~60-80 seq/s per V100 (fp16, seq 128); vs_baseline uses the 70 seq/s
 midpoint. The full training step (fwd+bwd+Adam update, bf16 compute /
@@ -9,30 +18,136 @@ f32 master math in the optimizer) runs as one donated jit program.
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
 import sys
 import time
 
-import numpy as np
+METRIC = "bert_large_samples_per_sec_chip"
+
+# bf16 dense peak FLOP/s per chip, keyed by substrings of device_kind.
+# Order matters: first match wins.
+_PEAKS = [
+    ("v6", 918e12),        # Trillium
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
 
-def _tpu_ready(retries=4, delay=10):
-    """The axon tunnel is lease-based and transiently flaky — retry init."""
-    import jax
+def _peak_for(kind: str) -> float:
+    k = (kind or "").lower()
+    for sub, peak in _PEAKS:
+        if sub in k:
+            return peak
+    return 197e12  # conservative default
 
-    for i in range(retries):
+
+def _emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def _fallback(error, platform="none"):
+    _emit({"metric": METRIC, "value": 0.0, "unit": "seq/s",
+           "vs_baseline": 0.0, "platform": platform, "error": str(error)[:400]})
+
+
+# --------------------------------------------------------------------------
+# Parent orchestrator: never imports jax, always prints one JSON line.
+# --------------------------------------------------------------------------
+
+def _probe_backend(timeout, retries=3, delay=10):
+    """Ask a subprocess what jax's default platform is. None on hang/crash.
+
+    The axon tunnel is lease-based and transiently flaky: a FAST init failure
+    (RuntimeError) is retried after ``delay``; a HANG (subprocess timeout) is
+    not — a hung plugin stays hung and the driver's time budget is finite.
+    """
+    code = ("import jax; d = jax.devices()[0]; "
+            "print('PROBE', d.platform, '|', d.device_kind, flush=True)")
+    for attempt in range(retries):
         try:
-            devs = jax.devices()
-            return devs[0].platform != "cpu"
-        except RuntimeError as e:
-            if i == retries - 1:
-                print(f"TPU backend unavailable after {retries} tries: {e}",
-                      file=sys.stderr)
-                return False
+            r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                               capture_output=True, text=True)
+        except (subprocess.TimeoutExpired, OSError):
+            return None
+        for line in (r.stdout or "").splitlines():
+            if line.startswith("PROBE "):
+                rest = line[len("PROBE "):]
+                platform, _, kind = rest.partition(" | ")
+                return platform.strip(), kind.strip()
+        if attempt < retries - 1:
             time.sleep(delay)
-    return False
+    return None
 
+
+def _run_child(mode, kind, timeout):
+    """Run ``bench.py --run <mode>``; return its JSON line dict or None."""
+    env = dict(os.environ)
+    if mode == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--run", mode,
+             "--kind", kind or ""],
+            timeout=timeout, capture_output=True, text=True, env=env)
+    except (subprocess.TimeoutExpired, OSError) as e:
+        return None, f"{mode} child: {type(e).__name__}"
+    for line in (r.stdout or "").splitlines():
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                return json.loads(line), None
+            except ValueError:
+                pass
+    tail = (r.stderr or "")[-300:]
+    return None, f"{mode} child rc={r.returncode}: {tail}"
+
+
+def orchestrate():
+    def _on_term(signum, frame):
+        _fallback(f"signal {signum} before measurement finished")
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
+    errors = []
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+    probe = _probe_backend(probe_timeout)
+    if probe is None:
+        errors.append(f"backend probe hung/crashed ({probe_timeout}s)")
+
+    if probe and probe[0] != "cpu":
+        kind = probe[1]
+        result, err = _run_child(
+            "tpu", kind, int(os.environ.get("BENCH_TPU_TIMEOUT", "1500")))
+        if result is not None and result.get("value", 0) > 0:
+            _emit(result)
+            return
+        errors.append(err or f"tpu child measured 0: {result.get('error')}")
+
+    result, err = _run_child(
+        "cpu", "", int(os.environ.get("BENCH_CPU_TIMEOUT", "900")))
+    if result is not None:
+        result.setdefault("fallback_reason", "; ".join(errors) or None)
+        _emit(result)
+        return
+    errors.append(err)
+    _fallback("; ".join(e for e in errors if e))
+
+
+# --------------------------------------------------------------------------
+# Child measurement: imports jax/mxnet_tpu, does the actual timing.
+# --------------------------------------------------------------------------
 
 def build_step(model_name, batch, seq, masked, vocab=30522, dtype="bfloat16"):
+    import numpy as np
+
     import mxnet_tpu as mx
     from mxnet_tpu import nd, optimizer
     from mxnet_tpu.models import bert
@@ -78,8 +193,25 @@ def bert_flops(batch, seq, masked, num_layers, units, hidden, vocab):
     return 3 * (fwd + head)
 
 
-def main():
-    on_tpu = _tpu_ready()
+def measure(mode, kind):
+    import numpy as np
+
+    on_tpu = mode == "tpu"
+    if on_tpu:
+        # if the axon lease lapsed between probe and child and jax quietly
+        # fell back to CPU, refuse: a CPU measurement must never be labeled
+        # as a TPU number (the orchestrator will rerun as a cpu child)
+        import jax
+
+        plat = jax.devices()[0].platform
+        if plat == "cpu":
+            raise RuntimeError("tpu child got cpu backend; refusing to measure")
+    if not on_tpu:
+        # the axon sitecustomize pins the platform at jax-config level; the
+        # JAX_PLATFORMS=cpu env var alone is ignored once jax is pre-imported
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     # bench config: BERT-large, seq 128 (phase-1 pretraining shape); batch 64
     # is the measured MFU knee on one v5e chip (16->0.31, 32->0.35, 64->0.42,
     # 128->0.39) — the OOM fallback below halves it if a smaller chip balks
@@ -102,13 +234,14 @@ def main():
         except Exception as e:  # OOM or transient: halve batch once or twice
             tried.append(str(e)[:100])
             if batch <= 2:
-                print(json.dumps({"metric": "bert_large_samples_per_sec_chip",
-                                  "value": 0.0, "unit": "seq/s",
-                                  "vs_baseline": 0.0, "error": tried}), flush=True)
+                _fallback(tried, platform=mode)
                 return
             batch //= 2
 
     import jax
+
+    if not kind:
+        kind = getattr(jax.devices()[0], "device_kind", "")
 
     # median of 3 timed windows; each window drains the device pipeline with a
     # host read of its final loss (the param donation chain makes that final
@@ -129,20 +262,38 @@ def main():
     cfg = bert_configs[name]
     flops = bert_flops(batch, seq, masked, cfg["num_layers"], cfg["units"],
                        cfg["hidden_size"], 30522) * steps
-    peak = 197e12  # TPU v5e bf16 dense peak
+    peak = _peak_for(kind)
     mfu = flops / dt / peak if on_tpu else 0.0
 
-    print(json.dumps({
-        "metric": "bert_large_samples_per_sec_chip" if name == "bert_large"
+    _emit({
+        "metric": METRIC if name == "bert_large"
         else f"{name}_samples_per_sec",
         "value": round(sps, 2),
         "unit": "seq/s",
         "vs_baseline": round(sps / 70.0, 3),
         "batch": batch, "seq": seq, "steps": steps,
+        "window_times_s": [round(t, 3) for t in times],
         "loss": float(np.asarray(jax.device_get(loss))),
         "mfu_est": round(mfu, 4),
+        "device_kind": kind,
+        "peak_flops": peak,
         "platform": "tpu" if on_tpu else "cpu",
-    }), flush=True)
+    })
+
+
+def main():
+    if "--run" in sys.argv:
+        mode = sys.argv[sys.argv.index("--run") + 1]
+        kind = ""
+        if "--kind" in sys.argv:
+            kind = sys.argv[sys.argv.index("--kind") + 1]
+        try:
+            measure(mode, kind)
+        except Exception as e:
+            _fallback(f"measure({mode}) raised: {e!r}", platform=mode)
+            raise
+    else:
+        orchestrate()
 
 
 if __name__ == "__main__":
