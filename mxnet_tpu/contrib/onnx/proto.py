@@ -183,7 +183,9 @@ def parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
         elif dt == DT_INT64:
             arr = np.asarray(get_repeated_int(fields, 7), np.int64).reshape(dims)
         else:
-            arr = np.asarray(get_repeated_int(fields, 6), np_dt).reshape(dims)
+            # int32_data is field 5 (field 6 is string_data): covers int32,
+            # int8/uint8, int16/uint16, bool per onnx.proto TensorProto
+            arr = np.asarray(get_repeated_int(fields, 5), np_dt).reshape(dims)
     return name, arr
 
 

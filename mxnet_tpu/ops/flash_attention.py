@@ -54,12 +54,16 @@ def flash_supported(q, k, v, mask=None) -> bool:
         return False
     b, h, tq, d = q.shape
     tk = k.shape[2]
-    return (tq % 128 == 0 and tk % 128 == 0 and d % 64 == 0
+    # d must be a full 128-lane multiple: the kernel's BlockSpecs put d on
+    # the lane dimension and Mosaic requires 128-multiple lane tiles (d=64
+    # compiles in interpret mode but is unvalidated on hardware)
+    return (tq % 128 == 0 and tk % 128 == 0 and d % 128 == 0
             and max(tq, tk) >= _FLASH_MIN_SEQ
             and q.dtype in (jnp.float32, jnp.bfloat16))
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal, bq, bk, scale):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal,
+                bq, bk, scale, off):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -76,9 +80,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal, bq
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
         if causal:
+            # bottom-right-aligned causal mask: row r attends to cols
+            # <= r + (tk - tq), matching _ref_attention/_chunked_attention
             rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, -jnp.inf)
+            s = jnp.where(rows + off >= cols, s, -jnp.inf)
         m_prev = m_ref[:, :1]  # (bq, 1), replicated over lanes
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -96,8 +102,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *, causal, bq
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
     if causal:
-        # skip fully-masked k blocks above the diagonal
-        @pl.when(qi * bq + bq > ki * bk)
+        # skip fully-masked k blocks above the (offset) diagonal: the block
+        # has live entries iff its max row + off reaches its min col
+        @pl.when(qi * bq + bq - 1 + off >= ki * bk)
         def _():
             _body()
     else:
@@ -119,7 +126,8 @@ def _flash_fwd(q, k, v, causal, block_q=128, block_k=128, interpret=False):
     vr = v.reshape(b * h, tk, d)
     scale = 1.0 / (d ** 0.5)
     grid = (b * h, tq // bq, tk // bk)
-    kernel = functools.partial(_fwd_kernel, causal=causal, bq=bq, bk=bk, scale=scale)
+    kernel = functools.partial(_fwd_kernel, causal=causal, bq=bq, bk=bk,
+                               scale=scale, off=tk - tq)
     scratch = [
         pltpu.VMEM((bq, _LANES), jnp.float32),
         pltpu.VMEM((bq, _LANES), jnp.float32),

@@ -20,7 +20,12 @@ from .base import MXNetError, dtype_flag, dtype_np
 
 NDARRAY_MAGIC = 0x112  # dmlc NDArray list magic (ndarray.cc kMXAPINDArrayListMagic)
 _SINGLE_MAGIC = 0xF993FAC9  # per-array magic in MXNet >= 1.0 (NDARRAY_V2_MAGIC)
-_V3_MAGIC = 0xF993FACA
+# Upstream's sparse block magic (NDARRAY_V3_MAGIC, ndarray.cc). Our sparse
+# layout could not be byte-verified against the empty reference mount, so we
+# write our OWN magic for sparse blocks and refuse upstream's — a foreign
+# MXNet sparse .params must fail loudly rather than misparse.
+_UPSTREAM_V3_MAGIC = 0xF993FACA
+_V3_MAGIC = 0x54505533  # "TPU3"
 
 _FLAG_TO_NP = {0: "float32", 1: "float64", 2: "float16", 3: "uint8", 4: "int32",
                5: "int8", 6: "int64", 7: "bool", 12: "bfloat16"}
@@ -80,6 +85,21 @@ def _read_buf(f, shape, dt):
 
 def _read_one(f):
     magic = struct.unpack("<I", f.read(4))[0]
+    if magic == _UPSTREAM_V3_MAGIC:
+        # Early versions of THIS library also wrote 0xf993faca (with the
+        # layout below); set MXNET_TPU_READ_LEGACY_SPARSE=1 to read such a
+        # self-written file. Files from upstream MXNet are indistinguishable
+        # and will misparse, hence loud-by-default.
+        import os
+        if os.environ.get("MXNET_TPU_READ_LEGACY_SPARSE") == "1":
+            magic = _V3_MAGIC
+        else:
+            raise MXNetError(
+                "sparse .params block with magic 0xf993faca: either an "
+                "upstream MXNet sparse file (layout not byte-verified by this "
+                "build — re-save densified) or a file written by an older "
+                "version of this library (set MXNET_TPU_READ_LEGACY_SPARSE=1 "
+                "to read it)")
     if magic not in (_SINGLE_MAGIC, _V3_MAGIC):
         raise MXNetError(f"bad NDArray magic {magic:#x}")
     if magic == _V3_MAGIC:

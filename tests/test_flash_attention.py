@@ -30,6 +30,29 @@ def test_flash_forward_matches_dense(causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("tq,tk", [(128, 384), (384, 128)])
+def test_flash_causal_cross_lengths(tq, tk):
+    """causal with tq != tk uses the bottom-right-aligned (tk - tq) offset —
+    kernel and chunked backward must mask the same elements."""
+    rs = np.random.RandomState(7)
+    B, H, D = 1, 2, 128
+    q = jnp.asarray(rs.randn(B, H, tq, D), jnp.float32)
+    k = jnp.asarray(rs.randn(B, H, tk, D), jnp.float32)
+    v = jnp.asarray(rs.randn(B, H, tk, D), jnp.float32)
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True)
+    ref = _dense(q, k, v, True)
+    # rows with no visible key (row + tk - tq < 0) are undefined in the dense
+    # oracle (softmax over all -inf -> nan); flash defines them as 0
+    valid = np.arange(tq) + tk - tq >= 0
+    np.testing.assert_allclose(np.asarray(out)[:, :, valid],
+                               np.asarray(ref)[:, :, valid], rtol=3e-4, atol=3e-4)
+    assert np.all(np.asarray(out)[:, :, ~valid] == 0.0)
+    # and the chunked path (the custom_vjp backward's oracle) agrees too
+    chk = fa._chunked_attention(q, k, v, True, chunk=64)
+    np.testing.assert_allclose(np.asarray(chk)[:, :, valid],
+                               np.asarray(ref)[:, :, valid], rtol=3e-4, atol=3e-4)
+
+
 def test_flash_multi_kblock_accumulation():
     """T > block size forces the online-softmax carry across k blocks."""
     rs = np.random.RandomState(1)

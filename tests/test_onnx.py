@@ -76,6 +76,31 @@ def test_onnx_tensor_codec_dtypes():
         np.testing.assert_array_equal(back, arr)
 
 
+def test_onnx_tensor_typed_data_fields():
+    """External ONNX files may store values in the typed repeated fields
+    (float_data=4, int32_data=5, int64_data=7) instead of raw_data; int8/
+    uint8/int32/bool all ride int32_data per onnx.proto."""
+    cases = [
+        (np.arange(6, dtype=np.float32).reshape(2, 3), 4),
+        (np.array([[1, -2], [3, 4]], np.int64), 7),
+        (np.array([[5, -6], [7, 8]], np.int32), 5),
+        (np.array([[0, 255], [1, 2]], np.uint8), 5),
+        (np.array([[-1, 2], [-3, 4]], np.int8), 5),
+    ]
+    for arr, field in cases:
+        dt = proto.NP_TO_DT[arr.dtype.name]
+        buf = b"".join(proto.f_varint(1, d) for d in arr.shape)
+        buf += proto.f_varint(2, dt) + proto.f_str(8, "typed")
+        if field == 4:
+            buf += b"".join(proto.f_float(4, float(v)) for v in arr.ravel())
+        else:
+            buf += b"".join(proto.f_varint(field, int(v)) for v in arr.ravel())
+        name, back = proto.parse_tensor(buf)
+        assert name == "typed"
+        assert back.dtype == arr.dtype
+        np.testing.assert_array_equal(back, arr)
+
+
 def test_onnx_attr_codec():
     cases = {"i": 7, "f": 1.5, "s": "hello", "ints": [1, 2, 3],
              "floats": [0.5, 0.25], "neg": -3}
