@@ -30,29 +30,68 @@ def amp_dtype():
     return getattr(_STATE, "dtype", None)
 
 
+def compute_dtype():
+    """jnp dtype matmul-class ops should COMPUTE in, or None when AMP is off.
+    Consumed by FullyConnected / Convolution / attention (``ops/nn.py``,
+    ``ops/attention.py``): inputs are cast to this dtype for the dot and
+    accumulated in f32 (``preferred_element_type``) — the TPU collapse of the
+    reference's fp16 op white/black lists (``lists/symbol_fp16.py``), where
+    only the MXU-bound ops change precision and everything else stays f32."""
+    d = amp_dtype()
+    if d is None:
+        return None
+    return jnp.bfloat16 if d == "bfloat16" else jnp.float16
+
+
+def cast_inputs(*arrays):
+    """Cast f32 arrays to the active AMP compute dtype (identity w/o AMP).
+    Non-f32 arrays (ints, already-cast bf16 params) pass through untouched."""
+    cd = compute_dtype()
+    if cd is None:
+        return arrays
+    return tuple(a.astype(cd) if a is not None and a.dtype == jnp.float32 else a
+                 for a in arrays)
+
+
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
     """Enable AMP globally. On TPU target_dtype defaults to bfloat16."""
     assert target_dtype in ("bfloat16", "float16")
     _STATE.dtype = target_dtype
+    # invalidate jit programs traced under the previous policy — otherwise a
+    # hybridized net keeps replaying its f32 dots and AMP silently no-ops
+    from ..gluon import block as _block
+
+    _block.bump_global_cache_epoch()
+
+
+def _reset():
+    """Disable AMP (test hook)."""
+    _STATE.dtype = None
+    # invalidate jit caches traced under a different amp policy
+    from ..gluon import block as _block
+
+    _block.bump_global_cache_epoch()
 
 
 class LossScaler:
     """Dynamic loss scaling (only meaningful for float16)."""
 
     def __init__(self, init_scale=2 ** 16, scale_factor=2.0, scale_window=2000):
-        self.loss_scale = init_scale if amp_dtype() == "float16" else 1.0
+        # enabled is latched at creation: the scaler stays active (overflow
+        # checks keep running, the scale can grow back) even if the scale
+        # later bottoms out at 1.0
+        self.enabled = amp_dtype() == "float16"
+        self.loss_scale = init_scale if self.enabled else 1.0
         self._factor = scale_factor
         self._window = scale_window
         self._unskipped = 0
 
     def has_overflow(self, params):
-        import jax.numpy as jnp
-        import numpy as np
-
         for p in params:
-            g = p.grad()._data
-            if not bool(jnp.isfinite(g).all()):
+            if p._nd is None or p.data()._grad is None:
+                continue
+            if not bool(jnp.isfinite(p.grad()._data).all()):
                 return True
         return False
 

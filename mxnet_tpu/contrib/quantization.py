@@ -3,19 +3,29 @@
 
 The reference inserts quantize/dequantize ops and calibrates scales via
 min-max or KL(entropy) over a calibration set. The TPU design keeps the same
-calibration logic (it's backend-agnostic math) and applies *simulated*
-quantization: int8 weights with per-channel scales, dequantized into the bf16
-matmul — which is how XLA consumes int8 on TPU without custom kernels. A
-Pallas native-int8 matmul is the later optimization.
+calibration logic (it's backend-agnostic math) and offers two execution
+modes:
+
+  - *simulated* (``quantize_net``): int8-grid values stored dequantized in
+    the model dtype — accuracy study without touching execution;
+  - *real int8* (``quantized_fully_connected`` / ``quantized_conv`` registry
+    ops + ``convert_to_int8``): ``lax.dot_general`` on int8 operands with
+    int32 accumulation — the MXU's native int8 path (reference:
+    ``quantized_fully_connected.cc``, ``quantized_conv.cc``), with f32
+    requant scales applied to the int32 accumulator.
 """
 from __future__ import annotations
 
 import numpy as np
 
 import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register
 
 __all__ = ["quantize_array", "dequantize_array", "calib_minmax", "calib_entropy",
-           "quantize_net"]
+           "quantize_net", "quantized_fully_connected", "quantized_conv",
+           "convert_to_int8", "QuantizedDense"]
 
 
 def quantize_array(x, scale=None, axis=None):
@@ -65,6 +75,160 @@ def calib_entropy(samples, num_bins=2048, num_quantized_bins=255):
         if kl < best_kl:
             best_kl, best_t = kl, t
     return best_t / 127.0
+
+
+# --------------------------------------------------------------------------
+# real int8 execution (reference: src/operator/quantization/
+# quantized_fully_connected.cc / quantized_conv.cc — cuDNN int8 there,
+# MXU int8 dot with s32 accumulation here)
+# --------------------------------------------------------------------------
+@register("_contrib_quantized_fully_connected", aliases=("quantized_fully_connected",))
+def quantized_fully_connected(dataq, weightq, bias=None, data_scale=1.0,
+                              weight_scale=1.0, num_hidden=None, no_bias=False,
+                              flatten=True, out_dtype="float32"):
+    """int8 GEMM: ``s8 x s8 -> s32`` accumulate, then one f32 requant-scale.
+
+    ``weight_scale`` may be per-output-channel (shape ``(num_hidden,)`` or
+    ``(num_hidden, 1)``). Output is dequantized f32/bf16 — on TPU keeping the
+    boundary in float and the FLOPs in int8 is the whole win; there is no
+    int8 "requantize to next layer" chain like the cuDNN path needed.
+    """
+    if flatten and dataq.ndim > 2:
+        dataq = dataq.reshape(dataq.shape[0], -1)
+    acc = lax.dot_general(dataq, weightq, (((dataq.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    ws = jnp.asarray(weight_scale, jnp.float32).reshape(-1)
+    out = acc.astype(jnp.float32) * (jnp.asarray(data_scale, jnp.float32) * ws)
+    if bias is not None and not no_bias:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(out_dtype)
+
+
+@register("_contrib_quantized_conv", aliases=("quantized_conv",))
+def quantized_conv(dataq, weightq, bias=None, kernel=None, stride=(1, 1),
+                   pad=(0, 0), dilate=(1, 1), num_filter=None, num_group=1,
+                   no_bias=False, data_scale=1.0, weight_scale=1.0,
+                   out_dtype="float32"):
+    """int8 convolution with s32 accumulation (NCHW, like ``Convolution``)."""
+    def _pair(v):
+        return tuple(int(x) for x in v) if isinstance(v, (tuple, list)) else (int(v),) * 2
+
+    stride, dilate, pad = _pair(stride), _pair(dilate), _pair(pad)
+    acc = lax.conv_general_dilated(
+        dataq, weightq, window_strides=stride,
+        padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=dilate, dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=int(num_group),
+        preferred_element_type=jnp.int32)
+    ws = jnp.asarray(weight_scale, jnp.float32).reshape(1, -1, 1, 1)
+    out = acc.astype(jnp.float32) * (jnp.asarray(data_scale, jnp.float32) * ws)
+    if bias is not None and not no_bias:
+        out = out + bias.astype(jnp.float32).reshape(1, -1, 1, 1)
+    return out.astype(out_dtype)
+
+
+class QuantizedDense:
+    """Inference-only replacement for ``gluon.nn.Dense`` holding int8 weights
+    (produced by :func:`convert_to_int8`). Activations are quantized with the
+    calibrated static scale when available, else dynamically per batch."""
+
+    def __init__(self, wq, w_scale, bias=None, activation=None, act_scale=None):
+        self._wq = wq
+        self._ws = jnp.ravel(jnp.asarray(w_scale, jnp.float32))
+        self._bias = bias
+        self._act = activation
+        self._act_scale = act_scale
+
+    def __call__(self, x):
+        from ..ndarray import NDArray
+
+        data = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        orig_dtype = data.dtype
+        xf = data.astype(jnp.float32)
+        a_scale = (jnp.asarray(self._act_scale, jnp.float32)
+                   if self._act_scale is not None
+                   else jnp.max(jnp.abs(xf)) / 127.0 + 1e-12)
+        xq = jnp.clip(jnp.round(xf / a_scale), -127, 127).astype(jnp.int8)
+        out = quantized_fully_connected(
+            xq, self._wq,
+            bias=self._bias._data if isinstance(self._bias, NDArray)
+            else self._bias,
+            data_scale=a_scale, weight_scale=self._ws)
+        if self._act == "relu":
+            out = jnp.maximum(out, 0)
+        elif self._act == "tanh":
+            out = jnp.tanh(out)
+        return NDArray(out.astype(orig_dtype))
+
+
+def convert_to_int8(net, calib_data=None, exclude_patterns=("embed",)):
+    """Swap every ``Dense`` child of a Gluon block tree for a
+    :class:`QuantizedDense` with real int8 weights. Returns the (mutated)
+    net and {layer_name: weight_scale}. With ``calib_data`` (list of input
+    batches), activation scales are calibrated min-max by running the f32 net
+    once with capture hooks; otherwise activations quantize dynamically."""
+    from ..gluon import nn as _gnn
+
+    # run eagerly from here on: stale jit programs would bypass the calib
+    # hooks (and keep executing f32 after conversion), and tracing through a
+    # hook's float() would crash on a tracer
+    for blk in [net] + [c for _, c in _walk_blocks(net)]:
+        if hasattr(blk, "_jit_cache"):
+            blk._jit_cache.clear()
+        if hasattr(blk, "_active"):
+            blk._active = False
+
+    act_stats = {}
+    if calib_data is not None:
+        hooked = []
+
+        def _capture(blk, name):
+            orig = blk.forward
+
+            def fwd(x, *a, **k):
+                act_stats.setdefault(name, 0.0)
+                act_stats[name] = max(act_stats[name],
+                                      float(jnp.max(jnp.abs(x._data))))
+                return orig(x, *a, **k)
+
+            blk.forward = fwd
+            hooked.append((blk, orig))
+
+        for name, child in _walk_blocks(net):
+            if isinstance(child, _gnn.Dense):
+                _capture(child, name)
+        for batch in calib_data:
+            net(batch)
+        for blk, orig in hooked:
+            blk.forward = orig
+
+    scales = {}
+    for parent, key, child, name in _walk_children(net):
+        if not isinstance(child, _gnn.Dense):
+            continue
+        if any(s in name for s in exclude_patterns) or child.weight._nd is None:
+            continue
+        wq, ws = quantize_array(child.weight.data()._data, axis=0)
+        bias = child.bias.data() if child.bias is not None and child.bias._nd is not None else None
+        a_scale = (act_stats[name] / 127.0 + 1e-12) if name in act_stats else None
+        qd = QuantizedDense(wq, ws, bias=bias,
+                            activation=getattr(child, "_act", None),
+                            act_scale=a_scale)
+        parent._children[key] = qd
+        scales[name] = np.asarray(ws)
+    return net, scales
+
+
+def _walk_blocks(net, prefix=""):
+    for _parent, _key, child, name in _walk_children(net, prefix):
+        yield name, child
+
+
+def _walk_children(net, prefix=""):
+    for key, child in list(getattr(net, "_children", {}).items()):
+        name = f"{prefix}{key}"
+        yield net, key, child, name
+        yield from _walk_children(child, prefix=name + ".")
 
 
 def quantize_net(net, calib_data=None, calib_mode="naive", quantized_dtype="int8",

@@ -74,6 +74,15 @@ class _BlockScope:
 
 _GLOBAL_COUNT = {}
 
+# global-policy epoch folded into every jit-cache signature: bumped when a
+# process-wide compile-affecting policy flips (e.g. amp.init), so programs
+# traced under the old policy are not replayed under the new one
+_CACHE_EPOCH = [0]
+
+
+def bump_global_cache_epoch():
+    _CACHE_EPOCH[0] += 1
+
 
 def _global_count(hint):
     n = _GLOBAL_COUNT.get(hint, 0)
@@ -394,7 +403,7 @@ class HybridBlock(Block):
     def _run_jit(self, plist, args):
         arg_raws = [a._data if isinstance(a, NDArray) else a for a in args]
         train = _ag.is_training()
-        sig = (train, tuple(
+        sig = (train, _CACHE_EPOCH[0], tuple(
             (tuple(r.shape), str(r.dtype)) if hasattr(r, "shape") else ("py", repr(r))
             for r in arg_raws))
         entry = self._jit_cache.get(sig)

@@ -65,16 +65,29 @@ class Trainer:
 
     def allreduce_grads(self):
         """Cross-process gradient reduction (no-op single-controller: GSPMD
-        already reduced across the mesh inside backward)."""
+        already reduced across the mesh inside backward). The whole grad list
+        rides ONE DCN collective via ``pushpull_batch``; sparse/compressed
+        keys fall back to per-key semantics inside it."""
         if self._kvstore is not None and getattr(self._kvstore, "is_distributed", False):
+            idx, grads = [], []
             for i, p in enumerate(self._params):
-                g = p.grad()
-                self._kvstore.push(i, g)
-                self._kvstore.pull(i, out=g)
+                if p._nd is not None and p.data()._grad is not None:
+                    idx.append(i)
+                    grads.append(p.grad())
+            self._kvstore.pushpull_batch(idx, grads)
 
     def step(self, batch_size, ignore_stale_grad=False):
         self._optimizer.rescale_grad = self._scale / batch_size
         self.allreduce_grads()
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None and getattr(scaler, "enabled",
+                                          scaler.loss_scale != 1.0):
+            # float16 AMP: drop the step on inf/nan grads and shrink the loss
+            # scale (reference: amp.py dynamic loss scaling)
+            skip = scaler.has_overflow(self._params)
+            scaler.update_scale(skip)
+            if skip:
+                return
         self._update(ignore_stale_grad)
 
     def update(self, batch_size, ignore_stale_grad=False):

@@ -32,11 +32,15 @@ def size() -> int:
 
 
 def local_rank() -> int:
-    return 0
+    from .parallel.distributed_trainer import local_rank as _lr
+
+    return _lr()
 
 
 def local_size() -> int:
-    return 1
+    from .parallel.distributed_trainer import local_size as _ls
+
+    return _ls()
 
 
 def allreduce(tensor, average=True, name=None, priority=0):

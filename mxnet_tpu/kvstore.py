@@ -102,11 +102,12 @@ class KVStore:
                 raise MXNetError(f"key {k} not initialized in kvstore")
             val = self._store[k]
             if isinstance(val, BaseSparseNDArray):
-                # dense pull of a sparse-stored value densifies; reference
-                # requires row_sparse_pull for rsp keys unless ignored
-                if not ignore_sparse:
-                    raise MXNetError(f"key {k} has sparse storage; use row_sparse_pull")
-                val = val.todense()
+                # reference semantics (KVStoreLocal::Pull): ignore_sparse=True
+                # SKIPS sparse-stored keys — row_sparse_pull is the sanctioned
+                # path; ignore_sparse=False makes the request an error
+                if ignore_sparse:
+                    continue
+                raise MXNetError(f"key {k} has sparse storage; use row_sparse_pull")
             if isinstance(o, (list, tuple)):
                 for x in o:
                     x._data = val._data
@@ -117,6 +118,31 @@ class KVStore:
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
         self.pull(key, out if out is not None else value, priority)
+
+    def pushpull_batch(self, keys, values):
+        """Batched dense push+pull-in-place: the whole list of values rides
+        ONE cross-process collective instead of one per key (the batching
+        bound the reference exposed as ``MXNET_KVSTORE_BIGARRAY_BOUND``,
+        ``src/kvstore/kvstore_dist.h`` — here the batch is always whole).
+        Falls back to per-key push/pull when sparse values, compression, or a
+        server-side updater demand per-key semantics."""
+        from .ndarray import sparse as _sp
+
+        keys, values = self._normalize(keys, values)
+        if (self._compression is not None or self._updater is not None
+                or self.type == "dist_async"  # push ACCUMULATES into store
+                or any(isinstance(v, (_sp.BaseSparseNDArray, list, tuple))
+                       for v in values)):
+            for k, v in zip(keys, values):
+                self.push(k, v)
+                self.pull(k, out=v)
+            return
+        raws = [v._data for v in values]
+        if self.is_distributed:
+            raws = _dcn_psum_batch(raws)
+        for k, v, r in zip(keys, values, raws):
+            self._store[k] = NDArray(r)
+            v._data = r
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the rows in ``row_ids`` (reference:
@@ -212,6 +238,25 @@ class KVStore:
         if isinstance(key, (list, tuple)):
             return list(key), list(value)
         return [key], [value]
+
+
+def _dcn_psum_batch(raws):
+    """Sum a LIST of arrays across processes with a single allgather: leaves
+    are flattened into one f32 transfer buffer, reduced, and split back —
+    O(1) DCN round-trips per training step regardless of parameter count."""
+    if jax.process_count() == 1 or not raws:
+        return raws
+    from jax.experimental import multihost_utils
+
+    flat = [jnp.ravel(r).astype(jnp.float32) for r in raws]
+    buf = jnp.concatenate(flat) if len(flat) > 1 else flat[0]
+    total = jnp.sum(multihost_utils.process_allgather(buf), axis=0)
+    out, off = [], 0
+    for r in raws:
+        n = r.size
+        out.append(total[off:off + n].reshape(r.shape).astype(r.dtype))
+        off += n
+    return out
 
 
 def _dcn_psum(x):

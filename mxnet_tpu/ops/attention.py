@@ -41,11 +41,17 @@ def _split_interleaved_qkv(qkv, heads):
 @register("_contrib_interleaved_matmul_selfatt_qk")
 def interleaved_matmul_selfatt_qk(qkv, heads=1):
     """scores = scaled Q @ K^T, output (B*H, T, T) like the reference."""
+    from ..contrib.amp import cast_inputs
+
+    orig_dtype = qkv.dtype
+    (qkv,) = cast_inputs(qkv)
     q, k, v = _split_interleaved_qkv(qkv, int(heads))
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32)).astype(q.dtype)
     scores = jnp.einsum("bhqc,bhkc->bhqk", q * scale, k)
     b, h, t, _ = scores.shape
-    return scores.reshape(b * h, t, t)
+    # restore the caller's dtype: downstream mask arithmetic / softmax on the
+    # scores must not change precision because a global AMP flag flipped
+    return scores.reshape(b * h, t, t).astype(orig_dtype)
 
 
 @register("_contrib_interleaved_matmul_selfatt_valatt")
@@ -109,9 +115,14 @@ def multi_head_attention(q, k, v, mask=None, causal=False, use_flash="auto"):
     shapes are tile-friendly, otherwise the XLA einsum path.
     """
     from . import flash_attention as fa
+    from ..contrib.amp import cast_inputs
 
+    orig_dtype = q.dtype
+    q, k, v = cast_inputs(q, k, v)  # AMP: score/context matmuls on the MXU
     if use_flash == "auto":
         use_flash = fa.flash_supported(q, k, v, mask)
     if use_flash:
-        return fa.flash_attention(q, k, v, mask=mask, causal=causal)
-    return _reference_mha(q, k, v, mask=mask, causal=causal)
+        out = fa.flash_attention(q, k, v, mask=mask, causal=causal)
+    else:
+        out = _reference_mha(q, k, v, mask=mask, causal=causal)
+    return out.astype(orig_dtype)

@@ -24,11 +24,23 @@ from .. import random as _random
 # --------------------------------------------------------------------------
 # FullyConnected (reference: fully_connected.cc → cuBLAS gemm)
 # --------------------------------------------------------------------------
+def _amp_compute_dtype():
+    from ..contrib.amp import compute_dtype
+
+    return compute_dtype()
+
+
 @register("FullyConnected", aliases=("fully_connected",))
 def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True):
     if flatten and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
-    out = jnp.matmul(data, weight.T)
+    adt = _amp_compute_dtype()
+    if adt is not None and data.dtype == jnp.float32:
+        # AMP: MXU compute in bf16/f16, f32 accumulate, f32 out
+        out = jnp.matmul(data.astype(adt), weight.astype(adt).T,
+                         preferred_element_type=jnp.float32)
+    else:
+        out = jnp.matmul(data, weight.T)
     if bias is not None and not no_bias:
         out = out + bias
     return out
@@ -53,6 +65,10 @@ def convolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1, 
         weight = weight[:, :, None, :]
         stride, dilate, pad = (1, _pair(stride, 1)[0]), (1, _pair(dilate, 1)[0]), (0, _pair(pad, 1)[0])
     stride, dilate, pad = _pair(stride), _pair(dilate), _pair(pad)
+    orig_dtype = data.dtype
+    adt = _amp_compute_dtype()
+    if adt is not None and orig_dtype == jnp.float32:
+        data, weight = data.astype(adt), weight.astype(adt)
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -60,9 +76,10 @@ def convolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1, 
         rhs_dilation=dilate,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=int(num_group),
-        preferred_element_type=jnp.float32 if data.dtype == jnp.bfloat16 else None,
+        preferred_element_type=jnp.float32
+        if data.dtype in (jnp.bfloat16, jnp.float16) else None,
     )
-    out = out.astype(data.dtype)
+    out = out.astype(orig_dtype)
     if bias is not None and not no_bias:
         out = out + bias.reshape(1, -1, 1, 1)
     if conv_1d:

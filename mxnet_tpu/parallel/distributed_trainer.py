@@ -56,7 +56,22 @@ def size() -> int:
 
 
 def local_rank() -> int:
+    """Rank within this host. jax has no first-class notion of it; honor the
+    launcher envs (tools/launch.py exports MXNET_TPU_LOCAL_RANK, matching
+    horovod's OMPI_COMM_WORLD_LOCAL_RANK convention)."""
+    for var in ("MXNET_TPU_LOCAL_RANK", "OMPI_COMM_WORLD_LOCAL_RANK",
+                "LOCAL_RANK"):
+        if var in os.environ:
+            return int(os.environ[var])
     return 0
+
+
+def local_size() -> int:
+    for var in ("MXNET_TPU_LOCAL_SIZE", "OMPI_COMM_WORLD_LOCAL_SIZE",
+                "LOCAL_WORLD_SIZE"):
+        if var in os.environ:
+            return int(os.environ[var])
+    return 1
 
 
 class DistributedTrainer(Trainer):

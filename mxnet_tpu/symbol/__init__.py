@@ -28,13 +28,16 @@ __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
 
 class Symbol:
     def __init__(self, op: Optional[str], inputs: List["Symbol"], kwargs: dict,
-                 name: str, nout: int = 1, out_index: int = 0):
+                 name: str, nout: int = 1, out_index: int = 0, sliced: bool = False):
         self._op = op  # None for variables
         self._inputs = inputs
         self._kwargs = kwargs
         self._name = name
         self._nout = nout
         self._out_index = out_index
+        # a "sliced" symbol selects ONE output of a multi-output node (bn[1]);
+        # an unsliced multi-output symbol exposes all its outputs
+        self._sliced = sliced or nout == 1
 
     # -- composition ---------------------------------------------------------
     @property
@@ -56,23 +59,78 @@ class Symbol:
         return order
 
     def list_outputs(self):
-        return [f"{self._name}_output"]
+        """Output names (reference: ``nnvm::Symbol::ListOutputNames``):
+        variables are their own name, op outputs are ``<name>_output`` (or
+        ``<name>_output<i>`` for multi-output ops), groups concatenate."""
+        if self._op is None:
+            return [self._name]
+        if self._op == "_group":
+            return [n for i in self._inputs for n in i.list_outputs()]
+        if self._nout == 1:
+            return [f"{self._name}_output"]
+        if self._sliced:
+            return [f"{self._name}_output{self._out_index}"]
+        return [f"{self._name}_output{i}" for i in range(self._nout)]
 
     def list_auxiliary_states(self):
         return []
 
+    def _topo_nodes(self):
+        seen, order = set(), []
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                walk(i)
+            order.append(s)
+
+        walk(self)
+        return order
+
     def get_internals(self):
-        return self
+        """Group over every node of the graph in topological order, each
+        selectable by output name and bindable as an executor head —
+        the feature-extraction workflow (reference:
+        ``nnvm::Symbol::GetInternals``, used as
+        ``sym.get_internals()['flatten0_output']``)."""
+        nodes = [n for n in self._topo_nodes() if n._op != "_group"]
+        return Symbol("_group", nodes, {}, f"{self._name}_internals",
+                      nout=len(nodes))
 
     def __getitem__(self, i):
-        if isinstance(i, int) and self._nout > 1:
+        if isinstance(i, str):
+            names = self.list_outputs()
+            if i not in names:
+                raise MXNetError(
+                    f"output {i!r} not found; candidates: {names}")
+            i = names.index(i)
+        if self._op == "_group":
+            total = len(self.list_outputs())
+            if i < 0:
+                i += total
+            if not 0 <= i < total:
+                raise MXNetError(f"group output index {i} out of range ({total})")
+            for inp in self._inputs:
+                n = len(inp.list_outputs())
+                if i < n:
+                    return inp[i] if (inp._nout > 1 and not inp._sliced) else inp
+                i -= n
+        if isinstance(i, int) and self._nout > 1 and not self._sliced:
+            if i < 0:
+                i += self._nout
+            if not 0 <= i < self._nout:
+                raise MXNetError(f"output index {i} out of range ({self._nout})")
             return Symbol(self._op, self._inputs, self._kwargs, self._name,
-                          self._nout, i)
+                          self._nout, i, sliced=True)
         return self
 
     def __iter__(self):
         # tuple-unpacking of multi-output ops: out, mean, var = F.BatchNorm(...)
-        if self._nout > 1:
+        if self._op == "_group":
+            return iter(self[i] for i in range(len(self.list_outputs())))
+        if self._nout > 1 and not self._sliced:
             return iter(self[i] for i in range(self._nout))
         raise TypeError("single-output Symbol is not iterable")
 
@@ -126,19 +184,31 @@ class Symbol:
         def run(env: Dict[str, jnp.ndarray]):
             memo = {}
 
-            def ev(s: Symbol):
-                key = (id(s._inputs), s._name) if s._op else s._name
+            def ev_all(s: Symbol):
+                """All outputs of s's node, as a tuple."""
                 if s._op is None:
                     if s._name not in env:
                         raise MXNetError(f"unbound argument {s._name}")
-                    return env[s._name]
-                mkey = id(s)
+                    return (env[s._name],)
                 base_key = (s._op, s._name)
                 if base_key not in memo:
                     raws = [ev(i) for i in s._inputs]
                     out = _registry.get(s._op).fn(*raws, **s._kwargs)
                     memo[base_key] = out if isinstance(out, tuple) else (out,)
-                return memo[base_key][s._out_index]
+                return memo[base_key]
+
+            def ev(s: Symbol):
+                if s._op == "_group":
+                    # one entry per list_outputs() name: unsliced multi-output
+                    # heads contribute all their outputs
+                    flat = []
+                    for i in s._inputs:
+                        if i._nout > 1 and not i._sliced:
+                            flat.extend(ev_all(i))
+                        else:
+                            flat.append(ev(i))
+                    return tuple(flat)
+                return ev_all(s)[s._out_index]
 
             return ev(self)
 
@@ -147,7 +217,8 @@ class Symbol:
     def eval(self, ctx=None, **kwargs):
         env = {k: v._data if isinstance(v, NDArray) else jnp.asarray(v)
                for k, v in kwargs.items()}
-        return [NDArray(self._make_fn()(env))]
+        out = self._make_fn()(env)
+        return [NDArray(o) for o in (out if isinstance(out, tuple) else (out,))]
 
     def infer_shape(self, **kwargs):
         """Shape inference; solves unknown parameter shapes from data shapes
@@ -192,7 +263,7 @@ class Symbol:
             key = id(s)
             if key in index:
                 return index[key]
-            inputs = [[walk(i), 0, 0] for i in s._inputs]
+            inputs = [[walk(i), i._out_index, 0] for i in s._inputs]
             nodes.append({
                 "op": s._op or "null",
                 "name": s._name,
@@ -203,8 +274,18 @@ class Symbol:
             index[key] = len(nodes) - 1
             return index[key]
 
-        head = walk(self)
-        return json.dumps({"nodes": nodes, "heads": [[head, 0, 0]],
+        if self._op == "_group":  # groups serialize as multiple heads,
+            # expanding unsliced multi-output heads into one entry per output
+            heads = []
+            for i in self._inputs:
+                if i._nout > 1 and not i._sliced:
+                    node = walk(i)
+                    heads.extend([node, j, 0] for j in range(i._nout))
+                else:
+                    heads.append([walk(i), i._out_index, 0])
+        else:
+            heads = [[walk(self), self._out_index, 0]]
+        return json.dumps({"nodes": nodes, "heads": heads,
                            "mxnet_tpu_version": 1}, indent=2)
 
     def save(self, fname):
@@ -280,6 +361,10 @@ def _infer_shapes_partial(head, known):
     def out_shape(s):
         if s._op is None:
             return shapes.get(s._name)
+        if s._op == "_group":
+            for i in s._inputs:
+                out_shape(i)
+            return None
         key = (s._op, s._name)
         if key in node_out:
             outs = node_out[key]
@@ -335,7 +420,11 @@ Variable = var
 
 
 def Group(symbols):
-    return _apply("stack", list(symbols), {"axis": 0}, name="group")
+    """Multi-head symbol (reference: ``nnvm::Symbol::CreateGroup``) — heads
+    keep their own shapes/dtypes; executor forward returns one NDArray per
+    head."""
+    symbols = list(symbols)
+    return Symbol("_group", symbols, {}, "group", nout=len(symbols))
 
 
 def load_json(json_str):
@@ -346,12 +435,14 @@ def load_json(json_str):
         if node["op"] == "null":
             built.append(var(node["name"]))
         else:
-            inputs = [built[i[0]] for i in node["inputs"]]
+            inputs = [built[i[0]][i[1]] if built[i[0]]._nout > 1 else built[i[0]]
+                      for i in node["inputs"]]
             kwargs = node.get("_raw_attrs", {})
             kwargs = {k: tuple(v) if isinstance(v, list) else v for k, v in kwargs.items()}
             built.append(_apply(node["op"], inputs, kwargs, node["name"]))
-    head = graph["heads"][0][0]
-    return built[head]
+    heads = [built[h[0]][h[1]] if built[h[0]]._nout > 1 else built[h[0]]
+             for h in graph["heads"]]
+    return heads[0] if len(heads) == 1 else Group(heads)
 
 
 def load(fname):
@@ -366,16 +457,27 @@ def eval_symbol(symbol: Symbol, env: dict):
 
     memo = {}
 
-    def ev(s: Symbol):
+    def ev_all(s: Symbol):
         if s._op is None:
             v = env[s._name]
-            return v if isinstance(v, NDArray) else NDArray(v)
+            return (v if isinstance(v, NDArray) else NDArray(v),)
         key = (s._op, s._name)
         if key not in memo:
             ins = tuple(ev(i) for i in s._inputs)
             out = invoke(_registry.get(s._op), ins, dict(s._kwargs))
             memo[key] = out if isinstance(out, tuple) else (out,)
-        return memo[key][s._out_index]
+        return memo[key]
+
+    def ev(s: Symbol):
+        if s._op == "_group":
+            flat = []
+            for i in s._inputs:
+                if i._nout > 1 and not i._sliced:
+                    flat.extend(ev_all(i))
+                else:
+                    flat.append(ev(i))
+            return tuple(flat)
+        return ev_all(s)[s._out_index]
 
     return ev(symbol)
 
@@ -402,14 +504,21 @@ class Executor:
             self.arg_dict[k]._data = v._data if isinstance(v, NDArray) else jnp.asarray(v)
         env = {k: v._data for k, v in self.arg_dict.items()}
         out = self._jit(env)
-        self.outputs = [NDArray(out)]
+        self.outputs = [NDArray(o)
+                        for o in (out if isinstance(out, tuple) else (out,))]
         return self.outputs
 
     def backward(self, out_grads=None):
         env = {k: v._data for k, v in self.arg_dict.items()}
-        _, vjp = jax.vjp(self._fn, env)
-        ct = (out_grads[0]._data if isinstance(out_grads, (list, tuple))
-              else out_grads._data) if out_grads is not None else jnp.ones_like(self.outputs[0]._data)
+        out, vjp = jax.vjp(self._fn, env)
+        multi = isinstance(out, tuple)
+        if out_grads is None:
+            ct = (tuple(jnp.ones_like(o) for o in out) if multi
+                  else jnp.ones_like(out))
+        else:
+            gl = out_grads if isinstance(out_grads, (list, tuple)) else [out_grads]
+            gl = [g._data if isinstance(g, NDArray) else jnp.asarray(g) for g in gl]
+            ct = tuple(gl) if multi else gl[0]
         (grads,) = vjp(ct)
         for k, g in grads.items():
             if k in self.grad_dict:

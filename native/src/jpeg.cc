@@ -1,0 +1,370 @@
+// Baseline JPEG decoder (dependency-free).
+//
+// The reference decodes JPEG inside the data pipeline with OpenCV
+// (src/io/iter_image_recordio_2.cc ImageRecordIOParser2 ->
+// src/io/image_recordio.h -> cv::imdecode). This runtime carries its own
+// ~700-line baseline decoder instead: ITU T.81 baseline sequential DCT
+// (SOF0/SOF1), restart markers, 4:4:4 / 4:2:2 / 4:2:0 chroma, grayscale,
+// YCbCr->RGB per BT.601. Progressive (SOF2) and arithmetic coding are
+// rejected with a clear error. Exposed through the flat C ABI
+// (MXTPUImdecode) and driven from Python threads — the decode loop holds no
+// Python state, so it runs truly parallel under the GIL.
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace mxjpeg {
+
+static thread_local std::string g_err;
+
+struct BitReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  uint32_t bits = 0;   // bit buffer, MSB-aligned within 'count' bits
+  int count = 0;
+  bool hit_marker = false;
+
+  BitReader(const uint8_t* data, size_t len) : p(data), end(data + len) {}
+
+  // refill one byte, handling 0xFF00 stuffing; stop at markers
+  bool fill() {
+    if (p >= end) return false;
+    uint8_t b = *p++;
+    if (b == 0xFF) {
+      if (p < end && *p == 0x00) {
+        ++p;  // stuffed
+      } else {
+        --p;  // real marker: un-consume, signal end of entropy data
+        hit_marker = true;
+        return false;
+      }
+    }
+    bits = (bits << 8) | b;
+    count += 8;
+    return true;
+  }
+
+  int get_bit() {
+    if (count == 0 && !fill()) return 0;  // past-end reads as 0 (T.81 allows)
+    --count;
+    return (bits >> count) & 1;
+  }
+
+  int get_bits(int n) {
+    int v = 0;
+    for (int i = 0; i < n; ++i) v = (v << 1) | get_bit();
+    return v;
+  }
+
+  void reset() { bits = 0; count = 0; hit_marker = false; }
+};
+
+// receive-and-extend (T.81 F.2.2.1)
+static inline int extend(int v, int n) {
+  return (n && v < (1 << (n - 1))) ? v - (1 << n) + 1 : v;
+}
+
+struct HuffTable {
+  // canonical decode via per-length first-code/first-index
+  int32_t mincode[17], maxcode[18];
+  int32_t valptr[17];
+  uint8_t values[256];
+  bool present = false;
+
+  void build(const uint8_t* counts /*16*/, const uint8_t* vals, int nvals) {
+    std::memcpy(values, vals, nvals);
+    int code = 0, k = 0;
+    for (int l = 1; l <= 16; ++l) {
+      valptr[l] = k;
+      mincode[l] = code;
+      code += counts[l - 1];
+      k += counts[l - 1];
+      maxcode[l] = counts[l - 1] ? code - 1 : -1;
+      code <<= 1;
+    }
+    maxcode[17] = 0x7fffffff;
+    present = true;
+  }
+
+  int decode(BitReader& br) const {
+    int code = br.get_bit();
+    int l = 1;
+    while (l <= 16 && (maxcode[l] < 0 || code > maxcode[l])) {
+      code = (code << 1) | br.get_bit();
+      ++l;
+    }
+    if (l > 16) return -1;
+    return values[valptr[l] + code - mincode[l]];
+  }
+};
+
+// AAN-style float IDCT, separable 8x8
+static void idct8(float* b /*64, in natural order*/) {
+  // rows then cols, simple O(64*16) matrix-free butterfly-lite; clarity over
+  // peak speed — decode is threaded above this level
+  static float c[8][8];
+  static bool init = false;
+  if (!init) {
+    for (int k = 0; k < 8; ++k)
+      for (int n = 0; n < 8; ++n)
+        c[k][n] = (k == 0 ? 0.353553390593f : 0.5f) *
+                  std::cos((2 * n + 1) * k * 3.14159265358979323846 / 16.0);
+    init = true;
+  }
+  float tmp[64];
+  for (int r = 0; r < 8; ++r) {  // 1-D over rows
+    for (int n = 0; n < 8; ++n) {
+      float s = 0;
+      for (int k = 0; k < 8; ++k) s += c[k][n] * b[r * 8 + k];
+      tmp[r * 8 + n] = s;
+    }
+  }
+  for (int col = 0; col < 8; ++col) {  // 1-D over cols
+    for (int n = 0; n < 8; ++n) {
+      float s = 0;
+      for (int k = 0; k < 8; ++k) s += c[k][n] * tmp[k * 8 + col];
+      b[n * 8 + col] = s;
+    }
+  }
+}
+
+static const uint8_t kZigzag[64] = {
+    0,  1,  8,  16, 9,  2,  3,  10, 17, 24, 32, 25, 18, 11, 4,  5,
+    12, 19, 26, 33, 40, 48, 41, 34, 27, 20, 13, 6,  7,  14, 21, 28,
+    35, 42, 49, 56, 57, 50, 43, 36, 29, 22, 15, 23, 30, 37, 44, 51,
+    58, 59, 52, 45, 38, 31, 39, 46, 53, 60, 61, 54, 47, 55, 62, 63};
+
+struct Component {
+  int id = 0, h = 1, v = 1, tq = 0;
+  int td = 0, ta = 0;      // huffman table ids (from SOS)
+  int dc_pred = 0;
+  int bw = 0, bh = 0;      // plane size in blocks
+  std::vector<float> plane;  // bw*8 x bh*8 samples
+};
+
+struct Decoder {
+  const uint8_t* data;
+  size_t len, pos = 0;
+  uint16_t qt[4][64] = {};
+  HuffTable hdc[4], hac[4];
+  Component comp[4];
+  int ncomp = 0, width = 0, height = 0;
+  int hmax = 1, vmax = 1;
+  int restart_interval = 0;
+
+  bool fail(const std::string& m) { g_err = "jpeg: " + m; return false; }
+
+  uint8_t u8() { return pos < len ? data[pos++] : 0; }
+  int u16() { int a = u8(); return (a << 8) | u8(); }
+
+  bool parse_and_decode() {
+    if (len < 4 || data[0] != 0xFF || data[1] != 0xD8) return fail("not a JPEG (no SOI)");
+    pos = 2;
+    while (pos + 4 <= len) {
+      if (u8() != 0xFF) return fail("marker sync lost");
+      int m = u8();
+      while (m == 0xFF && pos < len) m = u8();  // fill bytes
+      if (m == 0xD9) break;  // EOI
+      if (m == 0x01 || (m >= 0xD0 && m <= 0xD7)) continue;  // TEM/RSTn: no payload
+      int seglen = u16() - 2;
+      if (seglen < 0 || pos + seglen > len) return fail("truncated segment");
+      size_t segend = pos + seglen;
+      switch (m) {
+        case 0xDB:  // DQT
+          while (pos < segend) {
+            int pq_tq = u8();
+            int prec = pq_tq >> 4, id = pq_tq & 15;
+            if (id > 3) return fail("bad DQT id");
+            for (int i = 0; i < 64; ++i)
+              qt[id][i] = prec ? u16() : u8();
+          }
+          break;
+        case 0xC4:  // DHT
+          while (pos < segend) {
+            int tc_th = u8();
+            int cls = tc_th >> 4, id = tc_th & 15;
+            if (id > 3 || cls > 1) return fail("bad DHT header");
+            uint8_t counts[16];
+            int total = 0;
+            for (int i = 0; i < 16; ++i) { counts[i] = u8(); total += counts[i]; }
+            if (total > 256 || pos + total > len) return fail("bad DHT counts");
+            (cls ? hac[id] : hdc[id]).build(counts, data + pos, total);
+            pos += total;
+          }
+          break;
+        case 0xC0: case 0xC1: {  // SOF0/1 baseline
+          int prec = u8();
+          if (prec != 8) return fail("only 8-bit precision supported");
+          height = u16(); width = u16();
+          ncomp = u8();
+          if (ncomp != 1 && ncomp != 3) return fail("only 1- or 3-component JPEG");
+          for (int i = 0; i < ncomp; ++i) {
+            comp[i].id = u8();
+            int hv = u8();
+            comp[i].h = hv >> 4; comp[i].v = hv & 15;
+            comp[i].tq = u8();
+            if (comp[i].h < 1 || comp[i].h > 4 || comp[i].v < 1 || comp[i].v > 4)
+              return fail("bad sampling factors");
+            hmax = std::max(hmax, comp[i].h); vmax = std::max(vmax, comp[i].v);
+          }
+          break;
+        }
+        case 0xC2: return fail("progressive JPEG not supported (baseline only)");
+        case 0xC3: case 0xC5: case 0xC6: case 0xC7: case 0xC9: case 0xCA:
+        case 0xCB: case 0xCD: case 0xCE: case 0xCF:
+          return fail("unsupported SOF type");
+        case 0xDD: restart_interval = u16(); break;
+        case 0xDA: {  // SOS — entropy data follows
+          int ns = u8();
+          if (ns != ncomp) return fail("SOS component count mismatch");
+          for (int i = 0; i < ns; ++i) {
+            int cs = u8(), tdta = u8();
+            for (int j = 0; j < ncomp; ++j)
+              if (comp[j].id == cs) { comp[j].td = tdta >> 4; comp[j].ta = tdta & 15; }
+          }
+          pos += 3;  // Ss/Se/AhAl (fixed for baseline)
+          return decode_scan();
+        }
+        default: pos = segend; break;  // APPn/COM/etc: skip
+      }
+      pos = segend;
+    }
+    return fail("no SOS marker found");
+  }
+
+  bool decode_block(BitReader& br, Component& c, float* out) {
+    const HuffTable& dc = hdc[c.td];
+    const HuffTable& ac = hac[c.ta];
+    if (!dc.present || !ac.present) return fail("missing huffman table");
+    int coeff[64] = {};
+    int t = dc.decode(br);
+    if (t < 0) return fail("bad DC huffman code");
+    int diff = t ? extend(br.get_bits(t), t) : 0;
+    c.dc_pred += diff;
+    coeff[0] = c.dc_pred * qt[c.tq][0];
+    for (int k = 1; k < 64;) {
+      int rs = ac.decode(br);
+      if (rs < 0) return fail("bad AC huffman code");
+      int r = rs >> 4, s = rs & 15;
+      if (s == 0) {
+        if (r == 15) { k += 16; continue; }  // ZRL
+        break;  // EOB
+      }
+      k += r;
+      if (k > 63) return fail("AC run overflow");
+      coeff[k] = extend(br.get_bits(s), s) * qt[c.tq][k];
+      ++k;
+    }
+    for (int i = 0; i < 64; ++i) out[kZigzag[i]] = (float)coeff[i];
+    idct8(out);
+    return true;
+  }
+
+  bool decode_scan() {
+    int mcux = (width + 8 * hmax - 1) / (8 * hmax);
+    int mcuy = (height + 8 * vmax - 1) / (8 * vmax);
+    for (int i = 0; i < ncomp; ++i) {
+      Component& c = comp[i];
+      c.bw = mcux * c.h;
+      c.bh = mcuy * c.v;
+      c.plane.assign((size_t)c.bw * 8 * c.bh * 8, 0.f);
+      c.dc_pred = 0;
+    }
+    BitReader br(data + pos, len - pos);
+    int mcu_count = 0;
+    for (int my = 0; my < mcuy; ++my) {
+      for (int mx = 0; mx < mcux; ++mx) {
+        if (restart_interval && mcu_count && mcu_count % restart_interval == 0) {
+          // skip to RSTn marker, reset DC predictors
+          const uint8_t* q = br.p;
+          while (q + 1 < br.end && !(q[0] == 0xFF && q[1] >= 0xD0 && q[1] <= 0xD7)) ++q;
+          if (q + 1 >= br.end) return fail("missing restart marker");
+          br.p = q + 2;
+          br.reset();
+          for (int i = 0; i < ncomp; ++i) comp[i].dc_pred = 0;
+        }
+        for (int i = 0; i < ncomp; ++i) {
+          Component& c = comp[i];
+          for (int by = 0; by < c.v; ++by)
+            for (int bx = 0; bx < c.h; ++bx) {
+              float block[64];
+              std::memset(block, 0, sizeof(block));
+              if (!decode_block(br, c, block)) return false;
+              int px = (mx * c.h + bx) * 8, py = (my * c.v + by) * 8;
+              int stride = c.bw * 8;
+              for (int y = 0; y < 8; ++y)
+                std::memcpy(&c.plane[(size_t)(py + y) * stride + px],
+                            &block[y * 8], 8 * sizeof(float));
+            }
+        }
+        ++mcu_count;
+      }
+    }
+    return true;
+  }
+
+  // sample component i at full-res pixel (x, y) — nearest-neighbor upsample
+  inline float sample(const Component& c, int x, int y) const {
+    int cx = x * c.h / hmax, cy = y * c.v / vmax;
+    return c.plane[(size_t)cy * c.bw * 8 + cx];
+  }
+
+  void to_rgb(uint8_t* out) const {
+    auto clamp = [](float v) -> uint8_t {
+      return (uint8_t)(v < 0.f ? 0 : v > 255.f ? 255 : v + 0.5f);
+    };
+    if (ncomp == 1) {
+      for (int y = 0; y < height; ++y)
+        for (int x = 0; x < width; ++x) {
+          uint8_t g = clamp(sample(comp[0], x, y) + 128.f);
+          uint8_t* px = out + 3 * ((size_t)y * width + x);
+          px[0] = px[1] = px[2] = g;
+        }
+      return;
+    }
+    for (int y = 0; y < height; ++y)
+      for (int x = 0; x < width; ++x) {
+        float Y = sample(comp[0], x, y) + 128.f;
+        float Cb = sample(comp[1], x, y);
+        float Cr = sample(comp[2], x, y);
+        uint8_t* px = out + 3 * ((size_t)y * width + x);
+        px[0] = clamp(Y + 1.402f * Cr);
+        px[1] = clamp(Y - 0.344136f * Cb - 0.714136f * Cr);
+        px[2] = clamp(Y + 1.772f * Cb);
+      }
+  }
+};
+
+}  // namespace mxjpeg
+
+#include <cmath>
+#include <algorithm>
+
+extern "C" {
+
+const char* MXTPUJpegLastError() { return mxjpeg::g_err.c_str(); }
+
+// Decode a baseline JPEG into a malloc'd HWC RGB uint8 buffer.
+// Returns 0 on success; nonzero on error (message via MXTPUJpegLastError).
+int MXTPUImdecode(const uint8_t* buf, size_t len,
+                  int* out_h, int* out_w, int* out_c, uint8_t** out_buf) {
+  mxjpeg::Decoder d;
+  d.data = buf;
+  d.len = len;
+  if (!d.parse_and_decode()) return 1;
+  if (d.width <= 0 || d.height <= 0) { mxjpeg::g_err = "jpeg: empty frame"; return 1; }
+  uint8_t* rgb = (uint8_t*)std::malloc((size_t)d.width * d.height * 3);
+  if (!rgb) { mxjpeg::g_err = "jpeg: out of memory"; return 1; }
+  d.to_rgb(rgb);
+  *out_h = d.height;
+  *out_w = d.width;
+  *out_c = 3;
+  *out_buf = rgb;
+  return 0;
+}
+
+void MXTPUImageFree(uint8_t* buf) { std::free(buf); }
+
+}  // extern "C"

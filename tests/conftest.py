@@ -24,3 +24,9 @@ def _seed():
     np.random.seed(0)
     mx.random.seed(0)
     yield
+    # amp.init() now genuinely changes op compute dtypes — never let that
+    # global leak from one test into the next
+    from mxnet_tpu.contrib import amp
+
+    if amp.amp_dtype() is not None:
+        amp._reset()

@@ -35,6 +35,31 @@ _CHILD = textwrap.dedent("""
     import mxnet_tpu.horovod as hvd
     s = hvd.allreduce(nd.full((2,), float(rank)), average=True)  # (0+1)/2
     assert abs(float(s.asnumpy()[0]) - 0.5) < 1e-6
+    assert hvd.local_rank() == rank and hvd.local_size() == 2
+
+    # batched grad reduction: a full Trainer.step must issue exactly ONE
+    # cross-process collective for the whole parameter list
+    from jax.experimental import multihost_utils
+    calls = []
+    orig_ag = multihost_utils.process_allgather
+    multihost_utils.process_allgather = lambda *a, **k: (calls.append(1), orig_ag(*a, **k))[1]
+
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    net = nn.HybridSequential()
+    net.add(nn.Dense(5, in_units=3), nn.Dense(2, in_units=5))
+    net.initialize()
+    tr = hvd.DistributedTrainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+    x = nd.full((2, 3), float(rank + 1))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    calls.clear()
+    tr.step(2)
+    multihost_utils.process_allgather = orig_ag
+    assert len(calls) == 1, f"expected 1 collective for 4 params, got {len(calls)}"
+
     print(f"RANK{rank}-OK", flush=True)
 """)
 

@@ -153,3 +153,140 @@ def test_horovod_namespace():
     assert hvd.rank() == 0 and hvd.size() == 1
     out = hvd.allreduce(nd.ones((3,)))
     np.testing.assert_allclose(out.asnumpy(), np.ones(3))
+
+
+def test_amp_init_casts_matmul_compute_to_bf16():
+    """amp.init() must change what ops COMPUTE, not just set a flag: the
+    lowered dot for f32 params/inputs runs on bf16 operands with f32
+    accumulation (reference: amp_cast insertion per lists/symbol_fp16.py)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.contrib import amp
+    from mxnet_tpu.ops.nn import convolution, fully_connected
+
+    try:
+        amp.init("bfloat16")
+        x = jnp.ones((4, 8), jnp.float32)
+        w = jnp.ones((3, 8), jnp.float32)
+        jx = jax.make_jaxpr(lambda a, b: fully_connected(a, b, no_bias=True))(x, w)
+        txt = str(jx)
+        assert "bf16" in txt, txt  # operands cast to bf16
+        assert "preferred_element_type=float32" in txt, txt  # f32 accumulate
+        # output stays f32 (master-weight semantics around the MXU op)
+        assert jx.out_avals[0].dtype == jnp.float32
+        # conv too
+        xc = jnp.ones((1, 2, 8, 8), jnp.float32)
+        wc = jnp.ones((4, 2, 3, 3), jnp.float32)
+        jc = str(jax.make_jaxpr(lambda a, b: convolution(a, b, kernel=(3, 3)))(xc, wc))
+        assert "bf16" in jc, jc
+    finally:
+        amp._reset()
+    # AMP off again: plain f32 dot
+    txt = str(jax.make_jaxpr(lambda a, b: fully_connected(a, b, no_bias=True))(x, w))
+    assert "bf16" not in txt
+
+
+def test_amp_float16_loss_scaler_skips_overflow_steps():
+    """f16 path: Trainer.step consults the dynamic LossScaler — an inf grad
+    skips the update and shrinks the scale."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.contrib import amp
+    from mxnet_tpu.gluon import nn
+
+    try:
+        amp.init("float16")
+        net = nn.Dense(2, in_units=3)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5})
+        amp.init_trainer(tr)
+        assert tr._amp_loss_scaler.loss_scale > 1.0
+        w0 = net.weight.data().asnumpy().copy()
+        x = nd.ones((2, 3))
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        # poison the gradient with inf: the step must be dropped
+        g = net.weight.data()._grad
+        g._data = g._data.at[0, 0].set(np.inf)
+        scale_before = tr._amp_loss_scaler.loss_scale
+        tr.step(2)
+        np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+        assert tr._amp_loss_scaler.loss_scale < scale_before
+        # healthy grads update normally
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(2)
+        assert not np.array_equal(net.weight.data().asnumpy(), w0)
+    finally:
+        amp._reset()
+
+
+def test_quantized_fc_real_int8_matches_simulated():
+    """Real s8xs8->s32 GEMM with requant scales agrees with the simulated
+    (dequantize-then-f32-matmul) path to float rounding."""
+    import jax.numpy as jnp
+    from mxnet_tpu.contrib import quantization as q
+
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(5, 16), jnp.float32)
+    w = jnp.asarray(rs.randn(8, 16) * 0.5, jnp.float32)
+    xq, xs = q.quantize_array(x)                      # per-tensor
+    wq, ws = q.quantize_array(w, axis=0)              # per-channel
+    real = q.quantized_fully_connected(xq, wq, data_scale=xs, weight_scale=ws)
+    sim = q.dequantize_array(xq, xs, jnp.float32) @ q.dequantize_array(
+        wq, ws, jnp.float32).T
+    np.testing.assert_allclose(np.asarray(real), np.asarray(sim),
+                               rtol=1e-5, atol=1e-5)
+    # and close to the unquantized result (int8 grid error only)
+    np.testing.assert_allclose(np.asarray(real), np.asarray(x @ w.T),
+                               rtol=0.2, atol=0.15)
+
+
+def test_quantized_fc_lowers_to_int8_dot():
+    """The op must EXECUTE in int8: the lowered HLO carries an i8xi8->i32
+    dot, not a dequantized float matmul."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.contrib import quantization as q
+
+    xq = jnp.ones((4, 16), jnp.int8)
+    wq = jnp.ones((8, 16), jnp.int8)
+    txt = jax.jit(lambda a, b: q.quantized_fully_connected(
+        a, b, data_scale=0.1, weight_scale=0.2)).lower(xq, wq).as_text()
+    assert "i8" in txt and "i32" in txt, txt
+
+
+def test_quantized_conv_int8():
+    import jax.numpy as jnp
+    from mxnet_tpu.contrib import quantization as q
+
+    rs = np.random.RandomState(4)
+    x = jnp.asarray(rs.randn(2, 3, 8, 8), jnp.float32)
+    w = jnp.asarray(rs.randn(4, 3, 3, 3) * 0.3, jnp.float32)
+    xq, xs = q.quantize_array(x)
+    wq, ws = q.quantize_array(w, axis=0)
+    real = q.quantized_conv(xq, wq, kernel=(3, 3), data_scale=xs,
+                            weight_scale=ws)
+    from mxnet_tpu.ops.nn import convolution
+    ref = convolution(x, w, kernel=(3, 3))
+    np.testing.assert_allclose(np.asarray(real), np.asarray(ref),
+                               rtol=0.25, atol=0.25)
+
+
+def test_convert_to_int8_end_to_end():
+    """convert_to_int8 swaps Dense layers for int8 execution; calibrated
+    conversion stays close to the f32 net."""
+    from mxnet_tpu.contrib import quantization as q
+    from mxnet_tpu.gluon import nn
+
+    rs = np.random.RandomState(5)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8), nn.Dense(4, in_units=16))
+    net.initialize()
+    x = nd.array(rs.randn(10, 8))
+    ref = net(x).asnumpy()
+    net, scales = q.convert_to_int8(net, calib_data=[x])
+    assert len(scales) == 2
+    out = net(x).asnumpy()
+    assert np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9) < 0.1

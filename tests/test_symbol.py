@@ -60,3 +60,91 @@ def test_symbol_arithmetic_scalars():
     b = (a + 1) * 3 / 2 - 0.5
     (out,) = b.eval(a=nd.array([1.0]))
     np.testing.assert_allclose(out.asnumpy(), [2.5])
+
+
+def test_get_internals_feature_extraction():
+    """Reference workflow: sym.get_internals()['<node>_output'] bound as a
+    feature extractor (nnvm::Symbol::GetInternals)."""
+    data = sym.var("data")
+    c1 = sym.Convolution(data, sym.var("c1w"), sym.var("c1b"),
+                         num_filter=4, kernel=(3, 3), name="conv0")
+    a1 = sym.Activation(c1, act_type="tanh", name="act0")
+    p1 = sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                     name="pool0")
+    f1 = sym.FullyConnected(sym.flatten(p1), sym.var("fw"), sym.var("fb"),
+                            num_hidden=10, name="fc0")
+    internals = f1.get_internals()
+    names = internals.list_outputs()
+    assert "conv0_output" in names and "pool0_output" in names
+    assert "data" in names  # variables appear under their own name
+    feat = internals["conv0_output"]
+    ex = feat.simple_bind(data=(2, 1, 12, 12), c1w=(4, 1, 3, 3), c1b=(4,))
+    (out,) = ex.forward()
+    assert out.shape == (2, 4, 10, 10)
+    # unknown names fail loudly, not silently
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="not found"):
+        internals["nope_output"]
+
+
+def test_group_multi_head():
+    """Group outputs keep separate shapes; executor returns one NDArray per
+    head; JSON roundtrips via multiple heads."""
+    a = sym.var("a")
+    b = sym.tanh(a, name="t0")
+    c = sym.sum(a, name="s0")
+    g = sym.Group([b, c])
+    assert g.list_outputs() == ["t0_output", "s0_output"]
+    ex = g.simple_bind(a=(2, 3))
+    ex.arg_dict["a"][:] = 0.5
+    outs = ex.forward()
+    assert len(outs) == 2
+    assert outs[0].shape == (2, 3) and outs[1].shape == ()
+    g2 = sym.load_json(g.tojson())
+    assert g2.list_outputs() == ["t0_output", "s0_output"]
+    o = g2.eval(a=nd.ones((2, 3)))
+    assert len(o) == 2
+    np.testing.assert_allclose(o[1].asnumpy(), 6.0, rtol=1e-6)
+
+
+def test_group_backward():
+    """Executor.backward over a multi-head Group: cotangent matches the
+    tuple output structure."""
+    a = sym.var("a")
+    g = sym.Group([sym.tanh(a, name="tg"), sym.sum(a * a, name="sg")])
+    ex = g.simple_bind(a=(2, 2))
+    ex.arg_dict["a"][:] = 0.5
+    ex.forward(is_train=True)
+    ex.backward()
+    expect = (1 - np.tanh(0.5) ** 2) + 2 * 0.5  # d tanh(a) + d sum(a^2)
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), expect, rtol=1e-5)
+
+
+def test_sliced_multi_output_names_align():
+    """bn[k] (sliced) lists exactly one name; an unsliced multi-output head
+    in a group expands to all its outputs — names align with forward values."""
+    x = sym.var("x")
+    bn = sym.BatchNorm(x, sym.var("g"), sym.var("b"), sym.var("m"), sym.var("v"),
+                       name="bn0")
+    assert bn.list_outputs() == ["bn0_output0", "bn0_output1", "bn0_output2"]
+    sl = bn[1]
+    assert sl.list_outputs() == ["bn0_output1"]
+    grp = sym.Group([sl, sym.tanh(x, name="tx")])
+    names = grp.list_outputs()
+    assert names == ["bn0_output1", "tx_output"]
+    ex = grp.simple_bind(x=(4, 3), g=(3,), b=(3,), m=(3,), v=(3,))
+    outs = ex.forward()
+    assert len(outs) == len(names)
+    assert outs[0].shape == (3,)  # batch mean, not the normalized output
+    # group containing the UNsliced bn expands to 3 outputs + 1
+    grp2 = sym.Group([bn, sym.tanh(x, name="tx2")])
+    assert len(grp2.list_outputs()) == 4
+    ex2 = grp2.simple_bind(x=(4, 3), g=(3,), b=(3,), m=(3,), v=(3,))
+    assert len(ex2.forward()) == 4
+    # negative indexing picks the LAST head
+    assert grp2[-1].name == "tx2"
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    with pytest.raises(MXNetError, match="out of range"):
+        grp2[7]

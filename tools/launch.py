@@ -38,6 +38,9 @@ def launch_local(n: int, command: list[str]) -> int:
             "MXNET_TPU_COORDINATOR": coord,
             "MXNET_TPU_NPROC": str(n),
             "MXNET_TPU_PROCID": str(rank),
+            # all-local launch: local_rank == rank, local_size == n
+            "MXNET_TPU_LOCAL_RANK": str(rank),
+            "MXNET_TPU_LOCAL_SIZE": str(n),
             # reference-compat aliases so DMLC-era scripts keep working
             "DMLC_ROLE": "worker",
             "DMLC_NUM_WORKER": str(n),
