@@ -13,10 +13,37 @@ import jax.numpy as jnp
 
 from .ndarray import NDArray, array
 
-__all__ = ["imresize", "resize_short", "center_crop", "random_crop",
+__all__ = ["imdecode", "imresize", "resize_short", "center_crop", "random_crop",
            "color_normalize", "batchify_images", "HorizontalFlipAug", "CastAug",
            "ColorNormalizeAug", "RandomCropAug", "CenterCropAug", "ResizeAug",
            "CreateAugmenter"]
+
+
+def imdecode(buf, to_rgb=1, flag=1):
+    """Decode compressed image bytes to an HWC uint8 NDArray (reference:
+    ``mx.image.imdecode`` -> cv::imdecode). JPEG goes through the native
+    baseline decoder (``native/src/jpeg.cc``); npy payloads load directly;
+    other formats fall back to PIL when present."""
+    buf = bytes(buf._data.tobytes()) if isinstance(buf, NDArray) else bytes(buf)
+    if buf[:2] == b"\xff\xd8":
+        from .native import jpeg_decode
+
+        img = jpeg_decode(buf)
+    elif buf[:6] == b"\x93NUMPY":
+        import io as _io
+
+        img = np.load(_io.BytesIO(buf))
+        if img.ndim == 2:
+            img = np.repeat(img[:, :, None], 3, axis=2)
+    else:
+        import io as _io
+
+        import PIL.Image
+
+        img = np.asarray(PIL.Image.open(_io.BytesIO(buf)).convert("RGB"))
+    if not to_rgb:
+        img = img[:, :, ::-1]  # BGR like the reference's cv2 default
+    return array(img)
 
 
 def _raw(x):

@@ -2,3 +2,4 @@
 from .io import DataIter, DataBatch, DataDesc, NDArrayIter, ResizeIter, PrefetchingIter  # noqa: F401
 from . import recordio  # noqa: F401
 from .recordio import MXRecordIO, IndexedRecordIO  # noqa: F401
+from .image_iter import ImageRecordIter, imdecode_record  # noqa: F401

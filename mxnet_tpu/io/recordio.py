@@ -148,12 +148,31 @@ def unpack(s: bytes):
 
 
 def pack_img(header, img, quality=95, img_fmt=".jpg"):
-    """Pack a raw HWC uint8 array. Without OpenCV, stores lossless npy bytes
-    (readers detect the format by magic)."""
+    """Pack a raw HWC uint8 array as JPEG (via cv2 or PIL when present, like
+    the reference's cv2.imencode path); falls back to lossless npy bytes —
+    readers (unpack_img, ImageRecordIter) detect the format by magic."""
     import io as _io
 
+    img = np.asarray(img, dtype=np.uint8)
+    if img_fmt in (".jpg", ".jpeg") and img.ndim == 3 and img.shape[2] == 3:
+        try:
+            import cv2
+
+            ok, enc = cv2.imencode(".jpg", cv2.cvtColor(img, cv2.COLOR_RGB2BGR),
+                                   [cv2.IMWRITE_JPEG_QUALITY, int(quality)])
+            if ok:
+                return pack(header, enc.tobytes())
+        except ImportError:
+            try:
+                import PIL.Image
+
+                buf = _io.BytesIO()
+                PIL.Image.fromarray(img).save(buf, "JPEG", quality=int(quality))
+                return pack(header, buf.getvalue())
+            except ImportError:
+                pass
     buf = _io.BytesIO()
-    np.save(buf, np.asarray(img, dtype=np.uint8))
+    np.save(buf, img)
     return pack(header, buf.getvalue())
 
 
@@ -163,12 +182,17 @@ def unpack_img(s, iscolor=-1):
 
     if img_bytes[:6] == b"\x93NUMPY":
         img = np.load(_io.BytesIO(img_bytes))
+    elif img_bytes[:2] == b"\xff\xd8":
+        # JPEG: the dependency-free native decoder (native/src/jpeg.cc)
+        from ..native import jpeg_decode
+
+        img = jpeg_decode(bytes(img_bytes))
     else:
         try:
             import PIL.Image
 
             img = np.asarray(PIL.Image.open(_io.BytesIO(img_bytes)))
         except Exception as e:
-            raise MXNetError("cannot decode image payload (no OpenCV/PIL jpeg "
-                             "decoder available)") from e
+            raise MXNetError("cannot decode image payload (not JPEG/npy and "
+                             "no PIL available)") from e
     return header, img

@@ -83,6 +83,15 @@ def lib() -> Optional[ctypes.CDLL]:
     L.MXTPUImageFlipH.argtypes = [u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int, u8p]
     L.MXTPUBatchToCHWFloat.argtypes = [u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                                        ctypes.c_int, f32p, f32p, f32p, ctypes.c_int]
+    # jpeg.cc: baseline JPEG decoder
+    L.MXTPUImdecode.restype = ctypes.c_int
+    L.MXTPUImdecode.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                ctypes.POINTER(ctypes.c_int),
+                                ctypes.POINTER(ctypes.c_int),
+                                ctypes.POINTER(ctypes.c_int),
+                                ctypes.POINTER(u8p)]
+    L.MXTPUImageFree.argtypes = [u8p]
+    L.MXTPUJpegLastError.restype = ctypes.c_char_p
     _LIB = L
     return _LIB
 
@@ -114,6 +123,27 @@ def image_resize(src, oh, ow):
     L.MXTPUImageResize(_u8p(src), h, w, c,
                        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), oh, ow)
     return dst
+
+
+def jpeg_decode(buf: bytes):
+    """Baseline JPEG -> HWC RGB uint8 numpy array via the native decoder
+    (reference: cv::imdecode inside ImageRecordIOParser2,
+    ``src/io/iter_image_recordio_2.cc``). Releases the GIL for the whole
+    decode, so Python worker threads scale."""
+    import numpy as np
+
+    L = _require_lib()
+    h, w, c = ctypes.c_int(), ctypes.c_int(), ctypes.c_int()
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    rc = L.MXTPUImdecode(buf, len(buf), ctypes.byref(h), ctypes.byref(w),
+                         ctypes.byref(c), ctypes.byref(out))
+    if rc != 0:
+        raise ValueError(L.MXTPUJpegLastError().decode())
+    try:
+        arr = np.ctypeslib.as_array(out, shape=(h.value, w.value, c.value)).copy()
+    finally:
+        L.MXTPUImageFree(out)
+    return arr
 
 
 def image_flip_h(src):

@@ -9,6 +9,8 @@
 // rejected with a clear error. Exposed through the flat C ABI
 // (MXTPUImdecode) and driven from Python threads — the decode loop holds no
 // Python state, so it runs truly parallel under the GIL.
+#include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -338,9 +340,6 @@ struct Decoder {
 };
 
 }  // namespace mxjpeg
-
-#include <cmath>
-#include <algorithm>
 
 extern "C" {
 

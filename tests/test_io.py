@@ -1,6 +1,7 @@
 """Data pipeline: DataLoader, NDArrayIter, RecordIO wire format
 (reference: tests/python/unittest/test_io.py)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import gluon, nd
@@ -90,10 +91,19 @@ def test_pack_unpack_header():
 
 
 def test_pack_img_roundtrip():
+    # .npy format: lossless
     img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
-    s = pack_img(IRHeader(0, 1.0, 0, 0), img)
+    s = pack_img(IRHeader(0, 1.0, 0, 0), img, img_fmt=".npy")
     h, img2 = unpack_img(s)
     np.testing.assert_array_equal(img, img2)
+    # default .jpg format: lossy but close on smooth content, decoded by the
+    # native baseline decoder
+    yy, xx = np.mgrid[0:16, 0:16]
+    smooth = np.stack([yy * 8, xx * 8, yy * 4 + xx * 4], 2).astype(np.uint8)
+    s = pack_img(IRHeader(0, 1.0, 0, 0), smooth)
+    h, img3 = unpack_img(s)
+    assert img3.shape == smooth.shape
+    assert np.abs(img3.astype(int) - smooth.astype(int)).mean() < 4.0
 
 
 def test_vision_datasets_synthetic():
@@ -115,3 +125,110 @@ def test_prefetching_iter():
     for _ in pf:
         n += 1
     assert n == 2
+
+
+def _make_fixture_rec(tmp_path, n=24, size=(36, 48), jpeg=True):
+    """Pack a small im2rec-style fixture; JPEG via cv2 when available."""
+    from mxnet_tpu.io.recordio import IndexedRecordIO, IRHeader, pack_img
+
+    rec = IndexedRecordIO(str(tmp_path / "fix.idx"), str(tmp_path / "fix.rec"), "w")
+    rs = np.random.RandomState(0)
+    for i in range(n):
+        yy, xx = np.mgrid[0:size[0], 0:size[1]]
+        img = np.stack([(yy * (i + 1)) % 256, (xx * 2) % 256,
+                        (yy + xx + i) % 256], axis=2).astype(np.uint8)
+        fmt = ".jpg" if jpeg else ".npy"
+        rec.write_idx(i, pack_img(IRHeader(0, float(i % 3), i, 0), img,
+                                  img_fmt=fmt))
+    rec.close()
+    return str(tmp_path / "fix.rec"), str(tmp_path / "fix.idx")
+
+
+def test_native_jpeg_decode_matches_cv2(tmp_path):
+    """The dependency-free baseline decoder agrees with cv2 on 4:2:0 JPEG."""
+    cv2 = pytest.importorskip("cv2")
+    from mxnet_tpu.native import available, jpeg_decode
+
+    if not available():
+        pytest.skip("native lib not built")
+    yy, xx = np.mgrid[0:50, 0:70]
+    img = np.stack([yy % 256, (xx * 3) % 256, (xx + yy) % 256], 2).astype(np.uint8)
+    ok, enc = cv2.imencode(".jpg", cv2.cvtColor(img, cv2.COLOR_RGB2BGR),
+                           [cv2.IMWRITE_JPEG_QUALITY, 95])
+    assert ok
+    mine = jpeg_decode(enc.tobytes())
+    ref = cv2.cvtColor(cv2.imdecode(enc, cv2.IMREAD_COLOR), cv2.COLOR_BGR2RGB)
+    assert mine.shape == ref.shape
+    d = np.abs(mine.astype(int) - ref.astype(int))
+    # nearest-neighbor chroma upsample vs libjpeg fancy upsample: tiny mean
+    assert d.mean() < 3.0
+
+
+def test_image_record_iter_end_to_end(tmp_path):
+    """im2rec-packed JPEG fixture -> ImageRecordIter: decode, short-edge
+    resize, crop, mean/std, NCHW batches, correct labels, sharding."""
+    from mxnet_tpu.io import ImageRecordIter
+
+    recf, idxf = _make_fixture_rec(tmp_path)
+    it = ImageRecordIter(path_imgrec=recf, data_shape=(3, 28, 28),
+                         batch_size=8, resize=32, shuffle=False,
+                         mean_r=123.0, mean_g=117.0, mean_b=104.0,
+                         std_r=58.4, std_g=57.1, std_b=57.4,
+                         preprocess_threads=2)
+    assert it.provide_data[0].shape == (8, 3, 28, 28)
+    batches = list(it)
+    assert len(batches) == 3
+    b0 = batches[0]
+    assert b0.data[0].shape == (8, 3, 28, 28)
+    assert str(b0.data[0]._data.dtype) == "float32"
+    np.testing.assert_allclose(np.asarray(b0.label[0]._data),
+                               [i % 3 for i in range(8)])
+    # normalized pixels land in a sane range
+    v = np.asarray(b0.data[0]._data)
+    assert np.abs(v).max() < 6.0
+    # epoch 2 after reset
+    it.reset()
+    assert sum(1 for _ in it) == 3
+    it.close()
+
+    # sharding: 2 parts see disjoint halves
+    it0 = ImageRecordIter(path_imgrec=recf, data_shape=(3, 28, 28),
+                          batch_size=4, num_parts=2, part_index=0)
+    it1 = ImageRecordIter(path_imgrec=recf, data_shape=(3, 28, 28),
+                          batch_size=4, num_parts=2, part_index=1)
+    l0 = np.concatenate([np.asarray(b.label[0]._data) for b in it0])
+    l1 = np.concatenate([np.asarray(b.label[0]._data) for b in it1])
+    assert len(l0) == len(l1) == 12
+    np.testing.assert_allclose(l0, [i % 3 for i in range(0, 24, 2)])
+    np.testing.assert_allclose(l1, [i % 3 for i in range(1, 24, 2)])
+    it0.close(); it1.close()
+
+
+def test_image_record_iter_idx_shuffle_augment(tmp_path):
+    from mxnet_tpu.io import ImageRecordIter
+
+    recf, idxf = _make_fixture_rec(tmp_path, jpeg=False)  # npy payload path
+    it = ImageRecordIter(path_imgrec=recf, path_imgidx=idxf,
+                         data_shape=(3, 24, 24), batch_size=6, shuffle=True,
+                         rand_crop=True, rand_mirror=True, seed=7)
+    labels_e1 = np.concatenate([np.asarray(b.label[0]._data) for b in it])
+    it.reset()
+    labels_e2 = np.concatenate([np.asarray(b.label[0]._data) for b in it])
+    assert len(labels_e1) == 24
+    # shuffled epochs differ (with overwhelming probability given 24!)
+    assert not np.array_equal(labels_e1, labels_e2)
+    it.close()
+
+
+def test_imdecode_public_api():
+    cv2 = pytest.importorskip("cv2")
+    from mxnet_tpu import image as mimg
+    from mxnet_tpu.native import available
+
+    if not available():
+        pytest.skip("native lib not built")
+    img = np.full((16, 20, 3), 128, np.uint8)
+    ok, enc = cv2.imencode(".jpg", img)
+    out = mimg.imdecode(enc.tobytes())
+    assert out.shape == (16, 20, 3)
+    assert abs(int(np.asarray(out._data).mean()) - 128) <= 2

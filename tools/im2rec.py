@@ -50,16 +50,25 @@ def main():
     ap.add_argument("root", help="image root directory")
     ap.add_argument("--list", dest="lst", required=True, help=".lst file")
     ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--pass-through", action="store_true",
+                    help="store original jpeg bytes unmodified (no re-encode)")
     ap.add_argument("--num-thread", type=int, default=1)
     args = ap.parse_args()
 
-    from mxnet_tpu.io.recordio import IndexedRecordIO, IRHeader, pack_img
+    from mxnet_tpu.io.recordio import IndexedRecordIO, IRHeader, pack, pack_img
 
     rec = IndexedRecordIO(args.prefix + ".idx", args.prefix + ".rec", "w")
     n = 0
     for idx, label, rel in read_list(args.lst):
-        img = load_image(os.path.join(args.root, rel), args.resize)
-        rec.write_idx(idx, pack_img(IRHeader(0, label, idx, 0), img))
+        path = os.path.join(args.root, rel)
+        hdr = IRHeader(0, label, idx, 0)
+        if args.pass_through and rel.lower().endswith((".jpg", ".jpeg")):
+            with open(path, "rb") as f:
+                rec.write_idx(idx, pack(hdr, f.read()))
+        else:
+            img = load_image(path, args.resize)
+            rec.write_idx(idx, pack_img(hdr, img, quality=args.quality))
         n += 1
         if n % 1000 == 0:
             print(f"packed {n} images", file=sys.stderr)
