@@ -42,9 +42,11 @@ def _on_tpu() -> bool:
 
 
 _FLASH_MIN_SEQ = 4096  # below this XLA's fused einsum attention is faster on
-# TPU (measured: seq 2048 flash 8.4ms vs einsum 6.4ms); flash's win is O(L)
-# memory — the [b,h,t,t] score tensor the einsum path materializes stops
-# fitting HBM around tq*tk ≥ 4k², exactly where the kernel takes over
+# TPU (round-1 session measured seq 2048 flash 8.4ms vs einsum 6.4ms on v5e;
+# UNREPRODUCED since — no driver artifact has recorded a TPU run, treat as a
+# design heuristic, not a verified number); flash's win is O(L) memory — the
+# [b,h,t,t] score tensor the einsum path materializes stops fitting HBM
+# around tq*tk ≥ 4k², exactly where the kernel takes over
 
 
 def flash_supported(q, k, v, mask=None) -> bool:
