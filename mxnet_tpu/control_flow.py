@@ -1,0 +1,191 @@
+"""Control-flow operators: ``foreach`` / ``while_loop`` / ``cond``.
+
+Reference: ``src/operator/control_flow.cc`` + ``python/mxnet/ndarray/
+contrib.py`` — MXNet 1.x runs the body as a *subgraph op* so the loop can
+live inside a Symbol and be differentiated. The TPU-native design maps each
+construct onto its XLA structured-control-flow primitive (``lax.scan`` /
+``lax.while_loop`` / ``lax.cond``): one traced body, compiler-schedulable,
+no Python re-entry per iteration — exactly what the task's "no
+data-dependent Python control flow inside jit" rule demands.
+
+Autograd: each construct is invoked through the op registry's ``invoke``
+path as a dynamically-built OpDef (the same mechanism ``nd.Custom`` uses),
+so the replay tape differentiates straight through the ``lax`` primitive
+(scan/cond have full VJPs; while_loop is forward-only, as in the reference).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _as_nd(x):
+    from .ndarray import NDArray
+
+    return x if isinstance(x, NDArray) else NDArray(x)
+
+
+def _raw(x):
+    from .ndarray import NDArray
+
+    return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def _listify(x) -> List:
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _tape_call(name, raw_fn, arg_nds):
+    """Invoke ``raw_fn(*raw_args)`` through the autograd tape.
+
+    The body closure may capture NDArrays (e.g. weights) that must receive
+    gradients — the reference handles this by turning free variables of the
+    loop subgraph into implicit op inputs (``control_flow.cc`` subgraph
+    cut). Here ``jax.closure_convert`` hoists the captured buffers, and each
+    hoisted constant is matched back (by buffer identity) to its live
+    NDArray handle so ``backward()`` can reach its ``.grad``.
+    """
+    from .ndarray import NDArray, _live_ndarrays, invoke
+    from .registry import OpDef
+
+    flat = [_raw(a) for a in arg_nds]
+    closed = jax.make_jaxpr(raw_fn)(*flat)
+    consts = list(closed.consts)
+    # match hoisted constants back to live handles by buffer identity so
+    # e.g. a closed-over weight's .grad is populated by backward()
+    const_nds = []
+    for c in consts:
+        handle = None
+        if isinstance(c, jax.Array):
+            handle = next((a for a in _live_ndarrays if a._data is c), None)
+        const_nds.append(handle if handle is not None else NDArray(jnp.asarray(c)))
+    n_args = len(arg_nds)
+
+    def fn(*all_flat):
+        out = jax.core.eval_jaxpr(closed.jaxpr, all_flat[n_args:],
+                                  *all_flat[:n_args])
+        return tuple(out)
+
+    nout = len(closed.jaxpr.outvars)
+    opdef = OpDef(name=name, fn=fn, nout=nout)
+    res = invoke(opdef, tuple(list(arg_nds) + const_nds), {})
+    return (list(res) if isinstance(res, tuple) else [res]), nout
+
+
+def foreach(body: Callable, data, init_states):
+    """Scan ``body`` over axis 0 of ``data``.
+
+    ``body(data_slice, states) -> (outputs, new_states)`` with NDArray
+    inputs/outputs; mirrors ``mx.nd.contrib.foreach``. Returns
+    ``(outputs, final_states)`` where each output is stacked along axis 0.
+    Lowered to one ``lax.scan`` — a single XLA While with a traced body.
+    """
+    data_l = _listify(data)
+    states_l = _listify(init_states)
+    data_was_seq = isinstance(data, (list, tuple))
+    states_was_seq = isinstance(init_states, (list, tuple))
+    n_data = len(data_l)
+
+    def raw_fn(*flat):
+        d_raw = flat[:n_data]
+        s_raw = flat[n_data:]
+
+        def step(carry, xs):
+            ss = [_as_nd(c) for c in carry]
+            xx = [_as_nd(x) for x in xs]
+            out, new_s = body(xx if data_was_seq else xx[0],
+                              ss if states_was_seq else ss[0])
+            out_l = [_raw(o) for o in _listify(out)]
+            new_l = [_raw(s) for s in _listify(new_s)]
+            return tuple(new_l), tuple(out_l)
+
+        final, stacked = lax.scan(step, tuple(s_raw), tuple(d_raw))
+        return tuple(stacked) + tuple(final)
+
+    res, nout = _tape_call("foreach", raw_fn, data_l + states_l)
+    n_out = nout - len(states_l)
+    outs, finals = res[:n_out], res[n_out:]
+    outs = outs if len(outs) != 1 else outs[0]
+    finals = finals if states_was_seq else (finals[0] if finals else [])
+    return outs, finals
+
+
+def while_loop(cond_fn: Callable, func: Callable, loop_vars,
+               max_iterations: int):
+    """``mx.nd.contrib.while_loop`` over a bounded ``lax.scan``.
+
+    ``cond_fn(*loop_vars) -> scalar bool``; ``func(*loop_vars) ->
+    (step_output, new_loop_vars)``. Runs at most ``max_iterations`` steps;
+    rows of the stacked outputs beyond the real iteration count are zeros
+    (the reference leaves them undefined). Returns ``(outputs,
+    final_loop_vars)``.
+
+    Bounded scan (not a raw ``lax.while_loop``) because XLA requires static
+    output shapes — the same reason the reference demands
+    ``max_iterations`` up front.
+    """
+    vars_l = _listify(loop_vars)
+    n_vars = len(vars_l)
+    if max_iterations is None:
+        raise ValueError("while_loop requires max_iterations (static shapes)")
+
+    def raw_fn(*flat):
+        def step(carry, _):
+            alive, vs = carry
+            nd_vs = [_as_nd(v) for v in vs]
+            pred = jnp.logical_and(alive, _raw(cond_fn(*nd_vs)).astype(bool).reshape(()))
+
+            def do(vs_in):
+                out, new_vs = func(*[_as_nd(v) for v in vs_in])
+                return (tuple(_raw(v) for v in _listify(new_vs)),
+                        tuple(_raw(o) for o in _listify(out)))
+
+            def skip(vs_in):
+                out, new_vs = func(*[_as_nd(v) for v in vs_in])  # shape probe
+                zeros = tuple(jnp.zeros_like(_raw(o)) for o in _listify(out))
+                return tuple(vs_in), zeros
+
+            new_vs, outs = lax.cond(pred, do, skip, tuple(vs))
+            return (pred, new_vs), outs
+
+        (_, final_vs), stacked = lax.scan(
+            step, (jnp.bool_(True), tuple(flat)), None, length=max_iterations)
+        return tuple(stacked) + tuple(final_vs)
+
+    res, nout = _tape_call("while_loop", raw_fn, vars_l)
+    n_out = nout - n_vars
+    outs, finals = res[:n_out], res[n_out:]
+    outs = outs if len(outs) != 1 else outs[0]
+    return outs, finals
+
+
+def cond(pred, then_func: Callable, else_func: Callable):
+    """``mx.nd.contrib.cond``.
+
+    Eager (concrete predicate): run exactly one branch in Python, like the
+    reference's imperative path — no wasted compute, branch ops recorded on
+    the autograd tape as usual. Traced (predicate is a jit tracer, e.g.
+    inside ``hybridize``): lower to one ``lax.cond`` — both branches traced,
+    one executed at runtime, no host sync on the predicate.
+    """
+    p_raw = _raw(pred)
+    if not isinstance(p_raw, jax.core.Tracer):
+        out = _listify((then_func if bool(p_raw.reshape(())) else else_func)())
+        return out if len(out) != 1 else out[0]
+
+    out = lax.cond(
+        p_raw.astype(bool).reshape(()),
+        lambda _: tuple(_raw(o) for o in _listify(then_func())),
+        lambda _: tuple(_raw(o) for o in _listify(else_func())),
+        None)
+    res = [_as_nd(o) for o in out]
+    return res if len(res) != 1 else res[0]

@@ -15,7 +15,8 @@ from typing import Optional
 
 __all__ = ["lib", "available", "ensure_built", "NativeRecordReader",
            "NativeRecordWriter", "NativePrefetchReader", "image_resize",
-           "image_crop", "image_flip_h", "batch_to_chw_float", "storage_stats"]
+           "image_crop", "image_flip_h", "batch_to_chw_float", "storage_stats",
+           "imperative_invoke", "list_native_ops"]
 
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
@@ -25,16 +26,19 @@ def _lib_path():
     return os.path.join(os.path.dirname(__file__), "_native", "libmxtpu.so")
 
 
-def ensure_built(quiet=True) -> bool:
-    """Build the native library with make if a toolchain is available."""
-    if os.path.exists(_lib_path()):
+def ensure_built(quiet=True, force=False) -> bool:
+    """Build the native library with make if a toolchain is available.
+
+    ``force=True`` rebuilds even when the .so exists — used when a stale
+    artifact predates an ABI extension (missing symbols)."""
+    if not force and os.path.exists(_lib_path()):
         return True
     native_dir = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
     if not os.path.isdir(native_dir):
         return False
     try:
-        subprocess.run(["make", "-C", native_dir], check=True,
-                       capture_output=quiet, timeout=120)
+        cmd = ["make", "-C", native_dir] + (["-B"] if force else [])
+        subprocess.run(cmd, check=True, capture_output=quiet, timeout=120)
         return os.path.exists(_lib_path())
     except Exception:
         return False
@@ -51,6 +55,22 @@ def lib() -> Optional[ctypes.CDLL]:
         L = ctypes.CDLL(_lib_path())
     except OSError:
         return None
+    if not hasattr(L, "MXTPUImperativeInvoke"):
+        # stale artifact from before the core-ABI extension: the file exists
+        # so ensure_built() skipped make — force a rebuild and reload
+        # (dlclose first: dlopen of the same path would return the old map)
+        import _ctypes
+
+        _ctypes.dlclose(L._handle)
+        del L
+        if not ensure_built(force=True):
+            return None
+        try:
+            L = ctypes.CDLL(_lib_path())
+        except OSError:
+            return None
+        if not hasattr(L, "MXTPUImperativeInvoke"):
+            return None
     L.MXTPUGetLastError.restype = ctypes.c_char_p
     L.MXTPURecordWriterCreate.restype = ctypes.c_void_p
     L.MXTPURecordWriterCreate.argtypes = [ctypes.c_char_p]
@@ -92,8 +112,173 @@ def lib() -> Optional[ctypes.CDLL]:
                                 ctypes.POINTER(u8p)]
     L.MXTPUImageFree.argtypes = [u8p]
     L.MXTPUJpegLastError.restype = ctypes.c_char_p
+    # c_api.cc: core NDArray + imperative invoke ABI
+    vp = ctypes.c_void_p
+    L.MXTPUNDArrayCreateFromBytes.restype = ctypes.c_int
+    L.MXTPUNDArrayCreateFromBytes.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(vp)]
+    L.MXTPUNDArrayFree.argtypes = [vp]
+    L.MXTPUNDArrayGetShape.argtypes = [vp, ctypes.POINTER(ctypes.c_int),
+                                       ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))]
+    L.MXTPUNDArrayGetDType.argtypes = [vp, ctypes.POINTER(ctypes.c_int)]
+    L.MXTPUNDArrayGetData.argtypes = [vp, ctypes.POINTER(ctypes.c_void_p)]
+    L.MXTPUNDArraySize.argtypes = [vp, ctypes.POINTER(ctypes.c_int64)]
+    L.MXTPUImperativeInvoke.restype = ctypes.c_int
+    L.MXTPUImperativeInvoke.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(vp), ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(vp), ctypes.POINTER(ctypes.c_int)]
+    L.MXTPUSetInvokeBridge.argtypes = [ctypes.c_void_p]
+    L.MXTPUSetLastError.argtypes = [ctypes.c_char_p]
     _LIB = L
+    _install_invoke_bridge(L)
     return _LIB
+
+
+# --------------------------------------------------------------------------
+# Core ABI: NDArray handles + imperative invoke (c_api.cc)
+# --------------------------------------------------------------------------
+
+# mshadow TypeFlag order (reference include/mshadow/base.h)
+_DTYPE_TO_NP = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+                4: "int32", 5: "int8", 6: "int64"}
+_NP_TO_DTYPE = {v: k for k, v in _DTYPE_TO_NP.items()}
+
+_BRIDGE_REF = None  # keep the CFUNCTYPE alive for the process lifetime
+
+
+def _handle_to_numpy(L, h):
+    import numpy as np
+
+    ndim = ctypes.c_int()
+    shape_p = ctypes.POINTER(ctypes.c_int64)()
+    if L.MXTPUNDArrayGetShape(h, ctypes.byref(ndim), ctypes.byref(shape_p)):
+        raise RuntimeError(L.MXTPUGetLastError().decode())
+    shape = tuple(shape_p[i] for i in range(ndim.value))
+    dt = ctypes.c_int()
+    L.MXTPUNDArrayGetDType(h, ctypes.byref(dt))
+    np_dt = np.dtype(_DTYPE_TO_NP[dt.value])
+    data = ctypes.c_void_p()
+    L.MXTPUNDArrayGetData(h, ctypes.byref(data))
+    n = int(np.prod(shape)) if shape else 1
+    buf = ctypes.string_at(data, n * np_dt.itemsize)
+    return np.frombuffer(buf, dtype=np_dt).reshape(shape).copy()
+
+
+def _numpy_to_handle(L, arr):
+    import numpy as np
+
+    arr = np.ascontiguousarray(arr)
+    if str(arr.dtype) == "bfloat16":  # no C-side bf16; widen at the boundary
+        arr = arr.astype(np.float32)
+    if str(arr.dtype) not in _NP_TO_DTYPE:
+        arr = arr.astype(np.float32)
+    shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    out = ctypes.c_void_p()
+    rc = L.MXTPUNDArrayCreateFromBytes(
+        arr.ctypes.data_as(ctypes.c_void_p), shape, arr.ndim,
+        _NP_TO_DTYPE[str(arr.dtype)], ctypes.byref(out))
+    if rc:
+        raise RuntimeError(L.MXTPUGetLastError().decode())
+    return out
+
+
+def _install_invoke_bridge(L):
+    """Install the jax bridge: MXTPUImperativeInvoke dispatches any op the
+    native C++ tier lacks into the full Python/jax registry.
+
+    This is what makes the C ABI cover the WHOLE op surface when the
+    library is loaded inside a Python runtime — the analog of the
+    reference's MXImperativeInvokeEx reaching every NNVM-registered op.
+    """
+    global _BRIDGE_REF
+    import json
+
+    bridge_t = ctypes.CFUNCTYPE(
+        ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int))
+
+    def bridge(op_name, inputs, n_in, param_json, outputs, n_out):
+        try:
+            from . import registry
+
+            name = op_name.decode()
+            try:
+                opdef = registry.get(name)
+            except AttributeError as e:
+                L.MXTPUSetLastError(str(e).encode())
+                return -1
+            arrs = [_handle_to_numpy(L, inputs[i]) for i in range(n_in)]
+            params = json.loads(param_json.decode()) if param_json else {}
+            import numpy as np
+
+            out = opdef.fn(*arrs, **params)
+            outs = list(out) if isinstance(out, tuple) else [out]
+            if len(outs) > n_out[0]:
+                L.MXTPUSetLastError(b"bridge: outputs capacity too small")
+                return -1
+            created = []
+            try:
+                for i, o in enumerate(outs):
+                    outputs[i] = _numpy_to_handle(L, np.asarray(o))
+                    created.append(outputs[i])
+            except Exception:
+                for h in created:  # don't orphan partial outputs on failure
+                    L.MXTPUNDArrayFree(h)
+                raise
+            n_out[0] = len(outs)
+            return 0
+        except Exception as e:  # noqa: BLE001 — C boundary: no exceptions out
+            try:
+                L.MXTPUSetLastError(f"bridge: {e!r}".encode())
+            except Exception:
+                pass
+            return -1
+
+    _BRIDGE_REF = bridge_t(bridge)
+    L.MXTPUSetInvokeBridge(ctypes.cast(_BRIDGE_REF, ctypes.c_void_p))
+
+
+def imperative_invoke(op_name, arrays, params=None):
+    """Invoke an op through the C ABI (round-trips host bytes; for binding
+    tests and host-side tooling, not the jit hot path)."""
+    import json
+
+    import numpy as np
+
+    L = _require_lib()
+    handles = [_numpy_to_handle(L, np.asarray(a)) for a in arrays]
+    try:
+        ins = (ctypes.c_void_p * max(len(handles), 1))(*handles)
+        outs = (ctypes.c_void_p * 8)()
+        n_out = ctypes.c_int(8)
+        pj = json.dumps(params or {}).encode()
+        rc = L.MXTPUImperativeInvoke(op_name.encode(), ins, len(handles), pj,
+                                     outs, ctypes.byref(n_out))
+        if rc:
+            raise RuntimeError(L.MXTPUGetLastError().decode())
+        results = []
+        try:
+            for i in range(n_out.value):
+                results.append(_handle_to_numpy(L, outs[i]))
+        finally:
+            for i in range(n_out.value):
+                L.MXTPUNDArrayFree(outs[i])
+        return results[0] if len(results) == 1 else tuple(results)
+    finally:
+        for h in handles:
+            L.MXTPUNDArrayFree(h)
+
+
+def list_native_ops():
+    L = _require_lib()
+    names_p = ctypes.POINTER(ctypes.c_char_p)()
+    n = ctypes.c_int()
+    L.MXTPUListNativeOps.argtypes = [ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p)),
+                                     ctypes.POINTER(ctypes.c_int)]
+    L.MXTPUListNativeOps(ctypes.byref(names_p), ctypes.byref(n))
+    return [names_p[i].decode() for i in range(n.value)]
 
 
 def _require_lib():

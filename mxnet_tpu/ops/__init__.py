@@ -11,3 +11,4 @@ from . import attention  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import contrib_vision  # noqa: F401
+from . import linalg  # noqa: F401

@@ -223,7 +223,7 @@ def batch_dot(a, b, transpose_a=False, transpose_b=False):
     return jnp.matmul(a, b)
 
 
-register("linalg_gemm2")(lambda a, b, transpose_a=False, transpose_b=False, alpha=1.0: alpha * batch_dot(a, b, transpose_a, transpose_b))
+# linalg_gemm2 and the rest of the la_op family live in ops/linalg.py
 
 
 # --------------------------------------------------------------------------

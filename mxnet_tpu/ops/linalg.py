@@ -1,0 +1,159 @@
+"""Linear-algebra operator family (reference: ``src/operator/tensor/la_op.cc``).
+
+MXNet 1.x exposes these as ``mx.nd.linalg_*`` (and the ``mx.nd.linalg``
+submodule): BLAS-3 style batched matrix ops (gemm/trsm/trmm/syrk) and LAPACK
+factorizations (potrf/potri/gelqf) plus determinant helpers. The reference
+dispatches to cuBLAS/cuSOLVER per batch; here each op is a single jnp/lax
+call that XLA batches and tiles onto the MXU, and every op gets its gradient
+from jax autodiff instead of the hand-derived ``FGradient`` entries in
+``la_op.cc``.
+
+All ops operate on the last two axes; leading axes are batch (matching the
+reference's ``-2`` axis convention).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register
+
+
+def _t(x, transpose):
+    return jnp.swapaxes(x, -1, -2) if transpose else x
+
+
+@register("linalg_gemm", aliases=("_linalg_gemm",))
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0):
+    """alpha * op(A) @ op(B) + beta * C (reference: la_op.cc gemm)."""
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b)) + beta * C
+
+
+@register("linalg_gemm2", aliases=("_linalg_gemm2",))
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
+    """alpha * op(A) @ op(B) (reference: la_op.cc gemm2)."""
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b))
+
+
+@register("linalg_potrf", aliases=("_linalg_potrf",))
+def linalg_potrf(A):
+    """Cholesky factor L of a symmetric positive-definite A = L L^T."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri", aliases=("_linalg_potri",))
+def linalg_potri(A):
+    """Inverse of the original matrix from its Cholesky factor L:
+    potri(L) = inv(L L^T) (reference: la_op.cc potri)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    inv_l = lax.linalg.triangular_solve(A, eye, left_side=True, lower=True)
+    return jnp.matmul(jnp.swapaxes(inv_l, -1, -2), inv_l)
+
+
+@register("linalg_trsm", aliases=("_linalg_trsm",))
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Solve op(A) X = alpha B (or X op(A) = alpha B when rightside)."""
+    out = lax.linalg.triangular_solve(
+        A, alpha * B, left_side=not rightside, lower=lower,
+        transpose_a=transpose)
+    return out
+
+
+@register("linalg_trmm", aliases=("_linalg_trmm",))
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Triangular matrix multiply: op(tri(A)) @ B (or B @ op(tri(A)))."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    tri = _t(tri, transpose)
+    return alpha * (jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B))
+
+
+@register("linalg_syrk", aliases=("_linalg_syrk",))
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    """alpha * A @ A^T (or alpha * A^T @ A when transpose)."""
+    return alpha * jnp.matmul(_t(A, transpose), _t(A, not transpose))
+
+
+@register("linalg_sumlogdiag", aliases=("_linalg_sumlogdiag",))
+def linalg_sumlogdiag(A):
+    """Sum of log of the diagonal (log-det of a Cholesky factor)."""
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_gelqf", aliases=("_linalg_gelqf",), nout=2)
+def linalg_gelqf(A):
+    """LQ factorization A = L Q with Q orthonormal rows (reference gelqf).
+
+    Implemented via QR of A^T: A^T = Q_r R  =>  A = R^T Q_r^T = L Q.
+    """
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register("linalg_det", aliases=("_linalg_det",))
+def linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", aliases=("_linalg_slogdet",), nout=2)
+def linalg_slogdet(A):
+    sign, logabsdet = jnp.linalg.slogdet(A)
+    return sign, logabsdet
+
+
+@register("linalg_inverse", aliases=("_linalg_inverse",))
+def linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("linalg_extractdiag", aliases=("_linalg_extractdiag",))
+def linalg_extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag", aliases=("_linalg_makediag",))
+def linalg_makediag(A, offset=0):
+    n = A.shape[-1] + abs(offset)
+    out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    return out.at[..., rows, cols].set(A)
+
+
+@register("linalg_extracttrian", aliases=("_linalg_extracttrian",))
+def linalg_extracttrian(A, offset=0, lower=True):
+    """Pack the (lower/upper) triangle band into a vector (reference layout:
+    row-major walk of the kept triangle)."""
+    n = A.shape[-1]
+    import numpy as _np
+
+    mask = _np.tril(_np.ones((n, n), bool), k=offset) if lower else \
+        _np.triu(_np.ones((n, n), bool), k=offset)
+    rows, cols = _np.nonzero(mask)
+    return A[..., rows, cols]
+
+
+@register("linalg_maketrian", aliases=("_linalg_maketrian",))
+def linalg_maketrian(A, offset=0, lower=True):
+    """Inverse of extracttrian: scatter the packed vector back into an n x n
+    triangular matrix (zero elsewhere)."""
+    import numpy as _np
+
+    m = A.shape[-1]
+    # m = number of kept entries; solve n from the triangular count
+    k = abs(offset)
+    # entries = n*(n+1)/2 + extra band adjustment; brute-force smallest n
+    n = 1
+    while True:
+        mask = _np.tril(_np.ones((n, n), bool), k=offset) if lower else \
+            _np.triu(_np.ones((n, n), bool), k=offset)
+        cnt = int(mask.sum())
+        if cnt == m:
+            break
+        if cnt > m or n > 4096:
+            raise ValueError(f"linalg_maketrian: no n matches {m} entries")
+        n += 1
+    rows, cols = _np.nonzero(mask)
+    out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    return out.at[..., rows, cols].set(A)

@@ -1,0 +1,80 @@
+/* Flat C ABI — core NDArray + imperative-invoke surface.
+ *
+ * TPU-native analog of the reference's include/mxnet/c_api.h (the "ONLY
+ * ABI" every language binding wraps: MXNDArrayCreate*, MXImperativeInvokeEx,
+ * MXGetLastError in src/c_api/c_api_ndarray.cc). Design differences, on
+ * purpose:
+ *   - handles hold HOST buffers; device residency belongs to PJRT/XLA. A
+ *     binding hands bytes across this ABI and the runtime stages them.
+ *   - op dispatch is two-tier: a native C++ registry (host reference
+ *     kernels: dot/softmax/elementwise — enough for binding smoke tests and
+ *     host-side pre/post-processing), and an optional *bridge* installed by
+ *     an embedding Python runtime that routes any op name into the full
+ *     jax/XLA registry. The reference had one tier because its kernels WERE
+ *     native; here the fast path is the compiler, so the native tier is the
+ *     fallback rather than the engine.
+ *
+ * Conventions (same as the reference): every function returns 0 on success,
+ * -1 on failure with the message in MXTPUGetLastError() (thread-local).
+ */
+#ifndef MXTPU_C_API_H_
+#define MXTPU_C_API_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* MXTPUNDHandle;
+
+/* dtype codes follow the reference's mshadow enum (base.h TypeFlag). */
+enum MXTPUDType {
+  kMXTPUFloat32 = 0,
+  kMXTPUFloat64 = 1,
+  kMXTPUFloat16 = 2,
+  kMXTPUUint8 = 3,
+  kMXTPUInt32 = 4,
+  kMXTPUInt8 = 5,
+  kMXTPUInt64 = 6,
+};
+
+const char* MXTPUGetLastError();
+
+int MXTPUNDArrayCreateFromBytes(const void* data, const int64_t* shape,
+                                int ndim, int dtype, MXTPUNDHandle* out);
+int MXTPUNDArrayFree(MXTPUNDHandle h);
+int MXTPUNDArrayGetShape(MXTPUNDHandle h, int* ndim, const int64_t** shape);
+int MXTPUNDArrayGetDType(MXTPUNDHandle h, int* dtype);
+int MXTPUNDArrayGetData(MXTPUNDHandle h, const void** data);
+int MXTPUNDArraySize(MXTPUNDHandle h, int64_t* size);
+
+/* Invoke a named operator. inputs/n_in as given; on entry *n_out holds the
+ * capacity of the outputs array, on exit the number written. param_json is
+ * a flat JSON object of op hyper-parameters ({"transpose_a": true}, ...),
+ * mirroring the reference's key/value param strings in
+ * MXImperativeInvokeEx. Dispatch: native registry first, then the bridge
+ * (if installed). */
+int MXTPUImperativeInvoke(const char* op_name, MXTPUNDHandle* inputs,
+                          int n_in, const char* param_json,
+                          MXTPUNDHandle* outputs, int* n_out);
+
+/* Number of ops in the native tier + name listing. */
+int MXTPUListNativeOps(const char*** names, int* n);
+
+/* Bridge: an embedding runtime (Python/jax) installs this to serve every
+ * op name the native tier lacks. Returns 0 on success, nonzero on failure
+ * (and must set an error via MXTPUSetLastError). */
+typedef int (*MXTPUInvokeBridgeFn)(const char* op_name,
+                                   MXTPUNDHandle* inputs, int n_in,
+                                   const char* param_json,
+                                   MXTPUNDHandle* outputs, int* n_out);
+int MXTPUSetInvokeBridge(MXTPUInvokeBridgeFn fn);
+void MXTPUSetLastError(const char* msg);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_API_H_ */

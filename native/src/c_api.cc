@@ -1,0 +1,383 @@
+// Core C ABI: NDArray handles + imperative invoke (see mxtpu_c_api.h).
+//
+// Reference analog: src/c_api/c_api_ndarray.cc (MXImperativeInvokeEx ->
+// Imperative::Invoke -> engine push) + src/c_api/c_api.cc error plumbing.
+// Here there is no engine — the native tier computes synchronously on host
+// buffers with a handful of reference kernels, and the full op surface is
+// served by the bridge an embedding jax runtime installs (native.py).
+
+#include "../include/mxtpu_c_api.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <functional>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct NDArrayRec {
+  std::vector<int64_t> shape;
+  int dtype = kMXTPUFloat32;
+  std::vector<uint8_t> data;
+
+  int64_t size() const {
+    int64_t n = 1;
+    for (int64_t d : shape) n *= d;
+    return n;
+  }
+  float* f32() { return reinterpret_cast<float*>(data.data()); }
+  const float* f32() const { return reinterpret_cast<const float*>(data.data()); }
+};
+
+size_t dtype_bytes(int dtype) {
+  switch (dtype) {
+    case kMXTPUFloat32: return 4;
+    case kMXTPUFloat64: return 8;
+    case kMXTPUFloat16: return 2;
+    case kMXTPUUint8: return 1;
+    case kMXTPUInt32: return 4;
+    case kMXTPUInt8: return 1;
+    case kMXTPUInt64: return 8;
+    default: return 0;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Minimal flat-JSON parser: {"key": number|true|false|"string"} — the shape
+// of op param dicts crossing this ABI (reference passed key/value string
+// arrays; JSON keeps the ABI one pointer wide).
+// ---------------------------------------------------------------------------
+struct Params {
+  std::map<std::string, double> nums;
+  std::map<std::string, bool> bools;
+  std::map<std::string, std::string> strs;
+
+  bool flag(const std::string& k, bool dflt) const {
+    auto it = bools.find(k);
+    if (it != bools.end()) return it->second;
+    auto n = nums.find(k);
+    if (n != nums.end()) return n->second != 0;
+    return dflt;
+  }
+  double num(const std::string& k, double dflt) const {
+    auto it = nums.find(k);
+    return it == nums.end() ? dflt : it->second;
+  }
+};
+
+bool parse_params(const char* json, Params* out, std::string* err) {
+  if (json == nullptr) return true;
+  const char* p = json;
+  auto skip_ws = [&] { while (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r') ++p; };
+  skip_ws();
+  if (*p == '\0') return true;
+  if (*p != '{') { *err = "param_json: expected '{'"; return false; }
+  ++p;
+  skip_ws();
+  if (*p == '}') return true;
+  while (true) {
+    skip_ws();
+    if (*p != '"') { *err = "param_json: expected key string"; return false; }
+    ++p;
+    std::string key;
+    while (*p && *p != '"') key += *p++;
+    if (*p != '"') { *err = "param_json: unterminated key"; return false; }
+    ++p;
+    skip_ws();
+    if (*p != ':') { *err = "param_json: expected ':'"; return false; }
+    ++p;
+    skip_ws();
+    if (*p == '"') {
+      ++p;
+      std::string val;
+      while (*p && *p != '"') val += *p++;
+      if (*p != '"') { *err = "param_json: unterminated string"; return false; }
+      ++p;
+      out->strs[key] = val;
+    } else if (std::strncmp(p, "true", 4) == 0) {
+      out->bools[key] = true; p += 4;
+    } else if (std::strncmp(p, "false", 5) == 0) {
+      out->bools[key] = false; p += 5;
+    } else if (std::strncmp(p, "null", 4) == 0) {
+      p += 4;
+    } else {
+      char* end = nullptr;
+      double v = std::strtod(p, &end);
+      if (end == p) { *err = "param_json: bad value for " + key; return false; }
+      out->nums[key] = v;
+      p = end;
+    }
+    skip_ws();
+    if (*p == ',') { ++p; continue; }
+    if (*p == '}') break;
+    *err = "param_json: expected ',' or '}'";
+    return false;
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// Native op registry (host reference kernels, f32).
+// ---------------------------------------------------------------------------
+using NativeOp = std::function<int(std::vector<NDArrayRec*>&, const Params&,
+                                   std::vector<NDArrayRec*>*)>;
+
+int require_f32(std::vector<NDArrayRec*>& ins, const char* op) {
+  for (auto* a : ins) {
+    if (a->dtype != kMXTPUFloat32) {
+      g_last_error = std::string(op) + ": native tier supports float32 only "
+                     "(use the jax bridge for other dtypes)";
+      return -1;
+    }
+  }
+  return 0;
+}
+
+NDArrayRec* make_out(const std::vector<int64_t>& shape, int dtype) {
+  auto* r = new NDArrayRec();
+  r->shape = shape;
+  r->dtype = dtype;
+  r->data.resize(static_cast<size_t>(r->size()) * dtype_bytes(dtype));
+  return r;
+}
+
+int op_dot(std::vector<NDArrayRec*>& ins, const Params& ps,
+           std::vector<NDArrayRec*>* outs) {
+  if (ins.size() != 2) { g_last_error = "dot: expects 2 inputs"; return -1; }
+  if (require_f32(ins, "dot")) return -1;
+  NDArrayRec *a = ins[0], *b = ins[1];
+  if (a->shape.size() != 2 || b->shape.size() != 2) {
+    g_last_error = "dot: native tier handles 2-D only";
+    return -1;
+  }
+  bool ta = ps.flag("transpose_a", false), tb = ps.flag("transpose_b", false);
+  int64_t m = ta ? a->shape[1] : a->shape[0];
+  int64_t k = ta ? a->shape[0] : a->shape[1];
+  int64_t k2 = tb ? b->shape[1] : b->shape[0];
+  int64_t n = tb ? b->shape[0] : b->shape[1];
+  if (k != k2) { g_last_error = "dot: inner dimensions mismatch"; return -1; }
+  NDArrayRec* o = make_out({m, n}, kMXTPUFloat32);
+  const float* A = a->f32();
+  const float* B = b->f32();
+  float* C = o->f32();
+  int64_t lda = a->shape[1], ldb = b->shape[1];
+  for (int64_t i = 0; i < m; ++i) {
+    for (int64_t j = 0; j < n; ++j) {
+      double acc = 0.0;
+      for (int64_t t = 0; t < k; ++t) {
+        float av = ta ? A[t * lda + i] : A[i * lda + t];
+        float bv = tb ? B[j * ldb + t] : B[t * ldb + j];
+        acc += static_cast<double>(av) * bv;
+      }
+      C[i * n + j] = static_cast<float>(acc);
+    }
+  }
+  outs->push_back(o);
+  return 0;
+}
+
+int op_softmax(std::vector<NDArrayRec*>& ins, const Params& ps,
+               std::vector<NDArrayRec*>* outs) {
+  if (ins.size() != 1) { g_last_error = "softmax: expects 1 input"; return -1; }
+  if (require_f32(ins, "softmax")) return -1;
+  NDArrayRec* a = ins[0];
+  int ndim = static_cast<int>(a->shape.size());
+  int axis = static_cast<int>(ps.num("axis", -1));
+  if (axis < 0) axis += ndim;
+  if (axis != ndim - 1) {
+    g_last_error = "softmax: native tier handles last-axis only";
+    return -1;
+  }
+  int64_t inner = a->shape[ndim - 1];
+  int64_t outer = a->size() / inner;
+  NDArrayRec* o = make_out(a->shape, kMXTPUFloat32);
+  const float* X = a->f32();
+  float* Y = o->f32();
+  for (int64_t r = 0; r < outer; ++r) {
+    const float* x = X + r * inner;
+    float* y = Y + r * inner;
+    float mx = x[0];
+    for (int64_t i = 1; i < inner; ++i) mx = std::max(mx, x[i]);
+    double sum = 0.0;
+    for (int64_t i = 0; i < inner; ++i) { y[i] = std::exp(x[i] - mx); sum += y[i]; }
+    for (int64_t i = 0; i < inner; ++i) y[i] = static_cast<float>(y[i] / sum);
+  }
+  outs->push_back(o);
+  return 0;
+}
+
+int binary_ew(std::vector<NDArrayRec*>& ins, std::vector<NDArrayRec*>* outs,
+              const char* name, float (*fn)(float, float)) {
+  if (ins.size() != 2) { g_last_error = std::string(name) + ": expects 2 inputs"; return -1; }
+  if (require_f32(ins, name)) return -1;
+  if (ins[0]->shape != ins[1]->shape) {
+    g_last_error = std::string(name) + ": native tier requires equal shapes";
+    return -1;
+  }
+  NDArrayRec* o = make_out(ins[0]->shape, kMXTPUFloat32);
+  const float* A = ins[0]->f32();
+  const float* B = ins[1]->f32();
+  float* C = o->f32();
+  for (int64_t i = 0, n = o->size(); i < n; ++i) C[i] = fn(A[i], B[i]);
+  outs->push_back(o);
+  return 0;
+}
+
+int unary_ew(std::vector<NDArrayRec*>& ins, std::vector<NDArrayRec*>* outs,
+             const char* name, float (*fn)(float)) {
+  if (ins.size() != 1) { g_last_error = std::string(name) + ": expects 1 input"; return -1; }
+  if (require_f32(ins, name)) return -1;
+  NDArrayRec* o = make_out(ins[0]->shape, kMXTPUFloat32);
+  const float* A = ins[0]->f32();
+  float* C = o->f32();
+  for (int64_t i = 0, n = o->size(); i < n; ++i) C[i] = fn(A[i]);
+  outs->push_back(o);
+  return 0;
+}
+
+const std::map<std::string, NativeOp>& native_registry() {
+  static const std::map<std::string, NativeOp> reg = {
+      {"dot", op_dot},
+      {"softmax", op_softmax},
+      {"add", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
+         return binary_ew(i, o, "add", [](float a, float b) { return a + b; }); }},
+      {"subtract", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
+         return binary_ew(i, o, "subtract", [](float a, float b) { return a - b; }); }},
+      {"multiply", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
+         return binary_ew(i, o, "multiply", [](float a, float b) { return a * b; }); }},
+      {"divide", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
+         return binary_ew(i, o, "divide", [](float a, float b) { return a / b; }); }},
+      {"relu", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
+         return unary_ew(i, o, "relu", [](float a) { return a > 0 ? a : 0.0f; }); }},
+      {"exp", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
+         return unary_ew(i, o, "exp", [](float a) { return std::exp(a); }); }},
+      {"log", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
+         return unary_ew(i, o, "log", [](float a) { return std::log(a); }); }},
+      {"negative", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
+         return unary_ew(i, o, "negative", [](float a) { return -a; }); }},
+  };
+  return reg;
+}
+
+MXTPUInvokeBridgeFn g_bridge = nullptr;
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTPUGetLastError() { return g_last_error.c_str(); }
+
+void MXTPUSetLastError(const char* msg) { g_last_error = msg ? msg : ""; }
+
+int MXTPUNDArrayCreateFromBytes(const void* data, const int64_t* shape,
+                                int ndim, int dtype, MXTPUNDHandle* out) {
+  if (out == nullptr) { g_last_error = "CreateFromBytes: out is null"; return -1; }
+  if (ndim < 0 || (ndim > 0 && shape == nullptr)) {
+    g_last_error = "CreateFromBytes: bad shape";
+    return -1;
+  }
+  size_t esize = dtype_bytes(dtype);
+  if (esize == 0) { g_last_error = "CreateFromBytes: unknown dtype"; return -1; }
+  auto* r = new NDArrayRec();
+  r->dtype = dtype;
+  r->shape.assign(shape, shape + ndim);
+  int64_t n = r->size();
+  if (n < 0) { delete r; g_last_error = "CreateFromBytes: negative size"; return -1; }
+  r->data.resize(static_cast<size_t>(n) * esize);
+  if (data != nullptr && n > 0)
+    std::memcpy(r->data.data(), data, r->data.size());
+  *out = r;
+  return 0;
+}
+
+int MXTPUNDArrayFree(MXTPUNDHandle h) {
+  delete static_cast<NDArrayRec*>(h);
+  return 0;
+}
+
+int MXTPUNDArrayGetShape(MXTPUNDHandle h, int* ndim, const int64_t** shape) {
+  if (h == nullptr) { g_last_error = "GetShape: null handle"; return -1; }
+  auto* r = static_cast<NDArrayRec*>(h);
+  if (ndim) *ndim = static_cast<int>(r->shape.size());
+  if (shape) *shape = r->shape.data();
+  return 0;
+}
+
+int MXTPUNDArrayGetDType(MXTPUNDHandle h, int* dtype) {
+  if (h == nullptr) { g_last_error = "GetDType: null handle"; return -1; }
+  *dtype = static_cast<NDArrayRec*>(h)->dtype;
+  return 0;
+}
+
+int MXTPUNDArrayGetData(MXTPUNDHandle h, const void** data) {
+  if (h == nullptr) { g_last_error = "GetData: null handle"; return -1; }
+  *data = static_cast<NDArrayRec*>(h)->data.data();
+  return 0;
+}
+
+int MXTPUNDArraySize(MXTPUNDHandle h, int64_t* size) {
+  if (h == nullptr) { g_last_error = "Size: null handle"; return -1; }
+  *size = static_cast<NDArrayRec*>(h)->size();
+  return 0;
+}
+
+int MXTPUImperativeInvoke(const char* op_name, MXTPUNDHandle* inputs,
+                          int n_in, const char* param_json,
+                          MXTPUNDHandle* outputs, int* n_out) {
+  if (op_name == nullptr) { g_last_error = "Invoke: op_name is null"; return -1; }
+  if (n_out == nullptr || outputs == nullptr) {
+    g_last_error = "Invoke: outputs/n_out is null";
+    return -1;
+  }
+  const auto& reg = native_registry();
+  auto it = reg.find(op_name);
+  if (it == reg.end()) {
+    if (g_bridge != nullptr) return g_bridge(op_name, inputs, n_in,
+                                             param_json, outputs, n_out);
+    g_last_error = std::string("Invoke: op '") + op_name +
+                   "' not in the native tier and no jax bridge installed";
+    return -1;
+  }
+  Params ps;
+  std::string err;
+  if (!parse_params(param_json, &ps, &err)) { g_last_error = err; return -1; }
+  std::vector<NDArrayRec*> ins;
+  for (int i = 0; i < n_in; ++i) {
+    if (inputs[i] == nullptr) { g_last_error = "Invoke: null input handle"; return -1; }
+    ins.push_back(static_cast<NDArrayRec*>(inputs[i]));
+  }
+  std::vector<NDArrayRec*> outs;
+  if (it->second(ins, ps, &outs) != 0) {
+    for (auto* o : outs) delete o;
+    return -1;
+  }
+  if (static_cast<int>(outs.size()) > *n_out) {
+    for (auto* o : outs) delete o;
+    g_last_error = "Invoke: outputs capacity too small";
+    return -1;
+  }
+  for (size_t i = 0; i < outs.size(); ++i) outputs[i] = outs[i];
+  *n_out = static_cast<int>(outs.size());
+  return 0;
+}
+
+int MXTPUListNativeOps(const char*** names, int* n) {
+  static std::vector<const char*> cached;
+  if (cached.empty())
+    for (const auto& kv : native_registry()) cached.push_back(kv.first.c_str());
+  if (names) *names = cached.data();
+  if (n) *n = static_cast<int>(cached.size());
+  return 0;
+}
+
+int MXTPUSetInvokeBridge(MXTPUInvokeBridgeFn fn) {
+  g_bridge = fn;
+  return 0;
+}
+
+}  // extern "C"

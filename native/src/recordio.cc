@@ -186,12 +186,11 @@ class PrefetchReader {
 // ---------------------------------------------------------------------------
 extern "C" {
 
-static thread_local std::string g_last_error;
-
-const char* MXTPUGetLastError() { return g_last_error.c_str(); }
+// error string lives in c_api.cc (one thread-local for the whole ABI)
+void MXTPUSetLastError(const char* msg);
 
 static int fail(const char* msg) {
-  g_last_error = msg;
+  MXTPUSetLastError(msg);
   return -1;
 }
 
@@ -199,7 +198,7 @@ void* MXTPURecordWriterCreate(const char* path) {
   auto* w = new mxtpu::RecordWriter(path);
   if (!w->ok()) {
     delete w;
-    g_last_error = "cannot open file for writing";
+    MXTPUSetLastError("cannot open file for writing");
     return nullptr;
   }
   return w;
@@ -220,7 +219,7 @@ void* MXTPURecordReaderCreate(const char* path) {
   auto* r = new mxtpu::RecordReader(path);
   if (!r->ok()) {
     delete r;
-    g_last_error = "cannot open file for reading";
+    MXTPUSetLastError("cannot open file for reading");
     return nullptr;
   }
   return r;

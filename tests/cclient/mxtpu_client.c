@@ -1,0 +1,138 @@
+/* Pure-C smoke client for the MXTPU core ABI (no Python anywhere).
+ *
+ * The reference's promise was that any language could bind by wrapping the
+ * flat C API (include/mxnet/c_api.h); this client is the proof for the TPU
+ * rebuild: create NDArrays from bytes, run dot + softmax through
+ * MXTPUImperativeInvoke, read results back, exercise the error path.
+ *
+ * Usage: mxtpu_client <path/to/libmxtpu.so>; exit 0 iff all checks pass.
+ */
+#include <dlfcn.h>
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef void* H;
+typedef int (*create_fn)(const void*, const int64_t*, int, int, H*);
+typedef int (*free_fn)(H);
+typedef int (*shape_fn)(H, int*, const int64_t**);
+typedef int (*data_fn)(H, const void**);
+typedef int (*invoke_fn)(const char*, H*, int, const char*, H*, int*);
+typedef const char* (*err_fn)(void);
+
+#define CHECK(cond, msg)                                  \
+  do {                                                    \
+    if (!(cond)) {                                        \
+      fprintf(stderr, "FAIL: %s (%s)\n", msg, err());     \
+      return 1;                                           \
+    }                                                     \
+  } while (0)
+
+static err_fn err;
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <libmxtpu.so>\n", argv[0]);
+    return 2;
+  }
+  void* lib = dlopen(argv[1], RTLD_NOW | RTLD_LOCAL);
+  if (!lib) {
+    fprintf(stderr, "dlopen failed: %s\n", dlerror());
+    return 2;
+  }
+  create_fn create = (create_fn)dlsym(lib, "MXTPUNDArrayCreateFromBytes");
+  free_fn ndfree = (free_fn)dlsym(lib, "MXTPUNDArrayFree");
+  shape_fn get_shape = (shape_fn)dlsym(lib, "MXTPUNDArrayGetShape");
+  data_fn get_data = (data_fn)dlsym(lib, "MXTPUNDArrayGetData");
+  invoke_fn invoke = (invoke_fn)dlsym(lib, "MXTPUImperativeInvoke");
+  err = (err_fn)dlsym(lib, "MXTPUGetLastError");
+  if (!create || !ndfree || !get_shape || !get_data || !invoke || !err) {
+    fprintf(stderr, "missing ABI symbols\n");
+    return 2;
+  }
+
+  /* ---- dot: [2,3] @ [3,2] ------------------------------------------- */
+  float a_data[6] = {1, 2, 3, 4, 5, 6};
+  float b_data[6] = {1, 0, 0, 1, 1, 1};
+  int64_t a_shape[2] = {2, 3}, b_shape[2] = {3, 2};
+  H a, b;
+  CHECK(create(a_data, a_shape, 2, 0, &a) == 0, "create a");
+  CHECK(create(b_data, b_shape, 2, 0, &b) == 0, "create b");
+
+  H ins[2] = {a, b};
+  H outs[4];
+  int n_out = 4;
+  CHECK(invoke("dot", ins, 2, "{}", outs, &n_out) == 0, "invoke dot");
+  CHECK(n_out == 1, "dot emits one output");
+
+  int ndim;
+  const int64_t* oshape;
+  CHECK(get_shape(outs[0], &ndim, &oshape) == 0, "dot shape");
+  CHECK(ndim == 2 && oshape[0] == 2 && oshape[1] == 2, "dot shape [2,2]");
+  const void* raw;
+  CHECK(get_data(outs[0], &raw) == 0, "dot data");
+  const float* c = (const float*)raw;
+  /* [[1,2,3],[4,5,6]] @ [[1,0],[0,1],[1,1]] = [[4,5],[10,11]] */
+  float expect[4] = {4, 5, 10, 11};
+  for (int i = 0; i < 4; ++i)
+    CHECK(fabsf(c[i] - expect[i]) < 1e-5f, "dot values");
+  ndfree(outs[0]);
+
+  /* ---- dot with transpose_b: [2,3] @ [2,3]^T ------------------------- */
+  int64_t bt_shape[2] = {2, 3};
+  H bt;
+  CHECK(create(b_data, bt_shape, 2, 0, &bt) == 0, "create bt");
+  H ins_t[2] = {a, bt};
+  n_out = 4;
+  CHECK(invoke("dot", ins_t, 2, "{\"transpose_b\": true}", outs, &n_out) == 0,
+        "invoke dot transpose_b");
+  CHECK(get_data(outs[0], &raw) == 0, "dot_t data");
+  c = (const float*)raw;
+  /* b as [2,3] = [[1,0,0],[1,1,1]]; a @ b^T = [[1,6],[4,15]] */
+  float expect_t[4] = {1, 6, 4, 15};
+  for (int i = 0; i < 4; ++i)
+    CHECK(fabsf(c[i] - expect_t[i]) < 1e-5f, "dot_t values");
+  ndfree(outs[0]);
+  ndfree(bt);
+
+  /* ---- softmax over last axis ---------------------------------------- */
+  float s_data[4] = {0.0f, 1.0f, 2.0f, 3.0f};
+  int64_t s_shape[2] = {2, 2};
+  H s;
+  CHECK(create(s_data, s_shape, 2, 0, &s) == 0, "create s");
+  H sin[1] = {s};
+  n_out = 4;
+  CHECK(invoke("softmax", sin, 1, "{\"axis\": -1}", outs, &n_out) == 0,
+        "invoke softmax");
+  CHECK(get_data(outs[0], &raw) == 0, "softmax data");
+  c = (const float*)raw;
+  float e = expf(1.0f);
+  float p1 = 1.0f / (1.0f + e), p2 = e / (1.0f + e);
+  CHECK(fabsf(c[0] - p1) < 1e-5f && fabsf(c[1] - p2) < 1e-5f &&
+        fabsf(c[2] - p1) < 1e-5f && fabsf(c[3] - p2) < 1e-5f,
+        "softmax values");
+  /* rows sum to one */
+  CHECK(fabsf(c[0] + c[1] - 1.0f) < 1e-5f, "softmax row sum");
+  ndfree(outs[0]);
+
+  /* ---- error path: unknown op sets MXTPUGetLastError ------------------ */
+  n_out = 4;
+  CHECK(invoke("definitely_not_an_op", sin, 1, "{}", outs, &n_out) != 0,
+        "unknown op must fail");
+  CHECK(strlen(err()) > 0, "error string set");
+  CHECK(strstr(err(), "definitely_not_an_op") != NULL, "error names the op");
+
+  /* ---- error path: shape mismatch ------------------------------------ */
+  H bad_ins[2] = {a, s};
+  n_out = 4;
+  CHECK(invoke("dot", bad_ins, 2, "{}", outs, &n_out) != 0,
+        "dot shape mismatch must fail");
+
+  ndfree(a);
+  ndfree(b);
+  ndfree(s);
+  printf("mxtpu_client: all checks passed\n");
+  return 0;
+}

@@ -1,0 +1,96 @@
+"""Compile-time performance assertions over lowered/compiled HLO.
+
+Round-2 verdict ask #4: a perf harness that runs TODAY without TPU hardware.
+Instead of timing, assert the *structure* XLA produced:
+  (a) the dp train step's gradient all-reduces are combined into a small
+      constant number of collectives (not one per parameter);
+  (b) the O(L)-memory attention path materializes no [.., L, L] score
+      buffer, while the einsum path does (the memory contract of flash);
+  (c) buffer donation aliases param/opt-state inputs to outputs (no copy).
+"""
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, optimizer
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import MeshConfig, TrainStep, make_mesh
+
+
+def _build_mlp_step(mesh):
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(16, activation="relu"),
+            nn.Dense(8))
+    net.initialize()
+    x = nd.ones((8, 24))
+    _ = net(x)
+
+    def loss_fn(out, label):
+        return ((out - label) ** 2).mean()
+
+    ts = TrainStep(net, lambda out, *l: loss_fn(out, l[0]),
+                   optimizer.Adam(learning_rate=1e-3), mesh=mesh)
+    return ts, (x, nd.zeros((8, 8)))
+
+
+def test_dp_allreduce_combined():
+    """(a) 6 params' grads must not become 6 all-reduces: XLA's collective
+    combiner should leave a handful at most."""
+    mesh = make_mesh(MeshConfig(dp=8))
+    ts, args = _build_mlp_step(mesh)
+    compiled = ts.lower_hlo(*args).compile()
+    text = compiled.as_text()
+    n_ar = len(re.findall(r"all-reduce(?:-start)?\(", text))
+    n_params = 6  # 3 dense layers x (weight, bias)
+    assert n_ar >= 1, "dp step produced no all-reduce at all"
+    assert n_ar < n_params, (
+        f"{n_ar} all-reduces for {n_params} params — combiner not engaged")
+
+
+def test_chunked_attention_no_quadratic_buffer():
+    """(b) at L=2048 the chunked path's largest live tensor is [*, L, chunk];
+    the einsum path materializes the full [*, L, L] score matrix."""
+    from mxnet_tpu.ops import flash_attention as fa
+
+    L, D, chunk = 2048, 64, 256
+    q = jnp.zeros((1, 1, L, D), jnp.float32)
+
+    chunked = jax.jit(
+        lambda q: fa._chunked_attention(q, q, q, True, chunk=chunk)
+    ).lower(q).compile().as_text()
+    einsum = jax.jit(
+        lambda q: fa._ref_attention(q, q, q, True)
+    ).lower(q).compile().as_text()
+
+    quad = re.compile(rf"f32\[(?:1,1,)?{L},{L}\]")
+    assert not quad.search(chunked), "chunked path materialized an LxL buffer"
+    assert quad.search(einsum), "einsum oracle should have the LxL buffer"
+
+
+def test_donation_aliases_params():
+    """(c) donated params/opt-state show up as input_output_alias entries —
+    the no-copy update contract of the one-program train step."""
+    mesh = make_mesh(MeshConfig(dp=8))
+    ts, args = _build_mlp_step(mesh)
+    compiled = ts.lower_hlo(*args).compile()
+    text = compiled.as_text()
+    header = next((ln for ln in text.splitlines()
+                   if "input_output_alias" in ln), None)
+    assert header, "no input_output_alias in compiled HLO — donation lost"
+    n_alias = header.count("may-alias") + header.count("must-alias")
+    # params (6) + adam state (m, v per param = 12) = 18 donated buffers
+    assert n_alias >= 18, f"only {n_alias} aliased buffers, expected >= 18"
+
+
+def test_train_step_loss_decreases_under_dp():
+    """Sanity companion to the structural checks: the same compiled step
+    actually optimizes."""
+    mesh = make_mesh(MeshConfig(dp=8))
+    ts, args = _build_mlp_step(mesh)
+    losses = [float(np.asarray(jax.device_get(ts(*args)))) for _ in range(8)]
+    assert losses[-1] < losses[0]

@@ -1,0 +1,237 @@
+"""linalg op family (reference: src/operator/tensor/la_op.cc) and
+control-flow ops (reference: src/operator/control_flow.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _spd(n, batch=()):
+    rs = np.random.RandomState(0)
+    a = rs.randn(*batch, n, n).astype(np.float32)
+    return np.matmul(a, np.swapaxes(a, -1, -2)) + 3 * np.eye(n, dtype=np.float32)
+
+
+# --------------------------------------------------------------------------
+# linalg forward vs numpy oracle
+# --------------------------------------------------------------------------
+
+def test_gemm2_forward_and_flags():
+    rs = np.random.RandomState(1)
+    a = rs.randn(2, 3, 4).astype(np.float32)
+    b = rs.randn(2, 4, 5).astype(np.float32)
+    out = nd.linalg_gemm2(nd.array(a), nd.array(b), alpha=2.0)
+    np.testing.assert_allclose(out.asnumpy(), 2.0 * a @ b, rtol=1e-5)
+    outT = nd.linalg_gemm2(nd.array(a), nd.array(b.swapaxes(-1, -2)),
+                           transpose_b=True)
+    np.testing.assert_allclose(outT.asnumpy(), a @ b, rtol=1e-5)
+
+
+def test_gemm_forward():
+    rs = np.random.RandomState(2)
+    a = rs.randn(3, 4).astype(np.float32)
+    b = rs.randn(4, 5).astype(np.float32)
+    c = rs.randn(3, 5).astype(np.float32)
+    out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                         alpha=0.5, beta=2.0)
+    np.testing.assert_allclose(out.asnumpy(), 0.5 * a @ b + 2.0 * c,
+                               rtol=1e-5)
+
+
+def test_potrf_potri_sumlogdiag():
+    a = _spd(4)
+    L = nd.linalg_potrf(nd.array(a))
+    np.testing.assert_allclose(L.asnumpy() @ L.asnumpy().T, a, rtol=1e-4,
+                               atol=1e-4)
+    inv = nd.linalg_potri(L)
+    np.testing.assert_allclose(inv.asnumpy(), np.linalg.inv(a), rtol=1e-3,
+                               atol=1e-4)
+    sld = nd.linalg_sumlogdiag(L)
+    np.testing.assert_allclose(2 * float(sld.asnumpy()),
+                               np.linalg.slogdet(a)[1], rtol=1e-4)
+
+
+def test_trsm_trmm():
+    a = _spd(4)
+    L = np.linalg.cholesky(a).astype(np.float32)
+    b = np.random.RandomState(3).randn(4, 2).astype(np.float32)
+    x = nd.linalg_trsm(nd.array(L), nd.array(b))
+    np.testing.assert_allclose(L @ x.asnumpy(), b, rtol=1e-4, atol=1e-4)
+    y = nd.linalg_trmm(nd.array(L), nd.array(b))
+    np.testing.assert_allclose(y.asnumpy(), np.tril(L) @ b, rtol=1e-5)
+    # rightside
+    b2 = np.random.RandomState(4).randn(2, 4).astype(np.float32)
+    x2 = nd.linalg_trsm(nd.array(L), nd.array(b2), rightside=True)
+    np.testing.assert_allclose(x2.asnumpy() @ L, b2, rtol=1e-3, atol=1e-4)
+
+
+def test_syrk_det_inverse_slogdet():
+    rs = np.random.RandomState(5)
+    a = rs.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(nd.linalg_syrk(nd.array(a)).asnumpy(),
+                               a @ a.T, rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.linalg_syrk(nd.array(a), transpose=True).asnumpy(), a.T @ a,
+        rtol=1e-5)
+    s = _spd(3)
+    np.testing.assert_allclose(float(nd.linalg_det(nd.array(s)).asnumpy()),
+                               np.linalg.det(s), rtol=1e-3)
+    np.testing.assert_allclose(nd.linalg_inverse(nd.array(s)).asnumpy(),
+                               np.linalg.inv(s), rtol=1e-3, atol=1e-5)
+    sign, logdet = nd.linalg_slogdet(nd.array(s))
+    np_sign, np_logdet = np.linalg.slogdet(s)
+    assert float(sign.asnumpy()) == pytest.approx(np_sign)
+    assert float(logdet.asnumpy()) == pytest.approx(np_logdet, rel=1e-4)
+
+
+def test_gelqf():
+    rs = np.random.RandomState(6)
+    a = rs.randn(3, 5).astype(np.float32)
+    L, Q = nd.linalg_gelqf(nd.array(a))
+    np.testing.assert_allclose(L.asnumpy() @ Q.asnumpy(), a, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(Q.asnumpy() @ Q.asnumpy().T, np.eye(3),
+                               atol=1e-5)
+    # L is lower-triangular
+    assert abs(np.triu(L.asnumpy(), 1)).max() < 1e-5
+
+
+def test_diag_trian_roundtrip():
+    rs = np.random.RandomState(7)
+    a = rs.randn(4, 4).astype(np.float32)
+    d = nd.linalg_extractdiag(nd.array(a))
+    np.testing.assert_allclose(d.asnumpy(), np.diag(a))
+    md = nd.linalg_makediag(d)
+    np.testing.assert_allclose(md.asnumpy(), np.diag(np.diag(a)))
+    packed = nd.linalg_extracttrian(nd.array(a))
+    back = nd.linalg_maketrian(packed)
+    np.testing.assert_allclose(back.asnumpy(), np.tril(a), rtol=1e-6)
+
+
+def test_linalg_namespace():
+    a = np.eye(3, dtype=np.float32)
+    out = nd.linalg.gemm2(nd.array(a), nd.array(a))
+    np.testing.assert_allclose(out.asnumpy(), a)
+
+
+# --------------------------------------------------------------------------
+# linalg numeric gradients (the FGradient analog check)
+# --------------------------------------------------------------------------
+
+def test_gemm2_grad():
+    rs = np.random.RandomState(8)
+    a = rs.randn(3, 4).astype(np.float32)
+    b = rs.randn(4, 3).astype(np.float32)
+    check_numeric_gradient(lambda x, y: nd.linalg_gemm2(x, y), [a, b])
+
+
+def test_potrf_grad():
+    check_numeric_gradient(lambda x: nd.linalg_potrf(x).sum(), [_spd(3)],
+                           eps=1e-2, rtol=5e-2, atol=1e-3)
+
+
+def test_trsm_grad():
+    L = np.linalg.cholesky(_spd(3)).astype(np.float32)
+    b = np.random.RandomState(9).randn(3, 2).astype(np.float32)
+    check_numeric_gradient(lambda x: nd.linalg_trsm(nd.array(L), x), [b])
+
+
+def test_sumlogdiag_grad():
+    check_numeric_gradient(nd.linalg_sumlogdiag, [_spd(3)], eps=1e-2,
+                           rtol=5e-2, atol=1e-3)
+
+
+def test_det_grad():
+    check_numeric_gradient(nd.linalg_det, [_spd(3)], eps=1e-2, rtol=5e-2,
+                           atol=1e-2)
+
+
+# --------------------------------------------------------------------------
+# control flow
+# --------------------------------------------------------------------------
+
+def test_foreach_cumsum():
+    data = nd.array(np.arange(6, dtype=np.float32).reshape(6, 1))
+    init = nd.zeros((1,))
+    outs, final = nd.contrib.foreach(
+        lambda x, s: (x + s, x + s), data, init)
+    expect = np.cumsum(np.arange(6, dtype=np.float32)).reshape(6, 1)
+    np.testing.assert_allclose(outs.asnumpy(), expect)
+    np.testing.assert_allclose(final.asnumpy(), [15.0])
+
+
+def test_foreach_multi_state_and_output():
+    data = [nd.array(np.ones((4, 2), np.float32)),
+            nd.array(np.full((4, 2), 2.0, np.float32))]
+    init = [nd.zeros((2,)), nd.ones((2,))]
+
+    def body(xs, states):
+        a, b = xs
+        s1, s2 = states
+        return [a + s1, b * s2], [s1 + a, s2]
+
+    outs, finals = nd.contrib.foreach(body, data, init)
+    assert len(outs) == 2 and len(finals) == 2
+    np.testing.assert_allclose(finals[0].asnumpy(), [4.0, 4.0])
+    np.testing.assert_allclose(outs[1].asnumpy(), np.full((4, 2), 2.0))
+
+
+def test_foreach_grad():
+    """Tape differentiates through the scan (reference: foreach subgraph
+    backward)."""
+    import mxnet_tpu.autograd as ag
+
+    data = nd.array(np.arange(4, dtype=np.float32).reshape(4, 1))
+    w = nd.array([2.0])
+    w.attach_grad()
+    with ag.record():
+        outs, final = nd.contrib.foreach(
+            lambda x, s: (x * w, s + x * w), data, nd.zeros((1,)))
+        loss = final.sum()
+    loss.backward()
+    # final = w * sum(data); dloss/dw = sum(data) = 6
+    np.testing.assert_allclose(w.grad.asnumpy(), [6.0], rtol=1e-5)
+
+
+def test_while_loop():
+    # sum integers until total >= 10: 0+1+2+3+4 = 10 after 5 iters
+    def cond_fn(i, total):
+        return total < 10
+
+    def body_fn(i, total):
+        return i, (i + 1, total + i)
+
+    outs, finals = nd.contrib.while_loop(
+        cond_fn, body_fn, [nd.array([0.0]), nd.array([0.0])],
+        max_iterations=8)
+    i_fin, tot_fin = finals
+    np.testing.assert_allclose(tot_fin.asnumpy(), [10.0])
+    np.testing.assert_allclose(i_fin.asnumpy(), [5.0])
+    # rows past termination are zero-padded
+    np.testing.assert_allclose(outs.asnumpy().ravel(),
+                               [0, 1, 2, 3, 4, 0, 0, 0])
+
+
+def test_cond_eager_and_traced():
+    a, b = nd.array([1.0]), nd.array([2.0])
+    out = nd.contrib.cond(nd.array([1.0]), lambda: a + b, lambda: a - b)
+    np.testing.assert_allclose(out.asnumpy(), [3.0])
+    out = nd.contrib.cond(nd.array([0.0]), lambda: a + b, lambda: a - b)
+    np.testing.assert_allclose(out.asnumpy(), [-1.0])
+
+    # traced path: predicate is a tracer inside jit
+    import jax
+
+    def fn(p_raw, a_raw, b_raw):
+        an, bn = nd.NDArray(a_raw), nd.NDArray(b_raw)
+        out = nd.contrib.cond(nd.NDArray(p_raw), lambda: an + bn,
+                              lambda: an - bn)
+        return out._data
+
+    jfn = jax.jit(fn)
+    np.testing.assert_allclose(jfn(np.array([1.0]), np.array([1.0]),
+                                   np.array([2.0])), [3.0])
+    np.testing.assert_allclose(jfn(np.array([0.0]), np.array([1.0]),
+                                   np.array([2.0])), [-1.0])
