@@ -28,6 +28,7 @@ class MultiHeadAttention(HybridBlock):
     def __init__(self, units, num_heads, dropout=0.1, self_attn=True, **kwargs):
         super().__init__(**kwargs)
         self._heads = num_heads
+        self._units = units
         self._self = self_attn
         with self.name_scope():
             if self_attn:
@@ -43,18 +44,26 @@ class MultiHeadAttention(HybridBlock):
             self.drop = nn.Dropout(dropout)
 
     def hybrid_forward(self, F, x, mem=None, mask=None, causal=False):
-        b, t, c = x.shape
-        h = self._heads
+        # shape-agnostic (0/-1/-3 reshape codes + slice_axis): traces both
+        # under jit tracers AND as a Symbol graph (HybridBlock.export)
+        h, u = self._heads, self._units
         if self._self:
-            qkv = self.qkv(x).reshape((b, t, 3, h, c // h)).transpose((2, 0, 3, 1, 4))
-            q, k, v = qkv[0], qkv[1], qkv[2]
+            qkv = self.qkv(x)  # (b, t, 3u)
+            q = F.slice_axis(qkv, axis=-1, begin=0, end=u)
+            k = F.slice_axis(qkv, axis=-1, begin=u, end=2 * u)
+            v = F.slice_axis(qkv, axis=-1, begin=2 * u, end=3 * u)
         else:
-            tk = mem.shape[1]
-            q = self.q_proj(x).reshape((b, t, h, c // h)).transpose((0, 2, 1, 3))
-            kv = self.kv_proj(mem).reshape((b, tk, 2, h, c // h)).transpose((2, 0, 3, 1, 4))
-            k, v = kv[0], kv[1]
-        out = F.multi_head_attention(q, k, v, mask=mask, causal=causal)
-        out = out.transpose((0, 2, 1, 3)).reshape((b, t, c))
+            q = self.q_proj(x)
+            kv = self.kv_proj(mem)  # (b, tk, 2u)
+            k = F.slice_axis(kv, axis=-1, begin=0, end=u)
+            v = F.slice_axis(kv, axis=-1, begin=u, end=2 * u)
+
+        def heads(z):  # (b, t, u) -> (b, h, t, u//h)
+            return z.reshape((0, 0, h, -1)).transpose((0, 2, 1, 3))
+
+        out = F.multi_head_attention(heads(q), heads(k), heads(v), mask=mask,
+                                     causal=causal)
+        out = out.transpose((0, 2, 1, 3)).reshape((0, 0, -3))  # merge h,d
         return self.drop(self.proj(out))
 
 
@@ -131,8 +140,7 @@ class Transformer(HybridBlock):
                                      weight_initializer=init.Xavier())
 
     def _embed(self, F, embed, ids):
-        b, t = ids.shape
-        pos = F.arange(0, t, dtype="int32")
+        pos = F.arange_like(ids, axis=1, dtype="int32")
         scale = math.sqrt(self._units)
         return self.drop(embed(ids) * scale + self.pos_embed(pos))
 
@@ -140,10 +148,9 @@ class Transformer(HybridBlock):
         x = self._embed(F, self.src_embed, src_ids)
         mask = None
         if src_valid is not None:
-            b, t = src_ids.shape
-            steps = F.arange(0, t, dtype="int32")
-            mask = (steps.reshape((1, 1, 1, t)) <
-                    src_valid.astype("int32").reshape((b, 1, 1, 1)))
+            steps = F.arange_like(src_ids, axis=1, dtype="int32")
+            mask = (steps.reshape((1, 1, 1, -1)) <
+                    src_valid.astype("int32").reshape((-1, 1, 1, 1)))
         for layer in self.enc_layers:
             x = layer(x, mask)
         return x, mask

@@ -462,7 +462,19 @@ def Custom(*args, op_type=None, **kwargs):
 def array(source_array, ctx=None, dtype=None):
     if isinstance(source_array, NDArray):
         source_array = source_array._data
-    a = jnp.asarray(source_array, dtype_np(dtype) if dtype is not None else None)
+    np_dt = dtype_np(dtype) if dtype is not None else None
+    if not isinstance(source_array, (jax.Array, jax.core.Tracer)):
+        src_is_i64 = getattr(_np.asarray(source_array), "dtype", None) in (
+            _np.dtype(_np.int64), _np.dtype(_np.uint64))
+        if np_dt == _np.dtype(_np.int64) or (np_dt is None and src_is_i64):
+            # x64 stance (base.as_index_array): validated narrow, never
+            # jax's silent truncation — covers both explicit dtype="int64"
+            # and numpy's default int64 inference
+            from ..base import as_index_array
+
+            source_array = as_index_array(source_array, "nd.array int64")
+            np_dt = _np.dtype(_np.int32) if np_dt is not None else None
+    a = jnp.asarray(source_array, np_dt)
     if a.dtype == jnp.float64:
         a = a.astype(jnp.float32)  # MXNet default_dtype is f32
     return NDArray(a, ctx=ctx)

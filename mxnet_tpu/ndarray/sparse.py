@@ -42,7 +42,10 @@ class BaseSparseNDArray(NDArray):
 
     def __init__(self, data, aux, shape):
         NDArray.__init__(self, data)
-        self._aux = tuple(jnp.asarray(a) for a in aux)
+        from ..base import as_index_array
+
+        self._aux = tuple(jnp.asarray(as_index_array(a, "sparse aux index"))
+                          for a in aux)
         self._shape = tuple(int(s) for s in shape)
 
     @property
@@ -166,7 +169,10 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
         data, indices = arg1
         data = jnp.asarray(_raw(data) if isinstance(data, NDArray) else data,
                            dtype_np(dtype) if dtype else None)
-        indices = jnp.asarray(_raw(indices) if isinstance(indices, NDArray) else indices,
+        from ..base import as_index_array
+
+        raw_idx = _raw(indices) if isinstance(indices, NDArray) else indices
+        indices = jnp.asarray(as_index_array(raw_idx, "row_sparse indices"),
                               jnp.int32)
         if shape is None:
             shape = (int(indices.max()) + 1 if indices.size else 0,) + tuple(data.shape[1:])
@@ -182,8 +188,15 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, CSRNDArray):
         return arg1
     if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
-        data, indices, indptr = (jnp.asarray(_raw(a) if isinstance(a, NDArray) else a)
-                                 for a in arg1)
+        from ..base import as_index_array
+
+        def _csr_coerce(a, what):
+            raw = _raw(a) if isinstance(a, NDArray) else a
+            return jnp.asarray(as_index_array(raw, what) if what else raw)
+
+        data = _csr_coerce(arg1[0], None)
+        indices = _csr_coerce(arg1[1], "csr indices")
+        indptr = _csr_coerce(arg1[2], "csr indptr")
         data = data.astype(dtype_np(dtype)) if dtype else data
         if shape is None:
             raise MXNetError("csr_matrix from (data, indices, indptr) requires shape")

@@ -126,6 +126,12 @@ register("_rpower_scalar")(lambda x, scalar=1.0: jnp.power(scalar, x))
 register("_mod_scalar")(lambda x, scalar=1.0: jnp.mod(x, scalar))
 register("_maximum_scalar")(lambda x, scalar=0.0: jnp.maximum(x, scalar))
 register("_minimum_scalar")(lambda x, scalar=0.0: jnp.minimum(x, scalar))
+register("_equal_scalar")(lambda x, scalar=0.0: (x == scalar).astype(jnp.result_type(x)))
+register("_not_equal_scalar")(lambda x, scalar=0.0: (x != scalar).astype(jnp.result_type(x)))
+register("_greater_scalar")(lambda x, scalar=0.0: (x > scalar).astype(jnp.result_type(x)))
+register("_greater_equal_scalar")(lambda x, scalar=0.0: (x >= scalar).astype(jnp.result_type(x)))
+register("_lesser_scalar")(lambda x, scalar=0.0: (x < scalar).astype(jnp.result_type(x)))
+register("_lesser_equal_scalar")(lambda x, scalar=0.0: (x <= scalar).astype(jnp.result_type(x)))
 
 
 # --------------------------------------------------------------------------
@@ -312,6 +318,20 @@ def slice_op(x, begin, end, step=None):
     step = list(step or []) + [None] * (nd - len(step or []))
     idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
     return x[idx]
+
+
+@register("arange_like", aliases=("_contrib_arange_like",))
+def arange_like(data, start=0.0, step=1.0, axis=None, dtype="float32"):
+    """Range with length taken from ``data``'s (static) shape — the
+    shape-agnostic ``F.arange`` (reference: ``_contrib_arange_like``,
+    ``src/operator/contrib/``). Essential for symbol-traced models where
+    Python-level ``.shape`` is unavailable."""
+    from ..base import dtype_np
+
+    n = int(data.size if axis is None else data.shape[int(axis)])
+    # apply step/start before the cast: python-float step would otherwise
+    # weak-type-promote an int arange to f32
+    return (jnp.arange(n) * step + start).astype(dtype_np(dtype))
 
 
 @register("slice_axis")

@@ -51,13 +51,83 @@ def dump(finished=True, profile_process="worker"):
     return _state["dir"]
 
 
+def _aggregate_xplane(dump_dir):
+    """Parse the dumped XSpace protos into per-op stats.
+
+    Reference UX: ``src/profiler/aggregate_stats.cc`` ``dumps(reset)`` — a
+    table of (op name, count, total/avg/min/max ms). Here the events come
+    from jaxlib's native XPlane parser over the trace jax.profiler wrote; on
+    TPU the device plane rows are per-fused-computation (XLA's unit of
+    execution), which IS this framework's "op".
+    """
+    try:
+        from jax.profiler import ProfileData
+    except ImportError:  # pragma: no cover - very old jaxlib
+        return {}
+    import glob
+
+    stats = {}  # name -> [count, total_ns, min_ns, max_ns]
+    # only the LATEST run directory: the dump dir accumulates one
+    # timestamped subdir per profiling session, and aggregating across all
+    # of them would double-count earlier runs (and other processes sharing
+    # the default dir)
+    run_dirs = sorted(glob.glob(os.path.join(dump_dir, "plugins", "profile", "*")))
+    if not run_dirs:
+        return stats
+    paths = sorted(glob.glob(os.path.join(run_dirs[-1], "*.xplane.pb")))
+    for path in paths:
+        try:
+            data = ProfileData.from_file(path)
+        except Exception:
+            continue
+        for plane in data.planes:
+            pname = plane.name or ""
+            # keep device planes + the python/TraceMe host plane; skip
+            # bookkeeping planes (task environment, derived lines)
+            if not ("TPU" in pname or "GPU" in pname or "CPU" in pname
+                    or "Host" in pname or "python" in pname.lower()):
+                continue
+            for line in plane.lines:
+                for ev in line.events:
+                    name = ev.name
+                    dur = getattr(ev, "duration_ns", 0) or 0
+                    if not name or dur <= 0:
+                        continue
+                    # drop python-tracer stack frames ($file.py:42 fn) —
+                    # the reference table is per-op, not per-frame
+                    if name.startswith(("$", "<frozen")) or ".py:" in name:
+                        continue
+                    rec = stats.setdefault(name, [0, 0, float("inf"), 0])
+                    rec[0] += 1
+                    rec[1] += dur
+                    rec[2] = min(rec[2], dur)
+                    rec[3] = max(rec[3], dur)
+    return stats
+
+
 def dumps(reset=False):
-    """Aggregate per-op stat table. With XLA fusion, per-op means per-compiled
-    computation; detailed tables come from the xplane protos in the dump dir."""
-    lines = ["Profile Statistics (see TensorBoard / Perfetto for op-level "
-             f"detail; traces in {_state['dir']})"]
-    for name, (count, total) in sorted(_state["aggregate"].items()):
-        lines.append(f"{name}\t{count}\t{total * 1e3:.3f}ms")
+    """Aggregate per-op stat table (reference: ``AggregateStats::DumpTable``).
+
+    Combines the xplane-derived device/host op rows from the last dumped
+    trace with the Python-side ``scope()`` aggregates. Columns match the
+    reference: Name, Total Count, Time total/avg/min/max (ms).
+    """
+    header = f"{'Name':<48} {'Count':>8} {'Total(ms)':>12} {'Avg(ms)':>10} {'Min(ms)':>10} {'Max(ms)':>10}"
+    lines = ["Profile Statistics", header, "-" * len(header)]
+    rows = []
+    for name, (count, total_ns, mn, mx) in _aggregate_xplane(_state["dir"]).items():
+        rows.append((name, count, total_ns / 1e6, total_ns / 1e6 / count,
+                     mn / 1e6, mx / 1e6))
+    for name, (count, total) in _state["aggregate"].items():
+        t_ms = total * 1e3
+        rows.append((f"scope:{name}", count, t_ms, t_ms / count, t_ms / count,
+                     t_ms / count))
+    rows.sort(key=lambda r: -r[2])
+    for name, count, tot, avg, mn, mx in rows:
+        lines.append(f"{name[:48]:<48} {count:>8} {tot:>12.3f} {avg:>10.3f} "
+                     f"{mn:>10.3f} {mx:>10.3f}")
+    if reset:
+        _state["aggregate"] = {}
     return "\n".join(lines)
 
 

@@ -172,10 +172,16 @@ def load_ndarrays(fname: str) -> Union[Dict[str, "object"], List["object"]]:
     from .ndarray.sparse import CSRNDArray, RowSparseNDArray
 
     def _build(a):
+        from .base import as_index_array
+
         if isinstance(a, tuple):
             stype, data, auxes, shape = a
             cls = RowSparseNDArray if stype == "row_sparse" else CSRNDArray
             return cls(data, tuple(auxes), shape)
+        if a.dtype == np.int64:
+            # on-disk int64 payloads: validated narrow, never jax's silent
+            # truncation (base.as_index_array raises on overflow)
+            a = as_index_array(a, "loaded int64 tensor")
         return NDArray(a)
 
     nds = [_build(a) for a in arrays]

@@ -151,6 +151,24 @@ class Symbol:
     def __rtruediv__(self, o): return _apply("_rdiv_scalar", [self], {"scalar": o})
     def __pow__(self, o): return self._bin(o, "power", "_power_scalar")
     def __neg__(self): return _apply("negative", [self], {})
+    # comparisons return float 0/1 arrays like the reference (broadcast_* ops)
+    def __lt__(self, o): return self._bin(o, "lesser", "_lesser_scalar")
+    def __le__(self, o): return self._bin(o, "lesser_equal", "_lesser_equal_scalar")
+    def __gt__(self, o): return self._bin(o, "greater", "_greater_scalar")
+    def __ge__(self, o): return self._bin(o, "greater_equal", "_greater_equal_scalar")
+    def __eq__(self, o):
+        import numbers
+
+        if isinstance(o, Symbol) or isinstance(o, numbers.Number):
+            return self._bin(o, "equal", "_equal_scalar")
+        return NotImplemented
+    def __ne__(self, o):
+        import numbers
+
+        if isinstance(o, Symbol) or isinstance(o, numbers.Number):
+            return self._bin(o, "not_equal", "_not_equal_scalar")
+        return NotImplemented
+    __hash__ = object.__hash__  # __eq__ override must not break dict keys
 
     def reshape(self, *shape, **kw):
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
