@@ -190,25 +190,42 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
-    """Functional gradient API (``autograd.grad``). Returns grads as NDArrays."""
-    if create_graph:
-        raise NotImplementedError("create_graph=True (higher-order imperative grad) "
-                                  "is not supported; use hybridize + jax.grad composition")
+    """Functional gradient API (``autograd.grad``). Returns grads as NDArrays.
+
+    ``create_graph=True`` (higher-order grad — reference
+    ``Imperative::Backward`` with ``create_graph``): the gradient computation
+    itself is recorded on the tape as one differentiable op, so a second
+    ``grad``/``backward`` differentiates through it via jax's vjp-of-vjp.
+    """
     single = not isinstance(heads, (list, tuple))
     if single:
         heads = [heads]
     if not isinstance(variables, (list, tuple)):
         variables = [variables]
     replay = _build_replay(heads, list(variables))
-    leaf_vals = tuple(v._data for v in variables)
-    _, vjp_fn = jax.vjp(replay, leaf_vals)
-    if head_grads is None:
-        cts = tuple(jnp.ones_like(h._data) for h in heads)
-    else:
-        cts = tuple(g._data for g in head_grads)
-    (grads,) = vjp_fn(cts)
+    fixed_cts = None if head_grads is None else tuple(
+        g._data if hasattr(g, "_data") else jnp.asarray(g) for g in head_grads)
+
+    def grad_fn(*leaf_vals):
+        head_vals, vjp_fn = jax.vjp(replay, tuple(leaf_vals))
+        cts = fixed_cts if fixed_cts is not None else tuple(
+            jnp.ones_like(h) for h in head_vals)
+        (gs,) = vjp_fn(cts)
+        return tuple(gs)
+
     from . import ndarray as nd
 
+    if create_graph:
+        # route through the op-invoke tape: the returned NDArrays carry a
+        # tape entry whose pure fn is grad_fn, so they are differentiable
+        from .registry import OpDef
+
+        opdef = OpDef(name="grad", fn=grad_fn, nout=len(variables))
+        with _RecordScope(True, None):
+            res = nd.invoke(opdef, tuple(variables), {})
+        return list(res) if isinstance(res, tuple) else [res]
+
+    grads = grad_fn(*(v._data for v in variables))
     return [nd.NDArray(g) for g in grads]
 
 

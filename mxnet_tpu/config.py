@@ -36,6 +36,10 @@ _KNOBS: Dict[str, tuple] = {
     "flash_attention": (bool, True, ("MXNET_TPU_FLASH_ATTENTION",),
                         "use the Pallas flash kernel when shapes allow"),
     "default_dtype": (str, "float32", ("MXNET_DEFAULT_DTYPE",), "creation dtype"),
+    "storage_fallback_warn": (bool, True, ("MXNET_STORAGE_FALLBACK_WARN",),
+                              "warn when a sparse input densifies at an op "
+                              "boundary (reference: 'Storage type fallback' "
+                              "log in executor/infer_graph_attr_pass)"),
     "profiler_dir": (str, "/tmp/mxnet_tpu_profile", ("MXNET_PROFILER_DIR",),
                      "xplane trace output directory"),
     "num_cpu_workers": (int, 4, ("MXNET_CPU_WORKER_NTHREADS", "OMP_NUM_THREADS"),

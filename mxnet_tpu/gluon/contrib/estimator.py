@@ -12,7 +12,8 @@ from ..trainer import Trainer
 
 __all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
            "BatchBegin", "BatchEnd", "CheckpointHandler", "EarlyStoppingHandler",
-           "LoggingHandler"]
+           "LoggingHandler", "MetricHandler", "GradientUpdateHandler",
+           "ValidationHandler", "StoppingHandler"]
 
 
 class TrainBegin:
@@ -128,6 +129,92 @@ class EarlyStoppingHandler(EpochEnd):
                     self.stop_training = True
 
 
+class MetricHandler(EpochBegin, BatchEnd):
+    """Resets train metrics at epoch start and updates them per batch
+    (reference: ``event_handler.py MetricHandler`` — metric bookkeeping is a
+    handler, not a hard-coded loop step, so users can re-order/replace it)."""
+
+    def __init__(self, metrics=None, priority=-1000):
+        self.metrics = metrics
+        self.priority = priority  # after GradientUpdate (-2000), before user handlers (0)
+
+    def _metrics(self, estimator):
+        return self.metrics if self.metrics is not None else estimator.train_metrics
+
+    def epoch_begin(self, estimator, **kwargs):
+        for m in self._metrics(estimator):
+            m.reset()
+
+    def batch_end(self, estimator, label=None, pred=None, **kwargs):
+        if label is not None and pred is not None:
+            for m in self._metrics(estimator):
+                m.update(label, pred)
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Applies the optimizer step at batch end (reference:
+    ``GradientUpdateHandler`` — keeping the update a handler lets users
+    change its cadence, e.g. gradient accumulation)."""
+
+    def __init__(self, priority=-2000):
+        self.priority = priority
+
+    def batch_end(self, estimator, batch_size=1, **kwargs):
+        estimator.trainer.step(batch_size)
+
+
+class ValidationHandler(TrainBegin, EpochEnd, BatchEnd):
+    """Periodic validation (reference: ``ValidationHandler`` with
+    ``epoch_period``/``batch_period``). Runs AFTER the gradient update
+    (priority 0 > GradientUpdateHandler's -2000)."""
+
+    def __init__(self, val_data, epoch_period=1, batch_period=None,
+                 batches=None):
+        self.val_data = val_data
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.batches = batches
+        self._n_batches = 0
+
+    def train_begin(self, estimator, **kwargs):
+        self._n_batches = 0  # reusable across fit() calls
+
+    def batch_end(self, estimator, **kwargs):
+        self._n_batches += 1
+        if self.batch_period and self._n_batches % self.batch_period == 0:
+            estimator.evaluate(self.val_data, batches=self.batches)
+
+    def epoch_end(self, estimator, epoch=None, **kwargs):
+        if self.epoch_period and (epoch is None
+                                  or (epoch + 1) % self.epoch_period == 0):
+            estimator.evaluate(self.val_data, batches=self.batches)
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after ``max_epoch`` epochs or ``max_batch`` total batches
+    (reference: ``StoppingHandler``)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.stop_training = False
+        self._batches = 0
+
+    def train_begin(self, estimator, **kwargs):
+        self.stop_training = False  # reusable across fit() calls
+        self._batches = 0
+
+    def batch_end(self, estimator, **kwargs):
+        self._batches += 1
+        if self.max_batch is not None and self._batches >= self.max_batch:
+            self.stop_training = True
+
+    def epoch_end(self, estimator, epoch=None, **kwargs):
+        if self.max_epoch is not None and epoch is not None \
+                and epoch + 1 >= self.max_epoch:
+            self.stop_training = True
+
+
 class Estimator:
     def __init__(self, net, loss, train_metrics=None, trainer=None, context=None,
                  val_metrics=None):
@@ -162,13 +249,27 @@ class Estimator:
 
     def fit(self, train_data, val_data=None, epochs=1, event_handlers=None,
             batches=None):
-        handlers = event_handlers or [LoggingHandler()]
+        handlers = list(event_handlers or [LoggingHandler()])
+        # default handler composition (reference: fit() always prepends the
+        # metric + gradient-update handlers unless the caller supplied their
+        # own instances) — the train loop itself only fires events
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.insert(0, MetricHandler())
+        if not any(isinstance(h, GradientUpdateHandler) for h in handlers):
+            handlers.insert(0, GradientUpdateHandler())
+        # event dispatch order = priority then list order (reference:
+        # event_handler priorities — GradientUpdateHandler's -2000 puts the
+        # optimizer step before metric/validation handlers regardless of
+        # where the caller placed it in the list)
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+
+        def stop():
+            return any(getattr(h, "stop_training", False) for h in handlers)
+
         for h in handlers:
             if isinstance(h, TrainBegin):
                 h.train_begin(self)
         for epoch in range(epochs):
-            for m in self.train_metrics:
-                m.reset()
             for h in handlers:
                 if isinstance(h, EpochBegin):
                     h.epoch_begin(self, epoch=epoch)
@@ -182,18 +283,19 @@ class Estimator:
                     out = self.net(data)
                     loss = self.loss(out, label)
                 loss.backward()
-                self.trainer.step(data.shape[0])
-                for m in self.train_metrics:
-                    m.update(label, out)
                 for h in handlers:
                     if isinstance(h, BatchEnd):
-                        h.batch_end(self, batch=i)
-            if val_data is not None:
+                        h.batch_end(self, batch=i, label=label, pred=out,
+                                    loss=loss, batch_size=data.shape[0])
+                if stop():
+                    break
+            if val_data is not None and not any(
+                    isinstance(h, ValidationHandler) for h in handlers):
                 self.evaluate(val_data, batches=batches)
             for h in handlers:
                 if isinstance(h, EpochEnd):
                     h.epoch_end(self, epoch=epoch)
-            if any(getattr(h, "stop_training", False) for h in handlers):
+            if stop():
                 break
         for h in handlers:
             if isinstance(h, TrainEnd):

@@ -204,8 +204,14 @@ class ImageRecordIter(DataIter):
         from ..native import available, batch_to_chw_float
 
         if available():
+            # reuse_staging: the pooled host buffer backs the per-batch
+            # churn (reference: pinned-memory pool in iter_prefetcher.h);
+            # safe because jnp.asarray below copies to device before the
+            # next same-shape batch overwrites it
             batch = batch_to_chw_float(imgs, mean=self._mean, std=self._std,
-                                       nthreads=self._threads)
+                                       nthreads=self._threads,
+                                       reuse_staging=True,
+                                       staging_owner=id(self))
         else:  # pure-python fallback
             batch = ((imgs.astype(np.float32)
                       - np.asarray(self._mean, np.float32))
@@ -217,3 +223,6 @@ class ImageRecordIter(DataIter):
     def close(self):
         self._pool.shutdown(wait=False)
         self._file.close()
+        from ..native import release_staging
+
+        release_staging(id(self))

@@ -89,9 +89,18 @@ class KVStore:
             if self._updater is not None:
                 grad = NDArray(agg)
                 self._updater(k, grad, self._store[k])
+            elif self.type == "dist_async" and k in self._store:
+                # async semantics without an updater (reference:
+                # KVStoreDistServer::DataHandleDefault, sync_mode_ == false):
+                # each worker's push ACCUMULATES into the stored value as it
+                # arrives — there is no per-step barrier, so pushes add
+                # rather than replace. With an updater set, the updater call
+                # above owns the merge instead (reference parity).
+                self._store[k] = NDArray(self._store[k]._data + agg)
             else:
-                self._store[k] = NDArray(agg if k not in self._store or self.type != "dist_async"
-                                         else self._store[k]._data + agg)
+                # sync stores replace: the psum above already merged all
+                # workers for this step
+                self._store[k] = NDArray(agg)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         from .ndarray.sparse import BaseSparseNDArray

@@ -360,14 +360,20 @@ def image_crop(src, y0, x0, ch, cw):
 _STAGING: dict = {}
 
 
-def _staging_f32(shape):
-    """Reusable float32 staging buffer from the native pool, keyed by shape.
-    Safe to reuse because callers (batchify_images) immediately copy the
-    result to device; the pool backs the per-step churn the reference's
-    pinned-memory pool handled (src/storage/pooled_storage_manager.h)."""
+def _staging_f32(shape, owner=None):
+    """Reusable float32 staging buffer from the native pool, keyed by
+    (owner, shape). Safe to reuse because callers (batchify_images)
+    immediately copy the result to device; the pool backs the per-step churn
+    the reference's pinned-memory pool handled
+    (src/storage/pooled_storage_manager.h).
+
+    ``owner`` isolates concurrent producers: two iterators with the same
+    batch shape (e.g. train + val, each behind a PrefetchingIter thread)
+    must not share one buffer — pass a distinct token per iterator and call
+    :func:`release_staging` with it on close."""
     import numpy as np
 
-    key = tuple(shape)
+    key = (owner, tuple(shape))
     if key not in _STAGING:
         L = _require_lib()
         nbytes = int(np.prod(shape)) * 4
@@ -381,8 +387,17 @@ def _staging_f32(shape):
     return _STAGING[key]
 
 
+def release_staging(owner):
+    """Drop all staging buffers owned by ``owner`` back to the pool."""
+    L = lib()
+    for key in [k for k in _STAGING if k[0] == owner]:
+        buf = _STAGING.pop(key)
+        if L is not None:
+            L.MXTPUStorageFree(buf.ctypes.data_as(ctypes.c_void_p))
+
+
 def batch_to_chw_float(batch_hwc_u8, mean=None, std=None, nthreads=4,
-                       reuse_staging=False):
+                       reuse_staging=False, staging_owner=None):
     """(N,H,W,C) uint8 -> (N,C,H,W) float32 with per-channel (x-mean)/std,
     threaded in C++ — the host-side hot loop feeding device_put. Scalar
     mean/std broadcast; per-channel lists must have length C (the C kernel
@@ -407,7 +422,8 @@ def batch_to_chw_float(batch_hwc_u8, mean=None, std=None, nthreads=4,
 
     mean_v = _chanvec(mean, "mean")
     std_v = _chanvec(std, "std")
-    dst = _staging_f32((n, c, h, w)) if reuse_staging else np.empty((n, c, h, w), np.float32)
+    dst = _staging_f32((n, c, h, w), owner=staging_owner) if reuse_staging \
+        else np.empty((n, c, h, w), np.float32)
     f32p = ctypes.POINTER(ctypes.c_float)
     mean_p = mean_v.ctypes.data_as(f32p) if mean_v is not None else None
     std_inv = np.ascontiguousarray(1.0 / std_v) if std_v is not None else None

@@ -367,10 +367,29 @@ def _raw_index(key):
 # --------------------------------------------------------------------------
 # op invocation (the analog of MXImperativeInvokeEx)
 # --------------------------------------------------------------------------
+_DENSIFY_WARNED: set = set()
+
+
 def invoke(opdef, args, kwargs):
     # sparse inputs densify at the op boundary (logical-tensor semantics);
     # sparse-aware fast paths live in nd.sparse.{dot,add,retain} explicitly
-    args = tuple(a.todense() if hasattr(a, "_to_dense_raw") else a for a in args)
+    if any(hasattr(a, "_to_dense_raw") for a in args):
+        from .. import config as _config
+
+        if _config.get("storage_fallback_warn"):
+            import warnings
+
+            name = getattr(opdef, "name", "?")
+            if name not in _DENSIFY_WARNED:  # once per op, like the reference
+                _DENSIFY_WARNED.add(name)
+                warnings.warn(
+                    f"op {name!r}: sparse input densified at the op boundary "
+                    "(storage type fallback). Use nd.sparse.{dot,add,retain} "
+                    "for sparse-aware compute, or set "
+                    "MXNET_STORAGE_FALLBACK_WARN=0 to silence.",
+                    stacklevel=3)
+        args = tuple(a.todense() if hasattr(a, "_to_dense_raw") else a
+                     for a in args)
     arr_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
     raw_args = [_raw(a) for a in args]
     # NDArray kwargs (masks etc.) are unwrapped but not taped — gradients flow

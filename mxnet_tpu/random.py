@@ -6,6 +6,16 @@ design is functional: a process-global key is split on every draw in eager
 mode, and *inside a jit trace* draws split deterministically from a key that
 the staged computation receives as an argument (so compiled functions stay
 pure and every invocation can be fed fresh randomness).
+
+Resource-manager stance (reference ``src/resource.cc``, the other half of
+``ResourceRequest``): the reference hands ops two per-device resources —
+``kRandom`` (generator state) and ``kTempSpace`` (scratch workspace for
+reductions/cuDNN algo workspaces). On TPU, **kTempSpace is deliberately
+deleted**: XLA's buffer assignment allocates and reuses every intermediate/
+scratch buffer inside the compiled program, so there is nothing for the
+framework to pool or hand out — ops never see raw workspace. kRandom is
+THIS module. The host-side analog of pooled scratch (input-pipeline staging
+buffers) lives in the native StoragePool (``native/src/runtime.cc``).
 """
 from __future__ import annotations
 

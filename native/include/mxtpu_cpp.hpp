@@ -1,0 +1,133 @@
+// Header-only C++ user API over the flat MXTPU C ABI.
+//
+// Reference analog: cpp-package/include/mxnet-cpp/*.h — a convenience
+// wrapper that proves the "any language binds through the C API" contract.
+// Link (or dlopen) libmxtpu.so and write C++ against NDArray/Op below; when
+// the library is loaded inside a Python/jax runtime the same calls reach
+// the full operator registry through the invoke bridge.
+//
+// Error model: throws mxtpu::Error carrying MXTPUGetLastError().
+#ifndef MXTPU_CPP_HPP_
+#define MXTPU_CPP_HPP_
+
+#include <cstdint>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxtpu_c_api.h"
+
+namespace mxtpu {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& what) : std::runtime_error(what) {}
+};
+
+inline void check(int rc, const char* ctx) {
+  if (rc != 0)
+    throw Error(std::string(ctx) + ": " + MXTPUGetLastError());
+}
+
+// RAII NDArray handle (float32 host tensor).
+class NDArray {
+ public:
+  NDArray() = default;
+
+  NDArray(const std::vector<float>& data, const std::vector<int64_t>& shape) {
+    check(MXTPUNDArrayCreateFromBytes(data.data(), shape.data(),
+                                      static_cast<int>(shape.size()),
+                                      kMXTPUFloat32, &h_),
+          "NDArray create");
+  }
+
+  // adopt an existing handle (takes ownership)
+  explicit NDArray(MXTPUNDHandle h) : h_(h) {}
+
+  NDArray(NDArray&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  NDArray& operator=(NDArray&& o) noexcept {
+    if (this != &o) {
+      reset();
+      h_ = o.h_;
+      o.h_ = nullptr;
+    }
+    return *this;
+  }
+  NDArray(const NDArray&) = delete;
+  NDArray& operator=(const NDArray&) = delete;
+  ~NDArray() { reset(); }
+
+  MXTPUNDHandle handle() const { return h_; }
+
+  std::vector<int64_t> shape() const {
+    int ndim = 0;
+    const int64_t* s = nullptr;
+    check(MXTPUNDArrayGetShape(h_, &ndim, &s), "GetShape");
+    return std::vector<int64_t>(s, s + ndim);
+  }
+
+  int64_t size() const {
+    int64_t n = 0;
+    check(MXTPUNDArraySize(h_, &n), "Size");
+    return n;
+  }
+
+  std::vector<float> to_vector() const {
+    const void* raw = nullptr;
+    check(MXTPUNDArrayGetData(h_, &raw), "GetData");
+    const float* f = static_cast<const float*>(raw);
+    return std::vector<float>(f, f + size());
+  }
+
+ private:
+  void reset() {
+    if (h_ != nullptr) MXTPUNDArrayFree(h_);
+    h_ = nullptr;
+  }
+  MXTPUNDHandle h_ = nullptr;
+};
+
+// Invoke a named operator; returns its outputs.
+inline std::vector<NDArray> invoke(const std::string& op,
+                                   const std::vector<const NDArray*>& inputs,
+                                   const std::string& param_json = "{}") {
+  std::vector<MXTPUNDHandle> ins;
+  ins.reserve(inputs.size());
+  for (const NDArray* a : inputs) ins.push_back(a->handle());
+  MXTPUNDHandle outs[8];
+  int n_out = 8;
+  check(MXTPUImperativeInvoke(op.c_str(), ins.data(),
+                              static_cast<int>(ins.size()),
+                              param_json.c_str(), outs, &n_out),
+        ("invoke " + op).c_str());
+  std::vector<NDArray> result;
+  result.reserve(n_out);
+  for (int i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+  return result;
+}
+
+// convenience sugar for the common ops
+inline NDArray dot(const NDArray& a, const NDArray& b,
+                   bool transpose_a = false, bool transpose_b = false) {
+  std::string pj = std::string("{\"transpose_a\": ") +
+                   (transpose_a ? "true" : "false") + ", \"transpose_b\": " +
+                   (transpose_b ? "true" : "false") + "}";
+  return std::move(invoke("dot", {&a, &b}, pj)[0]);
+}
+
+inline NDArray softmax(const NDArray& x, int axis = -1) {
+  return std::move(
+      invoke("softmax", {&x}, "{\"axis\": " + std::to_string(axis) + "}")[0]);
+}
+
+inline NDArray add(const NDArray& a, const NDArray& b) {
+  return std::move(invoke("add", {&a, &b})[0]);
+}
+
+inline NDArray relu(const NDArray& x) {
+  return std::move(invoke("relu", {&x})[0]);
+}
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_HPP_

@@ -1,0 +1,55 @@
+// C++ user-API smoke client (header-only mxtpu_cpp.hpp over the C ABI).
+// Reference analog: cpp-package examples — proves a C++ program can train-
+// adjacent compute through the binding surface without Python.
+// Linked against libmxtpu.so (like the reference cpp-package links
+// libmxnet.so). Exit 0 iff all checks pass.
+#include <cmath>
+#include <cstdio>
+
+#include "../../native/include/mxtpu_cpp.hpp"
+
+int main() {
+  try {
+    // y = softmax(relu(A) @ B + C-ish chain)
+    mxtpu::NDArray a({1, -2, 3, -4, 5, -6}, {2, 3});
+    mxtpu::NDArray b({1, 0, 0, 1, 1, 1}, {3, 2});
+    auto r = mxtpu::relu(a);                         // [[1,0,3],[0,5,0]]
+    auto c = mxtpu::dot(r, b);                       // [[4,3],[0,5]]
+    auto shape = c.shape();
+    if (shape.size() != 2 || shape[0] != 2 || shape[1] != 2) {
+      std::fprintf(stderr, "bad dot shape\n");
+      return 1;
+    }
+    auto v = c.to_vector();
+    const float expect[4] = {4, 3, 0, 5};
+    for (int i = 0; i < 4; ++i)
+      if (std::fabs(v[i] - expect[i]) > 1e-5f) {
+        std::fprintf(stderr, "dot value mismatch at %d: %f\n", i, v[i]);
+        return 1;
+      }
+    auto s = mxtpu::softmax(c);
+    auto sv = s.to_vector();
+    if (std::fabs(sv[0] + sv[1] - 1.0f) > 1e-5f ||
+        std::fabs(sv[2] + sv[3] - 1.0f) > 1e-5f) {
+      std::fprintf(stderr, "softmax rows don't sum to 1\n");
+      return 1;
+    }
+    // error path: exception carries the C-side message
+    bool threw = false;
+    try {
+      mxtpu::invoke("not_a_real_op_zzz", {&a});
+    } catch (const mxtpu::Error& e) {
+      threw = std::string(e.what()).find("not_a_real_op_zzz") !=
+              std::string::npos;
+    }
+    if (!threw) {
+      std::fprintf(stderr, "error path failed\n");
+      return 1;
+    }
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "unexpected: %s\n", e.what());
+    return 1;
+  }
+  std::printf("mxtpu_cpp_client: all checks passed\n");
+  return 0;
+}

@@ -134,3 +134,39 @@ def test_mark_variables():
         y = (x * x).sum()
     y.backward()
     np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_grad_create_graph_second_order():
+    """Higher-order imperative grad (reference: Imperative::Backward with
+    create_graph): d2/dx2 x^3 = 6x."""
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        (gx,) = autograd.grad(y, x, create_graph=True)
+        # gx = 3x^2, still recorded
+        z = gx.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6 * np.array([1, 2, 3]),
+                               rtol=1e-5)
+
+
+def test_grad_create_graph_sin():
+    """d2/dx2 sin(x) = -sin(x) via grad-of-grad."""
+    x = nd.array([0.3, 1.1])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sin(x)
+        (gx,) = autograd.grad(y, x, create_graph=True)  # cos(x)
+        w = gx.sum()
+    w.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), -np.sin([0.3, 1.1]),
+                               rtol=1e-5)
+
+
+def test_grad_first_order_unchanged():
+    x = nd.array([2.0])
+    with autograd.record():
+        y = x * x
+    (g,) = autograd.grad(y, [x])
+    np.testing.assert_allclose(g.asnumpy(), [4.0])

@@ -158,3 +158,70 @@ def test_im2rec_roundtrip(tmp_path):
     assert len(rec.keys) == 3
     header, _ = unpack(rec.read_idx(1))
     assert header.label == 1.0
+
+
+def test_estimator_full_handler_taxonomy():
+    """Reference event_handler.py taxonomy: Metric/GradientUpdate/
+    Validation/Stopping handlers compose with the fit loop."""
+    import numpy as np
+
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.contrib.estimator import (Estimator,
+                                                   GradientUpdateHandler,
+                                                   MetricHandler,
+                                                   StoppingHandler,
+                                                   ValidationHandler)
+
+    net = nn.Dense(2)
+    net.initialize()
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    est = Estimator(net, loss, train_metrics="acc")
+
+    X = nd.array(np.random.RandomState(0).rand(64, 4).astype(np.float32))
+    Y = nd.array((np.random.RandomState(0).rand(64) > 0.5).astype(np.float32))
+    data = [(X[i * 8:(i + 1) * 8], Y[i * 8:(i + 1) * 8]) for i in range(8)]
+
+    val_runs = []
+    orig_eval = est.evaluate
+
+    def counting_eval(*a, **k):
+        val_runs.append(1)
+        return orig_eval(*a, **k)
+
+    est.evaluate = counting_eval
+    stopper = StoppingHandler(max_batch=11)
+    est.fit(data, epochs=10, event_handlers=[
+        MetricHandler(), GradientUpdateHandler(),
+        ValidationHandler(data, epoch_period=1), stopper])
+    # stopped after 11 batches => epoch 1 (batch 3 of epoch 2)
+    assert stopper._batches == 11 and stopper.stop_training
+    # validation ran once per completed epoch loop (2 epochs entered)
+    assert len(val_runs) == 2
+    # metric handler kept train metrics updated
+    name, acc = est.train_metrics[0].get()
+    assert 0.0 <= acc <= 1.0
+
+
+def test_estimator_stops_on_max_epoch():
+    import numpy as np
+
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.contrib.estimator import Estimator, StoppingHandler
+
+    net = nn.Dense(2)
+    net.initialize()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    X = nd.ones((8, 4)); Y = nd.zeros((8,))
+    epochs_seen = []
+
+    from mxnet_tpu.gluon.contrib.estimator import EpochEnd
+
+    class Spy(EpochEnd):
+        def epoch_end(self, estimator, epoch=None, **kwargs):
+            epochs_seen.append(epoch)
+
+    est.fit([(X, Y)], epochs=10,
+            event_handlers=[StoppingHandler(max_epoch=3), Spy()])
+    assert epochs_seen == [0, 1, 2]

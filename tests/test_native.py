@@ -278,3 +278,24 @@ def test_c_client_binary(tmp_path):
                        timeout=60)
     assert r.returncode == 0, f"stdout={r.stdout} stderr={r.stderr}"
     assert "all checks passed" in r.stdout
+
+
+def test_cpp_client_binary(tmp_path):
+    """Header-only C++ user API (mxtpu_cpp.hpp, the cpp-package analog)
+    compiles and drives relu->dot->softmax through the ABI."""
+    _skip_without_lib()
+    import subprocess
+
+    src = os.path.join(os.path.dirname(__file__), "cclient",
+                       "mxtpu_cpp_client.cc")
+    exe = str(tmp_path / "mxtpu_cpp_client")
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    lib_dir = os.path.dirname(native._lib_path())
+    subprocess.run([cxx, "-O2", "-std=c++17", "-o", exe, src,
+                    "-L" + lib_dir, "-lmxtpu", "-Wl,-rpath," + lib_dir],
+                   check=True, capture_output=True)
+    r = subprocess.run([exe], capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, f"stdout={r.stdout} stderr={r.stderr}"
+    assert "all checks passed" in r.stdout

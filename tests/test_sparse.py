@@ -275,3 +275,21 @@ def test_int64_params_roundtrip(tmp_path):
     save_ndarrays(f, {"big": np.array([2 ** 40], np.int64)})
     with pytest.raises(MXNetError, match="int32 range"):
         load_ndarrays(f)
+
+
+def test_storage_fallback_warns_once():
+    """Densify at an op boundary warns once per op (reference: 'Storage type
+    fallback' executor log), silenceable via MXNET_STORAGE_FALLBACK_WARN=0."""
+    import warnings
+
+    from mxnet_tpu.ndarray import _DENSIFY_WARNED
+
+    _DENSIFY_WARNED.discard("tanh")
+    rsp = sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([0, 2], np.int64)), shape=(4, 3))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        _ = nd.tanh(rsp)
+        _ = nd.tanh(rsp)  # second call: no new warning
+    fallback = [x for x in w if "storage type fallback" in str(x.message).lower()]
+    assert len(fallback) == 1
