@@ -200,9 +200,25 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     single = not isinstance(heads, (list, tuple))
     if single:
         heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
     if not isinstance(variables, (list, tuple)):
         variables = [variables]
-    replay = _build_replay(heads, list(variables))
+    n_vars = len(variables)
+    # Under create_graph, replay over variables PLUS every other
+    # attach_grad leaf reachable from heads: the second backward must reach
+    # those leaves too (reference Imperative::Backward propagates to all
+    # recorded inputs) — stop_gradient constants would silently zero their
+    # second-order gradients. Without create_graph, keep the cheap
+    # variables-only vjp (no wasted cotangents for large parameter sets).
+    if create_graph:
+        _, all_leaves = _collect(heads)
+        var_ids = {id(v) for v in variables}
+        extra_leaves = [l for l in all_leaves if id(l) not in var_ids]
+        leaves = list(variables) + extra_leaves
+    else:
+        leaves = list(variables)
+    replay = _build_replay(heads, leaves)
     fixed_cts = None if head_grads is None else tuple(
         g._data if hasattr(g, "_data") else jnp.asarray(g) for g in head_grads)
 
@@ -211,21 +227,22 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
         cts = fixed_cts if fixed_cts is not None else tuple(
             jnp.ones_like(h) for h in head_vals)
         (gs,) = vjp_fn(cts)
-        return tuple(gs)
+        return tuple(gs[:n_vars])
 
     from . import ndarray as nd
 
     if create_graph:
         # route through the op-invoke tape: the returned NDArrays carry a
-        # tape entry whose pure fn is grad_fn, so they are differentiable
+        # tape entry whose pure fn is grad_fn, so they are differentiable —
+        # w.r.t. the variables AND the other leaves (all are taped inputs)
         from .registry import OpDef
 
-        opdef = OpDef(name="grad", fn=grad_fn, nout=len(variables))
+        opdef = OpDef(name="grad", fn=grad_fn, nout=n_vars)
         with _RecordScope(True, None):
-            res = nd.invoke(opdef, tuple(variables), {})
+            res = nd.invoke(opdef, tuple(leaves), {})
         return list(res) if isinstance(res, tuple) else [res]
 
-    grads = grad_fn(*(v._data for v in variables))
+    grads = grad_fn(*(v._data for v in leaves))
     return [nd.NDArray(g) for g in grads]
 
 

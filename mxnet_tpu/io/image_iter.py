@@ -221,7 +221,13 @@ class ImageRecordIter(DataIter):
                          pad=pad, index=idx.copy())
 
     def close(self):
-        self._pool.shutdown(wait=False)
+        """Release decode pool + pooled staging buffers.
+
+        Must not be called while another thread is inside ``next()`` — the
+        staging buffer is freed back to the native pool here. When wrapped
+        in ``PrefetchingIter``, use ITS ``close()``, which joins the
+        prefetch thread before delegating."""
+        self._pool.shutdown(wait=True)
         self._file.close()
         from ..native import release_staging
 

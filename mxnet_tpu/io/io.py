@@ -208,19 +208,24 @@ class PrefetchingIter(DataIter):
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
 
-    def reset(self):
+    def _stop_and_join(self):
         self._stop.set()
-        while self._thread.is_alive():
+        while self._thread is not None and self._thread.is_alive():
             try:
                 self._queue.get_nowait()
             except Exception:
                 pass
             self._thread.join(timeout=0.01)
+
+    def reset(self):
+        self._stop_and_join()
         self._stop.clear()
         self.iters[0].reset()
         self._start()
 
     def next(self):
+        if getattr(self, "_closed", False):
+            raise StopIteration
         batch = self._queue.get()
         if batch is None:
             raise StopIteration
@@ -232,3 +237,15 @@ class PrefetchingIter(DataIter):
             return True
         except StopIteration:
             return False
+
+    def close(self):
+        """Stop the prefetch thread, then close the wrapped iterator.
+
+        Join-before-close matters: the wrapped iterator may own pooled
+        staging buffers (ImageRecordIter), and freeing them while the
+        prefetch thread is mid-next() would be a use-after-free."""
+        self._stop_and_join()
+        self._closed = True  # later next() raises StopIteration, never hangs
+        inner = self.iters[0]
+        if hasattr(inner, "close"):
+            inner.close()

@@ -28,9 +28,18 @@ def _wrap_out(out):
     return NDArray(out) if hasattr(out, "shape") else out
 
 
+def _unwrap_in(a):
+    if isinstance(a, NDArray):
+        return a._data
+    if isinstance(a, (list, tuple)):  # stack/concatenate/vstack take sequences
+        return type(a)(_unwrap_in(x) for x in a)
+    return a
+
+
 def _wrap1(fn):
     def f(*args, **kwargs):
-        args = [a._data if isinstance(a, NDArray) else a for a in args]
+        args = [_unwrap_in(a) for a in args]
+        kwargs = {k: _unwrap_in(v) for k, v in kwargs.items()}
         return _wrap_out(fn(*args, **kwargs))
 
     return f
@@ -43,8 +52,67 @@ for _name in ["add", "subtract", "multiply", "divide", "power", "exp", "log",
               "expand_dims", "squeeze", "where", "clip", "broadcast_to",
               "arange", "linspace", "zeros_like", "ones_like", "einsum",
               "tensordot", "cumsum", "sort", "argsort", "unique", "tile",
-              "repeat", "flip", "var", "std", "prod", "sign", "floor", "ceil"]:
-    setattr(np, _name, _wrap1(getattr(jnp, _name)))
+              "repeat", "flip", "var", "std", "prod", "sign", "floor", "ceil",
+              "log2", "log10", "log1p", "expm1", "floor_divide", "mod",
+              "square", "round", "trunc", "isnan", "isinf", "isfinite",
+              "logical_and", "logical_or", "logical_not", "logical_xor",
+              "equal", "not_equal", "greater", "greater_equal", "less",
+              "less_equal", "take", "diag", "eye", "tril", "triu", "outer",
+              "inner", "vdot", "kron", "meshgrid", "atleast_1d", "atleast_2d",
+              "ravel", "moveaxis", "swapaxes", "roll", "pad", "nan_to_num",
+              "nanmean", "nansum", "median", "percentile", "quantile",
+              "count_nonzero", "allclose", "array_equal", "sinh", "cosh",
+              "arcsin", "arccos", "arctan", "arctan2", "arcsinh", "arccosh",
+              "arctanh", "hypot", "exp2", "cbrt", "reciprocal", "positive",
+              "negative", "cumprod", "diff", "ediff1d", "trace", "vstack",
+              "hstack", "dstack", "column_stack", "array_split", "rot90",
+              "full_like", "empty_like", "triu_indices", "tril_indices",
+              "searchsorted", "interp", "cross", "histogram", "bincount",
+              "digitize", "average", "ptp", "gcd", "lcm"]:
+    if hasattr(jnp, _name):
+        setattr(np, _name, _wrap1(getattr(jnp, _name)))
+
+
+# np.random over the framework RNG (mx.random.seed drives it)
+def _np_random():
+    import types as _types
+
+    from . import random as _rng
+
+    r = _types.ModuleType("mxnet_tpu.np.random")
+
+    def _draw(op, *args, **kwargs):
+        from . import ndarray as _nd
+
+        size = kwargs.pop("shape", None)
+        if size is not None:
+            kwargs["shape"] = (size,) if isinstance(size, int) else tuple(size)
+        return getattr(_nd.random, op)(*args, **kwargs)
+
+    r.uniform = lambda low=0.0, high=1.0, size=None: _draw(
+        "uniform", low, high, shape=size if size is not None else ())
+    r.normal = lambda loc=0.0, scale=1.0, size=None: _draw(
+        "normal", loc, scale, shape=size if size is not None else ())
+    r.randint = lambda low, high=None, size=None, dtype="int32": _draw(
+        "randint", low if high is not None else 0,
+        high if high is not None else low,
+        shape=size if size is not None else (), dtype=dtype)
+    r.rand = lambda *shape: r.uniform(0.0, 1.0, size=shape or ())
+    r.randn = lambda *shape: r.normal(0.0, 1.0, size=shape or ())
+    r.seed = _rng.seed
+
+    def _shuffle(x):
+        # numpy contract: in-place, returns None
+        x._data = _draw("shuffle", x)._data
+        return None
+
+    r.shuffle = _shuffle
+    r.permutation = lambda x: _draw("shuffle", x)
+    return r
+
+
+np.random = _np_random()
+sys.modules["mxnet_tpu.np.random"] = np.random
 
 
 def _array(obj, dtype=None, ctx=None, device=None):

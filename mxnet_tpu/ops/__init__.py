@@ -12,3 +12,4 @@ from . import optimizer_ops  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import contrib_vision  # noqa: F401
 from . import linalg  # noqa: F401
+from . import extra  # noqa: F401

@@ -1,0 +1,254 @@
+"""Operator long-tail: sequence ops, extra activations, normalizations,
+spatial-transformer family, misc tensor ops.
+
+Reference homes: ``src/operator/sequence_last.cc`` / ``sequence_reverse.cc``,
+``src/operator/nn/lrn.cc``, ``src/operator/nn/group_norm.cc`` (1.6+),
+``src/operator/spatial_transformer.cc`` / ``bilinear_sampler.cc`` /
+``grid_generator.cc``, ``src/operator/tensor/ravel.cc``, ``matrix_op.cc``
+(split_v2), ``src/operator/contrib/krprod.cc`` (khatri_rao),
+``broadcast_reduce_op` (moments). Each is a jnp/lax composition; gradients
+come from jax autodiff.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register
+
+# --------------------------------------------------------------------------
+# activations (standalone op forms; Activation(act_type=...) covers some)
+# --------------------------------------------------------------------------
+register("hard_sigmoid")(
+    lambda data, alpha=0.2, beta=0.5: jnp.clip(alpha * data + beta, 0.0, 1.0))
+register("softmin")(
+    lambda data, axis=-1: jax.nn.softmax(-data, axis=int(axis)))
+register("relu6")(lambda data: jnp.clip(data, 0.0, 6.0))
+register("selu")(lambda data: jax.nn.selu(data))
+register("gelu")(lambda data: jax.nn.gelu(data, approximate=False))
+register("softrelu")(lambda data: jax.nn.softplus(data))
+register("log_sigmoid")(lambda data: jax.nn.log_sigmoid(data))
+register("logsumexp")(
+    lambda data, axis=None, keepdims=False: jax.scipy.special.logsumexp(
+        data, axis=None if axis is None else tuple(axis) if isinstance(axis, (list, tuple)) else int(axis),
+        keepdims=keepdims))
+
+
+# --------------------------------------------------------------------------
+# sequence ops (time-major by default, like SequenceMask)
+# --------------------------------------------------------------------------
+@register("SequenceLast", aliases=("sequence_last",))
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0):
+    """Last valid step of each sequence (reference: sequence_last.cc)."""
+    axis = int(axis)
+    if not use_sequence_length or sequence_length is None:
+        return lax.index_in_dim(data, data.shape[axis] - 1, axis,
+                                keepdims=False)
+    idx = (sequence_length.astype(jnp.int32) - 1)  # (B,)
+    dm = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return jnp.take_along_axis(
+        dm, idx.reshape((1, -1) + (1,) * (dm.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceReverse", aliases=("sequence_reverse",))
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0):
+    """Reverse each sequence along time, keeping padding in place
+    (reference: sequence_reverse.cc)."""
+    axis = int(axis)
+    dm = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    T = dm.shape[0]
+    steps = jnp.arange(T)
+    if not use_sequence_length or sequence_length is None:
+        out = dm[::-1]
+    else:
+        L = sequence_length.astype(jnp.int32)  # (B,)
+        # row t of sequence b reads from (L[b]-1-t) while t < L[b], else t
+        src = jnp.where(steps[:, None] < L[None, :],
+                        L[None, :] - 1 - steps[:, None], steps[:, None])
+        out = jnp.take_along_axis(
+            dm, src.reshape(src.shape + (1,) * (dm.ndim - 2)), axis=0)
+    return jnp.moveaxis(out, 0, axis)
+
+
+# --------------------------------------------------------------------------
+# normalizations
+# --------------------------------------------------------------------------
+@register("GroupNorm", aliases=("group_norm",))
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    """Group normalization over NCHW (reference: nn/group_norm.cc).
+
+    The reference op takes (num_groups,)-shaped gamma/beta (scale per
+    group); per-channel (C,) parameters — the PyTorch/GluonCV convention —
+    are accepted too and applied per channel.
+    """
+    n, c = data.shape[0], data.shape[1]
+    g = int(num_groups)
+    x = data.reshape((n, g, c // g) + data.shape[2:])
+    red = tuple(range(2, x.ndim))
+    mean = x.mean(axis=red, keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=red, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    if gamma.shape[0] == g and g != c:  # reference layout: per group
+        expand = (1, g, 1) + (1,) * (data.ndim - 2)
+        x = x * gamma.reshape(expand) + beta.reshape(expand)
+        return x.reshape(data.shape)
+    x = x.reshape(data.shape)
+    expand = (1, c) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(expand) + beta.reshape(expand)
+
+
+@register("LRN", aliases=("lrn",))
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Across-channel local response normalization over NCHW
+    (reference: nn/lrn.cc — the AlexNet-era op)."""
+    nsize = int(nsize)
+    sq = data * data
+    # windowed channel sum via padded cumulative trick (static shapes)
+    pad = nsize // 2
+    padded = jnp.pad(sq, [(0, 0), (pad, pad)] + [(0, 0)] * (data.ndim - 2))
+    acc = sum(
+        lax.slice_in_dim(padded, i, i + data.shape[1], axis=1)
+        for i in range(nsize))
+    return data / jnp.power(knorm + (alpha / nsize) * acc, beta)
+
+
+# --------------------------------------------------------------------------
+# spatial transformer family
+# --------------------------------------------------------------------------
+def _identity_grid(h, w):
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    return gx, gy  # each (h, w)
+
+
+@register("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Sampling grids (reference: grid_generator.cc).
+
+    affine: data (N, 6) affine params -> grid (N, 2, H, W), xy order.
+    warp:   data (N, 2, H, W) flow (pixels) -> identity grid + flow.
+    """
+    if transform_type == "affine":
+        h, w = int(target_shape[0]), int(target_shape[1])
+        gx, gy = _identity_grid(h, w)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], 0).reshape(3, h * w)  # (3, HW)
+        theta = data.reshape((-1, 2, 3)).astype(jnp.float32)
+        out = jnp.einsum("nij,jk->nik", theta, base)  # (N, 2, HW)
+        return out.reshape((-1, 2, h, w))
+    if transform_type == "warp":
+        n, _, h, w = data.shape
+        gx, gy = _identity_grid(h, w)
+        # pixel flow -> normalized coords
+        fx = data[:, 0] * (2.0 / max(w - 1, 1))
+        fy = data[:, 1] * (2.0 / max(h - 1, 1))
+        return jnp.stack([gx[None] + fx, gy[None] + fy], 1)
+    raise ValueError(f"GridGenerator: unknown transform_type {transform_type!r}")
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid):
+    """Sample NCHW ``data`` at normalized ``grid`` (N, 2, Ho, Wo), xy in
+    [-1, 1]; zero padding outside (reference: bilinear_sampler.cc)."""
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1.0) * (w - 1) / 2.0  # (N, Ho, Wo)
+    gy = (grid[:, 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(yy, xx):
+        inb = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        flat = data.reshape(n, c, h * w)
+        idx = (yc * w + xc).reshape(n, 1, -1)  # (N,1,HoWo)
+        vals = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (n, c, idx.shape[-1])), axis=2)
+        vals = vals.reshape((n, c) + yy.shape[1:])
+        return vals * inb[:, None].astype(data.dtype)
+
+    out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[:, None]
+           + gather(y0, x0 + 1) * (wx * (1 - wy))[:, None]
+           + gather(y0 + 1, x0) * ((1 - wx) * wy)[:, None]
+           + gather(y0 + 1, x0 + 1) * (wx * wy)[:, None])
+    return out.astype(data.dtype)
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear"):
+    """Affine spatial transformer network block = GridGenerator +
+    BilinearSampler (reference: spatial_transformer.cc)."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise ValueError("SpatialTransformer supports affine + bilinear")
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)
+
+
+# --------------------------------------------------------------------------
+# misc tensor ops
+# --------------------------------------------------------------------------
+@register("batch_take")
+def batch_take(a, indices):
+    """out[i] = a[i, indices[i]] (reference: indexing_op.cc batch_take)."""
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32).reshape(-1, 1), axis=1)[:, 0]
+
+
+@register("khatri_rao")
+def khatri_rao(*matrices):
+    """Column-wise Kronecker product (reference: contrib/krprod.cc)."""
+    out = matrices[0]
+    for m in matrices[1:]:
+        k = out.shape[1]
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, k)
+    return out
+
+
+@register("unravel_index", aliases=("_unravel_index",))
+def unravel_index(data, shape=None):
+    """Flat indices -> coordinate matrix (ndim, N) row-major
+    (reference: tensor/ravel.cc)."""
+    coords = jnp.unravel_index(data.astype(jnp.int32), tuple(int(s) for s in shape))
+    return jnp.stack(coords, 0)
+
+
+@register("ravel_multi_index", aliases=("_ravel_multi_index",))
+def ravel_multi_index(data, shape=None):
+    """Coordinate matrix (ndim, N) -> flat indices (reference: ravel.cc)."""
+    shape = tuple(int(s) for s in shape)
+    idx = jnp.zeros(data.shape[1:], jnp.int32)
+    stride = 1
+    for d in range(len(shape) - 1, -1, -1):
+        idx = idx + data[d].astype(jnp.int32) * stride
+        stride *= shape[d]
+    return idx
+
+
+@register("split_v2", aliases=("_split_v2",))
+def split_v2(data, indices_or_sections, axis=0, squeeze_axis=False):
+    """numpy-style split (reference: matrix_op.cc split_v2, 1.5+)."""
+    axis = int(axis)
+    if isinstance(indices_or_sections, (tuple, list)):
+        pieces = jnp.split(data, [int(i) for i in indices_or_sections], axis=axis)
+    else:
+        pieces = jnp.split(data, int(indices_or_sections), axis=axis)
+    if squeeze_axis:
+        pieces = [jnp.squeeze(p, axis=axis) for p in pieces]
+    return tuple(pieces)
+
+
+@register("moments", nout=2)
+def moments(data, axes=None, keepdims=False):
+    """(mean, variance) in one op (reference: nn/moments.cc)."""
+    ax = None if axes is None else tuple(int(a) for a in axes) \
+        if isinstance(axes, (tuple, list)) else (int(axes),)
+    mean = data.mean(axis=ax, keepdims=keepdims)
+    mk = data.mean(axis=ax, keepdims=True)
+    var = ((data - mk) ** 2).mean(axis=ax, keepdims=keepdims)
+    return mean, var

@@ -232,3 +232,20 @@ def test_imdecode_public_api():
     out = mimg.imdecode(enc.tobytes())
     assert out.shape == (16, 20, 3)
     assert abs(int(np.asarray(out._data).mean()) - 128) <= 2
+
+
+def test_prefetching_iter_close_then_next_raises(tmp_path):
+    """close() joins the prefetch thread; a later next() raises instead of
+    hanging on the drained queue."""
+    from mxnet_tpu.io.io import NDArrayIter, PrefetchingIter
+
+    import numpy as np
+
+    it = NDArrayIter(np.ones((16, 2), np.float32),
+                     np.zeros((16,), np.float32), batch_size=4)
+    pf = PrefetchingIter(it)
+    b = pf.next()
+    assert b is not None
+    pf.close()
+    with pytest.raises(StopIteration):
+        pf.next()

@@ -1,0 +1,220 @@
+"""Operator long-tail (ops/extra.py): sequence ops, activations,
+GroupNorm/LRN, spatial transformer family, misc tensor ops — numpy oracles."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_hard_sigmoid_relu6_selu_gelu():
+    x = nd.array(np.linspace(-8, 8, 9, dtype=np.float32))
+    np.testing.assert_allclose(nd.hard_sigmoid(x).asnumpy(),
+                               np.clip(0.2 * x.asnumpy() + 0.5, 0, 1))
+    np.testing.assert_allclose(nd.relu6(x).asnumpy(),
+                               np.clip(x.asnumpy(), 0, 6))
+    # selu fixed points: selu(0)=0
+    assert abs(float(nd.selu(nd.zeros((1,))).asnumpy().item())) < 1e-7
+    # gelu(x) ~ x for large x, ~0 for very negative
+    g = nd.gelu(x).asnumpy()
+    assert g[-1] == pytest.approx(8.0, rel=1e-4) and abs(g[0]) < 1e-5
+
+
+def test_softmin_logsumexp():
+    x = np.random.RandomState(0).randn(3, 5).astype(np.float32)
+    sm = nd.softmin(nd.array(x), axis=-1).asnumpy()
+    e = np.exp(-x - (-x).max(-1, keepdims=True))
+    np.testing.assert_allclose(sm, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    lse = nd.logsumexp(nd.array(x), axis=1).asnumpy()
+    np.testing.assert_allclose(
+        lse, np.log(np.exp(x).sum(1)), rtol=1e-5)
+
+
+def test_sequence_last_and_reverse():
+    # (T=4, B=3) time-major
+    data = np.arange(12, dtype=np.float32).reshape(4, 3)
+    seq_len = np.array([2, 4, 1], np.float32)
+    last = nd.SequenceLast(nd.array(data), nd.array(seq_len),
+                           use_sequence_length=True)
+    np.testing.assert_allclose(last.asnumpy(), [data[1, 0], data[3, 1],
+                                                data[0, 2]])
+    # no length: plain last step
+    np.testing.assert_allclose(
+        nd.SequenceLast(nd.array(data)).asnumpy(), data[-1])
+
+    rev = nd.SequenceReverse(nd.array(data), nd.array(seq_len),
+                             use_sequence_length=True).asnumpy()
+    # column 0 (len 2): first two rows swapped, padding rows unchanged
+    np.testing.assert_allclose(rev[:, 0], [data[1, 0], data[0, 0],
+                                           data[2, 0], data[3, 0]])
+    # column 1 (len 4): fully reversed
+    np.testing.assert_allclose(rev[:, 1], data[::-1, 1])
+    # column 2 (len 1): unchanged
+    np.testing.assert_allclose(rev[:, 2], data[:, 2])
+
+
+def test_group_norm_matches_manual():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 6, 4, 4).astype(np.float32)
+    gamma = rs.rand(6).astype(np.float32)
+    beta = rs.rand(6).astype(np.float32)
+    out = nd.GroupNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       num_groups=3, eps=1e-5).asnumpy()
+    xr = x.reshape(2, 3, 2, 4, 4)
+    mean = xr.mean(axis=(2, 3, 4), keepdims=True)
+    var = xr.var(axis=(2, 3, 4), keepdims=True)
+    ref = ((xr - mean) / np.sqrt(var + 1e-5)).reshape(2, 6, 4, 4)
+    ref = ref * gamma.reshape(1, 6, 1, 1) + beta.reshape(1, 6, 1, 1)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_lrn_matches_manual():
+    rs = np.random.RandomState(2)
+    x = rs.rand(1, 5, 3, 3).astype(np.float32)
+    out = nd.LRN(nd.array(x), alpha=1e-2, beta=0.75, knorm=2.0,
+                 nsize=3).asnumpy()
+    ref = np.empty_like(x)
+    for c in range(5):
+        lo, hi = max(0, c - 1), min(5, c + 2)
+        acc = (x[:, lo:hi] ** 2).sum(axis=1)
+        ref[:, c] = x[:, c] / (2.0 + (1e-2 / 3) * acc) ** 0.75
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_grid_generator_and_bilinear_sampler_identity():
+    """Identity affine must reproduce the input exactly."""
+    rs = np.random.RandomState(3)
+    x = rs.rand(2, 3, 5, 7).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(5, 7))
+    assert grid.shape == (2, 2, 5, 7)
+    out = nd.BilinearSampler(nd.array(x), grid)
+    np.testing.assert_allclose(out.asnumpy(), x, rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_flip():
+    """theta = [-1,0,0, 0,1,0] flips x; check against numpy flip."""
+    rs = np.random.RandomState(4)
+    x = rs.rand(1, 1, 4, 6).astype(np.float32)
+    theta = np.array([[-1, 0, 0, 0, 1, 0]], np.float32)
+    out = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                                target_shape=(4, 6)).asnumpy()
+    np.testing.assert_allclose(out, x[:, :, :, ::-1], rtol=1e-4, atol=1e-5)
+
+
+def test_bilinear_sampler_outside_zero():
+    x = nd.ones((1, 1, 2, 2))
+    # grid entirely outside [-1,1] -> zeros
+    grid = nd.array(np.full((1, 2, 2, 2), 5.0, np.float32))
+    out = nd.BilinearSampler(x, grid)
+    np.testing.assert_allclose(out.asnumpy(), 0.0)
+
+
+def test_batch_take_khatri_rao():
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array([0, 2, 1, 0], dtype="int32")
+    np.testing.assert_allclose(nd.batch_take(a, idx).asnumpy(), [0, 5, 7, 9])
+
+    m1 = np.arange(6, dtype=np.float32).reshape(2, 3)
+    m2 = np.arange(9, dtype=np.float32).reshape(3, 3)
+    kr = nd.khatri_rao(nd.array(m1), nd.array(m2)).asnumpy()
+    ref = np.stack([np.kron(m1[:, k], m2[:, k]) for k in range(3)], 1)
+    np.testing.assert_allclose(kr, ref)
+
+
+def test_ravel_unravel_roundtrip():
+    shape = (3, 4, 5)
+    flat = nd.array([0, 17, 59, 23], dtype="int32")
+    coords = nd.unravel_index(flat, shape=shape)
+    assert coords.shape == (3, 4)
+    back = nd.ravel_multi_index(coords, shape=shape)
+    np.testing.assert_array_equal(back.asnumpy(), [0, 17, 59, 23])
+    ref = np.stack(np.unravel_index([0, 17, 59, 23], shape), 0)
+    np.testing.assert_array_equal(coords.asnumpy(), ref)
+
+
+def test_split_v2_sections_and_indices():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(6, 2))
+    parts = nd.split_v2(x, 3, axis=0)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    parts = nd.split_v2(x, (1, 4), axis=0)
+    assert [p.shape[0] for p in parts] == [1, 3, 2]
+
+
+def test_moments():
+    rs = np.random.RandomState(5)
+    x = rs.rand(3, 4).astype(np.float32)
+    mean, var = nd.moments(nd.array(x), axes=(1,))
+    np.testing.assert_allclose(mean.asnumpy(), x.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(var.asnumpy(), x.var(1), rtol=1e-4)
+
+
+def test_extra_ops_gradients():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    rs = np.random.RandomState(6)
+    check_numeric_gradient(lambda x: nd.gelu(x),
+                           [rs.randn(2, 3).astype(np.float32)])
+    check_numeric_gradient(lambda x: nd.logsumexp(x, axis=1),
+                           [rs.randn(2, 4).astype(np.float32)])
+    x = rs.rand(1, 1, 4, 4).astype(np.float32)
+    theta = np.array([[0.8, 0.1, 0.0, -0.1, 0.9, 0.05]], np.float32)
+    check_numeric_gradient(
+        lambda d: nd.SpatialTransformer(d, nd.array(theta),
+                                        target_shape=(4, 4)),
+        [x], eps=1e-3, rtol=5e-2, atol=5e-3)
+
+
+def test_mx_np_namespace_breadth():
+    """mx.np numpy-compatible surface (reference: python/mxnet/numpy)."""
+    from mxnet_tpu.numpy_api import np as mnp
+
+    a = mnp.array([[1.0, 2.0], [3.0, 4.0]])
+    assert isinstance(a, nd.NDArray)
+    np.testing.assert_allclose(mnp.log1p(a).asnumpy(), np.log1p(a.asnumpy()),
+                               rtol=1e-6)
+    np.testing.assert_allclose(mnp.trace(a).asnumpy().item(), 5.0)
+    np.testing.assert_allclose(mnp.kron(a, mnp.ones((1, 1))).asnumpy(),
+                               a.asnumpy())
+    v = mnp.vstack([a, a])
+    assert v.shape == (4, 2)
+    assert mnp.count_nonzero(a).asnumpy().item() == 4
+    np.testing.assert_allclose(
+        mnp.percentile(a, 50).asnumpy().item(), 2.5)
+    idx = mnp.searchsorted(mnp.array([1.0, 3.0, 5.0]), mnp.array([2.0]))
+    assert int(idx.asnumpy().item()) == 1
+
+
+def test_mx_np_random():
+    import mxnet_tpu as mx
+    from mxnet_tpu.numpy_api import np as mnp
+
+    mx.random.seed(5)
+    u = mnp.random.uniform(0, 1, size=(100,))
+    assert u.shape == (100,)
+    assert 0.0 <= float(u.asnumpy().min()) and float(u.asnumpy().max()) <= 1.0
+    n = mnp.random.randn(50)
+    assert n.shape == (50,)
+    r = mnp.random.randint(0, 10, size=(20,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+    # seeding reproduces
+    mx.random.seed(5)
+    u2 = mnp.random.uniform(0, 1, size=(100,))
+    np.testing.assert_allclose(u.asnumpy(), u2.asnumpy())
+
+
+def test_group_norm_reference_group_scale():
+    """Reference layout: gamma/beta shaped (num_groups,)."""
+    rs = np.random.RandomState(7)
+    x = rs.randn(2, 6, 3, 3).astype(np.float32)
+    gamma = np.array([2.0, 0.5, 1.0], np.float32)
+    beta = np.array([0.0, 1.0, -1.0], np.float32)
+    out = nd.GroupNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       num_groups=3).asnumpy()
+    xr = x.reshape(2, 3, 2, 3, 3)
+    norm = (xr - xr.mean(axis=(2, 3, 4), keepdims=True)) / np.sqrt(
+        xr.var(axis=(2, 3, 4), keepdims=True) + 1e-5)
+    ref = (norm * gamma.reshape(1, 3, 1, 1, 1)
+           + beta.reshape(1, 3, 1, 1, 1)).reshape(x.shape)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)

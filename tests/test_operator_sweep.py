@@ -97,7 +97,7 @@ def test_unary_vs_numpy(name, fn, domain, dtype):
     if dtype != "float32" and name in ("gamma", "gammaln", "erf", "arccosh",
                                        "arctanh", "tan"):
         pytest.skip("low-precision tolerance too loose to be meaningful")
-    x_nd, x = _mk((3, 4), dtype, domain, seed=hash(name) % 2 ** 31)
+    x_nd, x = _mk((3, 4), dtype, domain, seed=__import__('zlib').crc32(name.encode()) % 2 ** 31)
     # the op computes in its input dtype; the oracle in f32 on the ROUNDED
     # input (so bf16 quantization error does not count against the op)
     x_round = np.asarray(x_nd.asnumpy(), np.float32)
@@ -215,7 +215,7 @@ _GRAD_CASES = {
 @pytest.mark.parametrize("case", sorted(_GRAD_CASES), ids=sorted(_GRAD_CASES))
 def test_numeric_gradient(case):
     fn, shapes, domain = _GRAD_CASES[case]
-    rs = np.random.RandomState(abs(hash(case)) % 2 ** 31)
+    rs = np.random.RandomState(__import__('zlib').crc32(case.encode()) % 2 ** 31)
     inputs = [rs.uniform(*domain, size=s).astype(np.float32) for s in shapes]
     check_numeric_gradient(fn, inputs, eps=1e-3, rtol=2e-2, atol=2e-3)
 
