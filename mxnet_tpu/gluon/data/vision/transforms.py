@@ -99,3 +99,134 @@ class RandomFlipLeftRight(Block):
         if np.random.rand() < 0.5:
             return NDArray(jnp.flip(x._data, axis=-2))
         return x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        import numpy as np
+
+        if np.random.rand() < 0.5:
+            return NDArray(jnp.flip(x._data, axis=-3))
+        return x
+
+
+def _blend(a, b, alpha):
+    return a * alpha + b * (1.0 - alpha)
+
+
+def _gray(x):
+    # HWC float; shared BT.601 luma constants (single source: mx.image)
+    from ....image import GRAY_COEF
+
+    return (x * jnp.asarray(GRAY_COEF, x.dtype)).sum(axis=-1, keepdims=True)
+
+
+class RandomBrightness(Block):
+    """Scale pixel values by U(1-b, 1+b) (reference transforms)."""
+
+    def __init__(self, brightness, **kwargs):
+        super().__init__(**kwargs)
+        self._b = float(brightness)
+
+    def forward(self, x):
+        import numpy as np
+
+        alpha = 1.0 + np.random.uniform(-self._b, self._b)
+        return NDArray(x._data * alpha)
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast, **kwargs):
+        super().__init__(**kwargs)
+        self._c = float(contrast)
+
+    def forward(self, x):
+        import numpy as np
+
+        alpha = 1.0 + np.random.uniform(-self._c, self._c)
+        d = x._data.astype(jnp.float32)
+        mean = _gray(d).mean()
+        return NDArray(_blend(d, mean, alpha).astype(x._data.dtype))
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation, **kwargs):
+        super().__init__(**kwargs)
+        self._s = float(saturation)
+
+    def forward(self, x):
+        import numpy as np
+
+        alpha = 1.0 + np.random.uniform(-self._s, self._s)
+        d = x._data.astype(jnp.float32)
+        return NDArray(_blend(d, _gray(d), alpha).astype(x._data.dtype))
+
+
+class RandomHue(Block):
+    """Rotate hue by U(-h, h) via the YIQ approximation the reference's
+    image_aug uses."""
+
+    def __init__(self, hue, **kwargs):
+        super().__init__(**kwargs)
+        self._h = float(hue)
+
+    def forward(self, x):
+        import numpy as np
+
+        from ....image import hue_rotation_matrix
+
+        alpha = np.random.uniform(-self._h, self._h)
+        m = jnp.asarray(hue_rotation_matrix(alpha))
+        d = x._data.astype(jnp.float32)
+        return NDArray((d @ m.T).astype(x._data.dtype))
+
+
+class RandomColorJitter(Block):
+    """Brightness/contrast/saturation/hue jitter in one transform."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        import numpy as np
+
+        # reference semantics: sub-transforms applied in random order
+        for i in np.random.permutation(len(self._ts)):
+            x = self._ts[i](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (reference RandomLighting).
+
+    Constants stay plain Python at class level — jnp arrays here would
+    force backend init at import time (bad in DataLoader workers)."""
+
+    def __init__(self, alpha, **kwargs):
+        super().__init__(**kwargs)
+        self._a = float(alpha)
+
+    def forward(self, x):
+        import numpy as np
+
+        from ....image import PCA_EIGVAL, PCA_EIGVEC
+
+        a = np.random.normal(0, self._a, size=(3,)).astype(np.float32)
+        rgb = (np.asarray(PCA_EIGVEC, np.float32) * a
+               * np.asarray(PCA_EIGVAL, np.float32)).sum(axis=1)
+        return NDArray(x._data + jnp.asarray(rgb, x._data.dtype))
+
+
+__all__ += ["RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+            "RandomSaturation", "RandomHue", "RandomColorJitter",
+            "RandomLighting"]

@@ -121,6 +121,25 @@ def color_normalize(src, mean, std=None):
     return NDArray(out)
 
 
+# shared color-jitter constants (BT.601 luma, YIQ transform, AlexNet PCA) —
+# single source for both the legacy Augmenter path and gluon transforms
+GRAY_COEF = np.array([0.299, 0.587, 0.114], np.float32)
+TYIQ = np.array([[0.299, 0.587, 0.114],
+                 [0.596, -0.274, -0.321],
+                 [0.211, -0.523, 0.311]], np.float32)
+PCA_EIGVAL = [55.46, 4.794, 1.148]
+PCA_EIGVEC = [[-0.5675, 0.7192, 0.4009],
+              [-0.5808, -0.0045, -0.8140],
+              [-0.5836, -0.6948, 0.4203]]
+
+
+def hue_rotation_matrix(alpha):
+    """RGB-space hue rotation by alpha (fraction of pi) via YIQ."""
+    u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+    rot = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]], np.float32)
+    return np.linalg.inv(TYIQ) @ rot @ TYIQ
+
+
 class Augmenter:
     def __init__(self, **kwargs):
         self._kwargs = kwargs
@@ -176,6 +195,90 @@ class CastAug(Augmenter):
         return src.astype(self.typ)
 
 
+class BrightnessJitterAug(Augmenter):
+    """Scale values by U(1-b, 1+b) (reference: image.py BrightnessJitterAug)."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = float(brightness)
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.brightness, self.brightness)
+        return NDArray(_raw(src) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = float(contrast)
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.contrast, self.contrast)
+        d = _raw(src).astype(jnp.float32)
+        gray_mean = (d * jnp.asarray(GRAY_COEF)).sum(axis=-1).mean()
+        return NDArray(d * alpha + gray_mean * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = float(saturation)
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.saturation, self.saturation)
+        d = _raw(src).astype(jnp.float32)
+        gray = (d * jnp.asarray(GRAY_COEF)).sum(axis=-1, keepdims=True)
+        return NDArray(d * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = float(hue)
+
+    def __call__(self, src):
+        alpha = np.random.uniform(-self.hue, self.hue)
+        m = jnp.asarray(hue_rotation_matrix(alpha))
+        d = _raw(src).astype(jnp.float32)
+        return NDArray(d @ m.T)
+
+
+class ColorJitterAug(Augmenter):
+    """brightness+contrast+saturation composite (reference ColorJitterAug)."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0):
+        super().__init__()
+        self.augs = []
+        if brightness:
+            self.augs.append(BrightnessJitterAug(brightness))
+        if contrast:
+            self.augs.append(ContrastJitterAug(contrast))
+        if saturation:
+            self.augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        # reference semantics: RandomOrderAug shuffles sub-augmenters per call
+        order = np.random.permutation(len(self.augs))
+        for i in order:
+            src = self.augs[i](src)
+        return src
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting noise (reference LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__()
+        self.alphastd = float(alphastd)
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,)).astype(np.float32)
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return NDArray(_raw(src) + jnp.asarray(rgb))
+
+
 class ColorNormalizeAug(Augmenter):
     def __init__(self, mean, std):
         super().__init__()
@@ -186,7 +289,8 @@ class ColorNormalizeAug(Augmenter):
 
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
-                    mean=None, std=None, **kwargs):
+                    mean=None, std=None, brightness=0, contrast=0,
+                    saturation=0, hue=0, pca_noise=0, **kwargs):
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize))
@@ -195,6 +299,12 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise, PCA_EIGVAL, PCA_EIGVEC))
     if mean is not None:
         auglist.append(ColorNormalizeAug(mean, std if std is not None else 1.0))
     return auglist

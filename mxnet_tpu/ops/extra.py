@@ -252,3 +252,40 @@ def moments(data, axes=None, keepdims=False):
     mk = data.mean(axis=ax, keepdims=True)
     var = ((data - mk) ** 2).mean(axis=ax, keepdims=keepdims)
     return mean, var
+
+
+@register("Correlation")
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation layer (reference: src/operator/correlation.cc).
+
+    For every spatial position, the (mean) inner product between a patch of
+    ``data1`` and displaced patches of ``data2`` over a (2d+1)^2 displacement
+    grid. Expressed as a dense shift-and-reduce so XLA lowers it to fused
+    elementwise + reductions — no gather scatter, TPU-tileable.
+    """
+    if kernel_size != 1:
+        raise ValueError("Correlation: native tier implements kernel_size=1 "
+                         "(the FlowNet configuration)")
+    n, c, h, w = data1.shape
+    d = int(max_displacement)
+    p = int(pad_size)
+    s1 = int(stride1)
+    a = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    b = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+    hp, wp = h + 2 * p, w + 2 * p
+    # centers sampled every stride1 pixels (reference uses ceil)
+    out_h = -(-(hp - 2 * d) // s1)
+    out_w = -(-(wp - 2 * d) // s1)
+    lim_h, lim_w = d + (out_h - 1) * s1 + 1, d + (out_w - 1) * s1 + 1
+    a_c = lax.slice(a, (0, 0, d, d), (n, c, lim_h, lim_w), (1, 1, s1, s1))
+    rows = []
+    for dy in range(-d, d + 1, int(stride2)):
+        for dx in range(-d, d + 1, int(stride2)):
+            b_c = lax.slice(b, (0, 0, d + dy, d + dx),
+                            (n, c, dy + lim_h, dx + lim_w), (1, 1, s1, s1))
+            if is_multiply:
+                rows.append((a_c * b_c).mean(axis=1))
+            else:
+                rows.append(jnp.abs(a_c - b_c).mean(axis=1))
+    return jnp.stack(rows, axis=1)

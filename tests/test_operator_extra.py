@@ -218,3 +218,74 @@ def test_group_norm_reference_group_scale():
     ref = (norm * gamma.reshape(1, 3, 1, 1, 1)
            + beta.reshape(1, 3, 1, 1, 1)).reshape(x.shape)
     np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_matches_manual():
+    rs = np.random.RandomState(8)
+    a = rs.rand(1, 2, 5, 5).astype(np.float32)
+    b = rs.rand(1, 2, 5, 5).astype(np.float32)
+    out = nd.Correlation(nd.array(a), nd.array(b), max_displacement=1,
+                         pad_size=1).asnumpy()
+    assert out.shape == (1, 9, 5, 5)
+    ap = np.pad(a, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    bp = np.pad(b, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    k = 0
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            ref = (ap[:, :, 1:6, 1:6] * bp[:, :, 1 + dy:6 + dy, 1 + dx:6 + dx]
+                   ).mean(axis=1)
+            np.testing.assert_allclose(out[:, k], ref, rtol=1e-5, err_msg=str((dy, dx)))
+            k += 1
+
+
+def test_color_jitter_transforms():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    import mxnet_tpu as mx
+
+    img = nd.array(np.random.RandomState(9).rand(8, 8, 3).astype(np.float32))
+    for t in [T.RandomBrightness(0.3), T.RandomContrast(0.3),
+              T.RandomSaturation(0.3), T.RandomHue(0.1),
+              T.RandomColorJitter(0.2, 0.2, 0.2, 0.05),
+              T.RandomLighting(0.1), T.RandomFlipTopBottom()]:
+        out = t(img)
+        assert out.shape == img.shape
+        assert np.isfinite(out.asnumpy()).all(), type(t).__name__
+    # zero-strength hue == identity
+    np.random.seed(0)
+    out = T.RandomHue(0.0)(img)
+    np.testing.assert_allclose(out.asnumpy(), img.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_image_jitter_augmenters():
+    from mxnet_tpu import image as mx_image
+
+    img = nd.array(np.random.RandomState(10).rand(8, 8, 3).astype(np.float32))
+    augs = mx_image.CreateAugmenter((3, 8, 8), brightness=0.2, contrast=0.2,
+                                    saturation=0.2, hue=0.1, pca_noise=0.05)
+    out = img
+    for a in augs:
+        out = a(out)
+    assert out.shape == (8, 8, 3)
+    assert np.isfinite(out.asnumpy()).all()
+    names = [type(a).__name__ for a in augs]
+    assert "ColorJitterAug" in names and "HueJitterAug" in names \
+        and "LightingAug" in names
+
+
+def test_correlation_stride1():
+    """stride1 subsamples correlation centers (reference: ceil output dims,
+    strided centers)."""
+    rs = np.random.RandomState(11)
+    a = rs.rand(1, 1, 7, 7).astype(np.float32)
+    b = rs.rand(1, 1, 7, 7).astype(np.float32)
+    out = nd.Correlation(nd.array(a), nd.array(b), max_displacement=1,
+                         pad_size=1, stride1=2).asnumpy()
+    # hp=9, out = ceil((9-2)/2) = 4
+    assert out.shape == (1, 9, 4, 4), out.shape
+    ap = np.pad(a, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    bp = np.pad(b, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    # dy=dx=0 channel (index 4): strided centers 1,3,5,7
+    ref = (ap[:, :, 1:8:2, 1:8:2] * bp[:, :, 1:8:2, 1:8:2]).mean(axis=1)
+    np.testing.assert_allclose(out[:, 4], ref, rtol=1e-5)
