@@ -1,3 +1,5 @@
 """gluon.rnn (reference: ``python/mxnet/gluon/rnn/``)."""
 from .rnn_layer import RNN, LSTM, GRU  # noqa: F401
-from .rnn_cell import RNNCell, LSTMCell, GRUCell, SequentialRNNCell  # noqa: F401
+from .rnn_cell import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,  # noqa: F401
+                       ModifierCell, DropoutCell, ResidualCell, ZoneoutCell,
+                       BidirectionalCell)

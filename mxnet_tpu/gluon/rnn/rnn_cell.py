@@ -35,17 +35,39 @@ class _BaseCell(HybridBlock):
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC", merge_outputs=None,
                valid_length=None):
+        """Unroll over time. With ``valid_length`` (reference semantics):
+        outputs at padded positions are zeroed (SequenceMask) and the
+        returned states are the states AT each sequence's last valid step
+        (not after consuming padding)."""
         from ... import ndarray as nd
 
         axis = layout.find("T")
         states = begin_state or self.begin_state(inputs.shape[1 - axis if axis == 0 else 0])
         outputs = []
+        state_trace = [] if valid_length is not None else None
         for t in range(length):
             x_t = inputs.slice_axis(axis=axis, begin=t, end=t + 1).squeeze(axis=axis)
             out, states = self(x_t, states)
             outputs.append(out)
+            if state_trace is not None:
+                state_trace.append(states)
+        if valid_length is not None:
+            # states at the last VALID step of each sequence
+            states = [
+                nd.SequenceLast(nd.stack(*[st[i] for st in state_trace], axis=0),
+                                valid_length, use_sequence_length=True)
+                for i in range(len(states))
+            ]
+        merged = nd.stack(*outputs, axis=axis)
+        if valid_length is not None:
+            merged = nd.SequenceMask(merged, valid_length,
+                                     use_sequence_length=True, axis=axis)
         if merge_outputs or merge_outputs is None:
-            outputs = nd.stack(*outputs, axis=axis)
+            outputs = merged
+        else:
+            outputs = [merged.slice_axis(axis=axis, begin=t, end=t + 1)
+                       .squeeze(axis=axis) for t in range(length)] \
+                if valid_length is not None else outputs
         return outputs, states
 
 
@@ -113,3 +135,115 @@ class SequentialRNNCell(_BaseCell):
             x, ns = cell(x, s)
             next_states.append(ns)
         return x, next_states
+
+
+class ModifierCell(_BaseCell):
+    """Wraps a base cell, delegating state handling (reference:
+    ``rnn_cell.py ModifierCell`` — the base of Dropout/Zoneout/Residual)."""
+
+    def __init__(self, base_cell):
+        HybridBlock.__init__(self)
+        self.base_cell = base_cell  # attribute assignment registers the child
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+    def infer_shape(self, x, *args):
+        if hasattr(self.base_cell, "infer_shape"):
+            self.base_cell.infer_shape(x, *args)
+
+
+class DropoutCell(ModifierCell):
+    """Applies dropout on the OUTPUT of the wrapped cell per step."""
+
+    def __init__(self, base_cell, rate=0.5):
+        super().__init__(base_cell)
+        self._rate = float(rate)
+
+    def hybrid_forward(self, F, x, states):
+        from ... import autograd as _ag
+
+        out, ns = self.base_cell(x, states)
+        if self._rate:
+            out = F.Dropout(out, p=self._rate, training=_ag.is_training())
+        return out, ns
+
+
+class ResidualCell(ModifierCell):
+    """Adds the input to the wrapped cell's output (reference ResidualCell)."""
+
+    def hybrid_forward(self, F, x, states):
+        out, ns = self.base_cell(x, states)
+        return out + x, ns
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization: randomly keep previous states
+    (reference ZoneoutCell; Krueger et al. 2017)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self._zo = float(zoneout_outputs)
+        self._zs = float(zoneout_states)
+
+    def hybrid_forward(self, F, x, states):
+        out, ns = self.base_cell(x, states)
+        prev = states if isinstance(states, (list, tuple)) else [states]
+
+        from ... import autograd as _ag
+
+        def mix(new, old, rate):
+            if not rate or not _ag.is_training():
+                return new
+            # dropout of ones gives the keep/replace mask with the right
+            # scaling removed (mask is 0 or 1/(1-p); normalize back)
+            mask = F.Dropout(F.ones_like(new), p=rate,
+                             training=True) * (1.0 - rate)
+            return mask * new + (1 - mask) * old
+
+        out = mix(out, prev[0], self._zo)
+        ns = [mix(n, p, self._zs) for n, p in zip(ns, prev)]
+        return out, ns
+
+
+class BidirectionalCell(_BaseCell):
+    """Runs two cells over the sequence in opposite directions and concats
+    outputs (reference BidirectionalCell; unroll-only, like the reference)."""
+
+    def __init__(self, l_cell, r_cell):
+        HybridBlock.__init__(self)
+        self.l_cell, self.r_cell = l_cell, r_cell  # assignment registers
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return [self.l_cell.begin_state(batch_size, **kwargs),
+                self.r_cell.begin_state(batch_size, **kwargs)]
+
+    def __call__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "BidirectionalCell supports unroll() only (reference behavior)")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as nd
+
+        axis = layout.find("T")
+        bs = begin_state or self.begin_state(
+            inputs.shape[1 - axis if axis == 0 else 0])
+        l_out, l_states = self.l_cell.unroll(length, inputs, bs[0], layout,
+                                             merge_outputs=True,
+                                             valid_length=valid_length)
+        rev = nd.SequenceReverse(inputs, axis=axis) if valid_length is None \
+            else nd.SequenceReverse(inputs, valid_length,
+                                    use_sequence_length=True, axis=axis)
+        r_out, r_states = self.r_cell.unroll(length, rev, bs[1], layout,
+                                             merge_outputs=True,
+                                             valid_length=valid_length)
+        r_out = nd.SequenceReverse(r_out, axis=axis) if valid_length is None \
+            else nd.SequenceReverse(r_out, valid_length,
+                                    use_sequence_length=True, axis=axis)
+        out = nd.concat(l_out, r_out, dim=-1)
+        return out, [l_states, r_states]
+
+
+__all__ += ["ModifierCell", "DropoutCell", "ResidualCell", "ZoneoutCell",
+            "BidirectionalCell"]

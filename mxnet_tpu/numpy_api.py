@@ -153,5 +153,14 @@ npx.set_np = lambda shape=True, array=True: None  # numpy semantics are default
 npx.reset_np = lambda: None
 npx.is_np_array = lambda: True
 
+
+def _npx_getattr(name):
+    """Any registry op is reachable as npx.<name> (reference: the generated
+    ``mxnet.numpy_extension`` surface over the same op registry)."""
+    return getattr(nd, name)
+
+
+npx.__getattr__ = _npx_getattr
+
 sys.modules["mxnet_tpu.np"] = np
 sys.modules["mxnet_tpu.npx"] = npx

@@ -118,3 +118,120 @@ def test_bidirectional_begin_state_forwarded():
     outs, _ = bi.unroll(2, data, begin_state=states, merge_outputs=True)
     args = outs.list_arguments()
     assert "fw_h0" in args and "bw_h0" in args  # states are live graph inputs
+
+
+def test_rnn_modifier_cells():
+    """Dropout/Residual/Zoneout/Bidirectional cells (reference rnn_cell.py
+    modifier taxonomy)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon import rnn
+
+    mx.random.seed(0)
+    T, B, C, H = 5, 2, 4, 4
+
+    # residual: output = cell output + input (needs C == H)
+    base = rnn.RNNCell(H, input_size=C)
+    res = rnn.ResidualCell(base)
+    res.initialize()
+    x = nd.array(np.random.RandomState(0).rand(T, B, C).astype(np.float32))
+    out, states = res.unroll(T, x, layout="TNC")
+    assert out.shape == (T, B, H)
+    # residual really adds the input
+    base_out, _ = base.unroll(T, x, layout="TNC")
+    np.testing.assert_allclose(out.asnumpy(), (base_out + x).asnumpy(),
+                               rtol=1e-5)
+
+    # dropout cell: eval mode = identity wrt base
+    dc = rnn.DropoutCell(rnn.GRUCell(H, input_size=C), rate=0.5)
+    dc.initialize()
+    out_d, _ = dc.unroll(T, x, layout="TNC")
+    assert np.isfinite(out_d.asnumpy()).all()
+
+    # zoneout under record: finite + trainable
+    zc = rnn.ZoneoutCell(rnn.LSTMCell(H, input_size=C), 0.2, 0.2)
+    zc.initialize()
+    with autograd.record():
+        out_z, _ = zc.unroll(T, x, layout="TNC")
+        loss = (out_z ** 2).mean()
+    loss.backward()
+    assert np.isfinite(out_z.asnumpy()).all()
+
+    # bidirectional: concat doubles the feature dim; reversal is seq-aware
+    bi = rnn.BidirectionalCell(rnn.GRUCell(H, input_size=C),
+                               rnn.GRUCell(H, input_size=C))
+    bi.initialize()
+    out_b, st = bi.unroll(T, x, layout="TNC")
+    assert out_b.shape == (T, B, 2 * H)
+    assert np.isfinite(out_b.asnumpy()).all()
+
+
+def test_dropout_cell_actually_drops_in_training():
+    """DropoutCell must be stochastic under record() and identity in eval."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon import rnn
+
+    mx.random.seed(1)
+    cell = rnn.DropoutCell(rnn.RNNCell(8, input_size=4), rate=0.5)
+    cell.initialize()
+    x = nd.ones((2, 3, 4))  # T,N,C
+    with autograd.record():
+        o1, _ = cell.unroll(2, x, layout="TNC")
+        o2, _ = cell.unroll(2, x, layout="TNC")
+    # training: two draws differ (dropout active)
+    assert not np.allclose(o1.asnumpy(), o2.asnumpy())
+    # eval: deterministic, equals the base cell output
+    e1, _ = cell.unroll(2, x, layout="TNC")
+    e2, _ = cell.unroll(2, x, layout="TNC")
+    np.testing.assert_allclose(e1.asnumpy(), e2.asnumpy())
+
+
+def test_zoneout_cell_stochastic_in_training():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon import rnn
+
+    mx.random.seed(2)
+    cell = rnn.ZoneoutCell(rnn.GRUCell(8, input_size=4), 0.4, 0.4)
+    cell.initialize()
+    x = nd.ones((3, 2, 4))
+    with autograd.record():
+        o1, _ = cell.unroll(3, x, layout="TNC")
+        o2, _ = cell.unroll(3, x, layout="TNC")
+    assert not np.allclose(o1.asnumpy(), o2.asnumpy())
+    # eval: identity wrt base (no zoneout)
+    base_out, _ = cell.base_cell.unroll(3, x, layout="TNC")
+    eval_out, _ = cell.unroll(3, x, layout="TNC")
+    np.testing.assert_allclose(eval_out.asnumpy(), base_out.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_unroll_valid_length_masks_and_selects_states():
+    """valid_length: padded outputs zeroed; states taken at the last valid
+    step (reference unroll semantics)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import rnn
+
+    mx.random.seed(3)
+    T, B, C, H = 5, 2, 3, 4
+    cell = rnn.GRUCell(H, input_size=C)
+    cell.initialize()
+    x = nd.array(np.random.RandomState(0).rand(T, B, C).astype(np.float32))
+    vl = nd.array([2.0, 5.0])
+    out, states = cell.unroll(T, x, layout="TNC", valid_length=vl)
+    o = out.asnumpy()
+    # rows past valid_length are zero for batch 0
+    assert abs(o[2:, 0]).max() == 0.0
+    assert abs(o[:, 1]).min() >= 0.0  # batch 1 fully valid (no mask)
+    # state for batch 0 equals the output at its last valid step (GRU: h)
+    np.testing.assert_allclose(states[0].asnumpy()[0], o[1, 0], rtol=1e-6)
