@@ -235,18 +235,12 @@ def batch_dot(a, b, transpose_a=False, transpose_b=False):
 # --------------------------------------------------------------------------
 # shape manipulation (reference: matrix_op.cc)
 # --------------------------------------------------------------------------
-@register("reshape", aliases=("Reshape",))
-def reshape(x, shape=None, reverse=False):
-    shape = tuple(int(s) for s in shape)
-    if reverse:
-        # MXNet reverse=True resolves special values right-to-left; support the
-        # common -1 case by flipping, resolving, flipping back.
-        raise NotImplementedError("reshape(reverse=True) is not supported; use explicit shapes")
-    # MXNet special codes: 0 copy input dim, -1 infer, -2 copy rest, -3 merge two,
-    # -4 split (consumes following two entries). Implement 0/-1/-2/-3.
-    out, i = [], 0
-    in_shape = x.shape
-    si = 0
+def _resolve_reshape(shape, in_shape):
+    """Resolve MXNet reshape special codes against in_shape.
+
+    0 copy input dim, -1 infer, -2 copy rest, -3 merge two. Returns a list
+    that may contain one -1 for jnp to infer."""
+    out, i, si = [], 0, 0
     while i < len(shape):
         s = shape[i]
         if s == 0:
@@ -260,7 +254,19 @@ def reshape(x, shape=None, reverse=False):
         else:
             out.append(s); si += 1
         i += 1
-    return jnp.reshape(x, tuple(out))
+    return out
+
+
+@register("reshape", aliases=("Reshape",))
+def reshape(x, shape=None, reverse=False):
+    shape = tuple(int(s) for s in shape)
+    if reverse:
+        # reverse=True resolves the special codes right-to-left against the
+        # input shape (matrix_op-inl.h InferReshapeShape reversed walk) —
+        # only the SHAPE resolution flips; the data order never changes
+        out = _resolve_reshape(shape[::-1], x.shape[::-1])[::-1]
+        return jnp.reshape(x, tuple(out))
+    return jnp.reshape(x, tuple(_resolve_reshape(shape, x.shape)))
 
 
 register("reshape_like")(lambda x, y: jnp.reshape(x, y.shape))

@@ -22,23 +22,10 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-try:  # pallas TPU backend is absent on some CPU-only builds
-    from jax.experimental.pallas import tpu as pltpu
-
-    _HAS_PLTPU = True
-except Exception:  # pragma: no cover
-    pltpu = None
-    _HAS_PLTPU = False
-
-_LANES = 128
-
-
-def _on_tpu() -> bool:
-    try:
-        dev = jax.devices()[0]
-        return dev.platform in ("tpu", "axon") or "TPU" in getattr(dev, "device_kind", "")
-    except Exception:
-        return False
+from .pallas_common import HAS_PLTPU as _HAS_PLTPU
+from .pallas_common import LANES as _LANES
+from .pallas_common import on_tpu as _on_tpu
+from .pallas_common import pltpu
 
 
 _FLASH_MIN_SEQ = 4096  # below this XLA's fused einsum attention is faster on

@@ -251,6 +251,12 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-5, momentum=0.
 @register("LayerNorm", aliases=("layer_norm",))
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
     ax = int(axis)
+    from . import pallas_layernorm as _pln
+
+    if _pln.ln_kernel_supported(data, ax):
+        # fused single-pass VMEM kernel on TPU (see pallas_layernorm.py);
+        # the jnp composition below is the fallback XLA fuses itself
+        return _pln.layer_norm_fused(data, gamma, beta, eps)
     xf = data.astype(jnp.float32)
     mean = jnp.mean(xf, axis=ax, keepdims=True)
     var = jnp.var(xf, axis=ax, keepdims=True)

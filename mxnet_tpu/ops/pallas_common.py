@@ -1,0 +1,25 @@
+"""Shared backend detection + constants for the Pallas kernels
+(flash_attention.py, pallas_layernorm.py) — one copy so the kernel gates
+stay in lockstep."""
+from __future__ import annotations
+
+import jax
+
+try:  # pallas TPU backend is absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    HAS_PLTPU = False
+
+LANES = 128
+
+
+def on_tpu() -> bool:
+    try:
+        dev = jax.devices()[0]
+        return dev.platform in ("tpu", "axon") or "TPU" in getattr(
+            dev, "device_kind", "")
+    except Exception:
+        return False

@@ -289,3 +289,16 @@ def test_correlation_stride1():
     # dy=dx=0 channel (index 4): strided centers 1,3,5,7
     ref = (ap[:, :, 1:8:2, 1:8:2] * bp[:, :, 1:8:2, 1:8:2]).mean(axis=1)
     np.testing.assert_allclose(out[:, 4], ref, rtol=1e-5)
+
+
+def test_reshape_reverse():
+    """reverse=True resolves 0/-1 codes right-to-left (reference
+    matrix_op-inl.h: (-1, 0) on (2,3,4) keeps the LAST dim, infers front)."""
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    # forward: 0 copies dim0 -> (2, 12); reverse: 0 copies dim-1 -> (6, 4)
+    assert nd.reshape(x, shape=(0, -1)).shape == (2, 12)
+    assert nd.reshape(x, shape=(-1, 0), reverse=True).shape == (6, 4)
+    # data order preserved
+    np.testing.assert_array_equal(
+        nd.reshape(x, shape=(-1, 0), reverse=True).asnumpy().ravel(),
+        np.arange(24, dtype=np.float32))

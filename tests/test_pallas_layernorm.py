@@ -1,0 +1,91 @@
+"""Fused Pallas LayerNorm vs the jnp oracle (interpret mode on CPU)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu.ops import pallas_layernorm as pln
+
+
+def _oracle(x, g, b, eps=1e-5):
+    xf = np.asarray(x, np.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    return (xf - mean) / np.sqrt(var + eps) * np.asarray(g, np.float32) \
+        + np.asarray(b, np.float32)
+
+
+@pytest.mark.parametrize("shape", [(4, 128), (2, 3, 256), (512, 128)])
+def test_ln_kernel_matches_oracle(shape):
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(*shape), jnp.float32)
+    g = jnp.asarray(rs.rand(shape[-1]), jnp.float32)
+    b = jnp.asarray(rs.rand(shape[-1]), jnp.float32)
+    out = pln.layer_norm_fused(x, g, b, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), _oracle(x, g, b), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_ln_kernel_row_padding():
+    """Row counts that don't divide the block size go through the pad/slice
+    path and must still be exact."""
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(300, 128), jnp.float32)  # 300 % 256 != 0
+    g = jnp.ones((128,), jnp.float32)
+    b = jnp.zeros((128,), jnp.float32)
+    out = pln.layer_norm_fused(x, g, b, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), _oracle(x, g, b), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_ln_kernel_bf16():
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(8, 256), jnp.bfloat16)
+    g = jnp.ones((256,), jnp.bfloat16)
+    b = jnp.zeros((256,), jnp.bfloat16)
+    out = pln.layer_norm_fused(x, g, b, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               _oracle(np.asarray(x, np.float32), g, b),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_ln_custom_vjp_matches_jnp_grads():
+    """Analytic backward vs autodiff of the naive composition."""
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(6, 128), jnp.float32)
+    g = jnp.asarray(rs.rand(128) + 0.5, jnp.float32)
+    b = jnp.asarray(rs.rand(128), jnp.float32)
+
+    def fused(x, g, b):
+        return pln.layer_norm_fused(x, g, b, interpret=True).sum()
+
+    def naive(x, g, b):
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(-1, keepdims=True)
+        var = xf.var(-1, keepdims=True)
+        return ((xf - mean) / jnp.sqrt(var + 1e-5) * g + b).sum()
+
+    gx1, gg1, gb1 = jax.grad(fused, argnums=(0, 1, 2))(x, g, b)
+    gx2, gg2, gb2 = jax.grad(naive, argnums=(0, 1, 2))(x, g, b)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gg1), np.asarray(gg2), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gb1), np.asarray(gb2), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_ln_gate_on_cpu():
+    """On the CPU backend the registered LayerNorm op must NOT take the
+    kernel path (backend gate), and still be exact."""
+    from mxnet_tpu import nd
+
+    x = nd.array(np.random.RandomState(4).randn(4, 128).astype(np.float32))
+    g = nd.ones((128,))
+    b = nd.zeros((128,))
+    assert not pln.ln_kernel_supported(x._data)
+    out = nd.LayerNorm(x, g, b)
+    np.testing.assert_allclose(out.asnumpy(),
+                               _oracle(x.asnumpy(), g.asnumpy(), b.asnumpy()),
+                               rtol=2e-5, atol=2e-5)
