@@ -33,6 +33,10 @@ _KNOBS: Dict[str, tuple] = {
                                "kept for API compat"),
     "use_fusion": (bool, True, ("MXNET_USE_FUSION",),
                    "pointwise fusion — always on via XLA"),
+    "fused_layernorm": (bool, False, ("MXNET_TPU_FUSED_LAYERNORM",),
+                        "route LayerNorm through the Pallas kernel on TPU "
+                        "(off until hardware-validated; interpret-mode "
+                        "tested)"),
     "flash_attention": (bool, True, ("MXNET_TPU_FLASH_ATTENTION",),
                         "use the Pallas flash kernel when shapes allow"),
     "default_dtype": (str, "float32", ("MXNET_DEFAULT_DTYPE",), "creation dtype"),
