@@ -65,6 +65,40 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
     _block.bump_global_cache_epoch()
 
 
+# the op-class lists behind the policy (reference: amp/lists/symbol_fp16.py
+# FP16_FUNCS / FP16_FP32_FUNCS / FP32_FUNCS). On TPU the low-precision set
+# is exactly the MXU-bound ops; reductions/normalizations accumulate f32.
+_LP16_OPS = ["FullyConnected", "Convolution", "Deconvolution", "dot",
+             "batch_dot", "linalg_gemm", "linalg_gemm2",
+             "interleaved_matmul_selfatt_qk",
+             "interleaved_matmul_selfatt_valatt", "multi_head_attention"]
+_F32_OPS = ["softmax", "log_softmax", "SoftmaxOutput", "LayerNorm",
+            "BatchNorm", "RMSNorm", "InstanceNorm", "L2Normalization",
+            "norm", "sum", "mean", "exp", "log", "erf", "gammaln"]
+_WIDEST_OPS = ["add", "subtract", "multiply", "divide", "maximum", "minimum",
+               "concat", "where"]
+
+
+def list_lp16_ops(target_dtype="bfloat16"):
+    """Ops computed in the low-precision dtype under AMP (reference:
+    ``amp.list_fp16_ops``)."""
+    return list(_LP16_OPS)
+
+
+list_fp16_ops = list_lp16_ops
+
+
+def list_fp32_ops(target_dtype="bfloat16"):
+    """Ops pinned to f32 compute/accumulation under AMP."""
+    return list(_F32_OPS)
+
+
+def list_widest_type_cast_ops(target_dtype="bfloat16"):
+    """Ops that follow the widest input dtype (reference:
+    ``list_widest_type_cast``)."""
+    return list(_WIDEST_OPS)
+
+
 def _reset():
     """Disable AMP (test hook)."""
     _STATE.dtype = None

@@ -1,3 +1,4 @@
 """Vision datasets + transforms (reference: ``python/mxnet/gluon/data/vision/``)."""
-from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset  # noqa: F401
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,  # noqa: F401
+                       ImageRecordDataset, ImageFolderDataset)
 from . import transforms  # noqa: F401

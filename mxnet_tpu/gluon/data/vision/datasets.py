@@ -132,3 +132,51 @@ class ImageRecordDataset(Dataset):
 
     def __len__(self):
         return len(self._record.keys)
+
+
+class ImageFolderDataset(Dataset):
+    """A dataset of images in class-per-subdirectory layout (reference:
+    ``gluon/data/vision/datasets.py ImageFolderDataset``): ``root/cat/x.jpg``
+    -> label = index of sorted('cat', ...). JPEG decodes through the native
+    baseline decoder; ``.npy`` payloads load directly."""
+
+    def __init__(self, root, flag=1, transform=None):
+        import os as _os
+
+        self._root = _os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self.synsets = []
+        self.items = []
+        exts = (".jpg", ".jpeg", ".png", ".npy")
+        for folder in sorted(_os.listdir(self._root)):
+            path = _os.path.join(self._root, folder)
+            if not _os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(_os.listdir(path)):
+                if fname.lower().endswith(exts):
+                    self.items.append((_os.path.join(path, fname), label))
+        if not self.items:
+            raise ValueError(f"no images under {self._root} "
+                             f"(extensions: {exts})")
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from ....image import imdecode
+
+        path, label = self.items[idx]
+        with open(path, "rb") as f:
+            # imdecode sniffs magic bytes (JPEG / npy / PIL fallback) — no
+            # extension-based dispatch, so .NPY/.png route correctly — and
+            # honors flag=0 (grayscale)
+            data = imdecode(f.read(), flag=self._flag)
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+
+__all__ += ["ImageFolderDataset"]

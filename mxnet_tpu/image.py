@@ -43,6 +43,9 @@ def imdecode(buf, to_rgb=1, flag=1):
         img = np.asarray(PIL.Image.open(_io.BytesIO(buf)).convert("RGB"))
     if not to_rgb:
         img = img[:, :, ::-1]  # BGR like the reference's cv2 default
+    if flag == 0 and img.ndim == 3 and img.shape[-1] == 3:
+        # reference flag=0: grayscale decode (BT.601 luma, keepdims)
+        img = (img.astype(np.float32) @ GRAY_COEF)[..., None].astype(img.dtype)
     return array(img)
 
 

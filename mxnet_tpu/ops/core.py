@@ -208,6 +208,18 @@ def argsort(x, axis=-1, is_ascend=True, dtype="float32"):
 # --------------------------------------------------------------------------
 # matmul family (reference: dot.cc/batch_dot → cuBLAS; here → MXU dot_general)
 # --------------------------------------------------------------------------
+def _amp_pair(a, b):
+    """AMP policy for matmul-class ops: MXU compute in bf16/f16 with f32
+    accumulation (amp._LP16_OPS contract); identity when AMP is off or the
+    inputs aren't f32."""
+    from ..contrib.amp import compute_dtype
+
+    adt = compute_dtype()
+    if adt is not None and a.dtype == jnp.float32 and b.dtype == jnp.float32:
+        return a.astype(adt), b.astype(adt), jnp.float32
+    return a, b, None
+
+
 @register("dot")
 def dot(a, b, transpose_a=False, transpose_b=False):
     """MXNet dot: contracts last axis of a with first axis of b (after transposes)."""
@@ -215,9 +227,13 @@ def dot(a, b, transpose_a=False, transpose_b=False):
         a = jnp.moveaxis(a, 0, -1) if a.ndim > 1 else a
     if transpose_b:
         b = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+    a, b, acc = _amp_pair(a, b)
     if a.ndim == 1 and b.ndim == 1:
-        return jnp.dot(a, b)
-    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+        out = jnp.dot(a, b, preferred_element_type=acc) if acc else jnp.dot(a, b)
+    else:
+        out = jnp.tensordot(a, b, axes=([a.ndim - 1], [0]),
+                            preferred_element_type=acc) if acc else             jnp.tensordot(a, b, axes=([a.ndim - 1], [0]))
+    return out.astype(jnp.float32) if acc else out
 
 
 @register("batch_dot")
@@ -226,7 +242,9 @@ def batch_dot(a, b, transpose_a=False, transpose_b=False):
         a = jnp.swapaxes(a, -1, -2)
     if transpose_b:
         b = jnp.swapaxes(b, -1, -2)
-    return jnp.matmul(a, b)
+    a, b, acc = _amp_pair(a, b)
+    out = jnp.matmul(a, b, preferred_element_type=acc) if acc else jnp.matmul(a, b)
+    return out.astype(jnp.float32) if acc else out
 
 
 # linalg_gemm2 and the rest of the la_op family live in ops/linalg.py

@@ -23,17 +23,27 @@ def _t(x, transpose):
     return jnp.swapaxes(x, -1, -2) if transpose else x
 
 
+def _amp_matmul(a, b):
+    """AMP matmul (bf16/f16 MXU compute, f32 accumulate) — the amp._LP16_OPS
+    contract for the gemm family."""
+    from ..ops.core import _amp_pair
+
+    a, b, acc = _amp_pair(a, b)
+    out = jnp.matmul(a, b, preferred_element_type=acc) if acc else jnp.matmul(a, b)
+    return out.astype(jnp.float32) if acc else out
+
+
 @register("linalg_gemm", aliases=("_linalg_gemm",))
 def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
                 beta=1.0):
     """alpha * op(A) @ op(B) + beta * C (reference: la_op.cc gemm)."""
-    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b)) + beta * C
+    return alpha * _amp_matmul(_t(A, transpose_a), _t(B, transpose_b)) + beta * C
 
 
 @register("linalg_gemm2", aliases=("_linalg_gemm2",))
 def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
     """alpha * op(A) @ op(B) (reference: la_op.cc gemm2)."""
-    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b))
+    return alpha * _amp_matmul(_t(A, transpose_a), _t(B, transpose_b))
 
 
 @register("linalg_potrf", aliases=("_linalg_potrf",))

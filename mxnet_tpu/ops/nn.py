@@ -92,6 +92,11 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1
                   pad=(0, 0), adj=(0, 0), num_filter=None, num_group=1, no_bias=False):
     stride, pad = _pair(stride), _pair(pad)
     kh, kw = weight.shape[-2], weight.shape[-1]
+    orig_dtype = data.dtype
+    adt = _amp_compute_dtype()
+    if adt is not None and orig_dtype == jnp.float32:
+        # AMP: MXU compute in bf16/f16, f32 accumulate (amp._LP16_OPS)
+        data, weight = data.astype(adt), weight.astype(adt)
     # transposed conv = lhs-dilated conv with flipped kernel (IOHW)
     out = lax.conv_general_dilated(
         data, jnp.flip(weight, (-1, -2)).swapaxes(0, 1),
@@ -100,7 +105,10 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1
         lhs_dilation=stride,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=int(num_group),
+        preferred_element_type=jnp.float32
+        if data.dtype in (jnp.bfloat16, jnp.float16) else None,
     )
+    out = out.astype(orig_dtype)
     if bias is not None and not no_bias:
         out = out + bias.reshape(1, -1, 1, 1)
     return out

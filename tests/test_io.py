@@ -1,5 +1,7 @@
 """Data pipeline: DataLoader, NDArrayIter, RecordIO wire format
 (reference: tests/python/unittest/test_io.py)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -249,3 +251,69 @@ def test_prefetching_iter_close_then_next_raises(tmp_path):
     pf.close()
     with pytest.raises(StopIteration):
         pf.next()
+
+
+def test_image_folder_dataset(tmp_path):
+    """class-per-subdirectory layout -> (image, label) samples."""
+    import numpy as np
+
+    from mxnet_tpu.gluon.data.vision import ImageFolderDataset
+
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            np.save(str(d / f"img{i}.npy"),
+                    (np.random.rand(8, 8, 3) * 255).astype(np.uint8))
+    ds = ImageFolderDataset(str(tmp_path))
+    assert len(ds) == 6
+    assert ds.synsets == ["cat", "dog"]
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3) and label == 0
+    img, label = ds[5]
+    assert label == 1
+    # empty dir raises
+    import pytest as _pytest
+
+    empty = tmp_path / "empty_root"
+    empty.mkdir()
+    with _pytest.raises(ValueError, match="no images"):
+        ImageFolderDataset(str(empty))
+
+
+def test_opperf_runner(tmp_path):
+    """tools/opperf.py (reference benchmark/opperf analog) runs a subset and
+    emits the table + json."""
+    import json
+    import subprocess
+    import sys
+
+    json_path = str(tmp_path / "opperf.json")
+    out = subprocess.run(
+        [sys.executable, "tools/opperf.py", "--ops", "dot,softmax,LayerNorm",
+         "--reps", "3", "--json", json_path,
+         "--platform", "cpu"],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert out.returncode == 0, out.stderr[-400:]
+    assert "Operator" in out.stdout and "dot" in out.stdout
+    rows = json.load(open(json_path))
+    assert {r["op"] for r in rows} == {"dot", "softmax", "LayerNorm"}
+    assert all(r["p50_us"] > 0 for r in rows)
+
+
+def test_image_folder_dataset_grayscale_and_case(tmp_path):
+    import numpy as np
+
+    from mxnet_tpu.gluon.data.vision import ImageFolderDataset
+
+    d = tmp_path / "cls"
+    d.mkdir()
+    np.save(str(d / "UPPER.NPY"),
+            (np.random.rand(6, 6, 3) * 255).astype(np.uint8))
+    ds = ImageFolderDataset(str(tmp_path))
+    img, label = ds[0]  # uppercase .NPY routes via magic sniffing
+    assert img.shape == (6, 6, 3)
+    ds0 = ImageFolderDataset(str(tmp_path), flag=0)
+    gray, _ = ds0[0]
+    assert gray.shape == (6, 6, 1)

@@ -290,3 +290,42 @@ def test_convert_to_int8_end_to_end():
     assert len(scales) == 2
     out = net(x).asnumpy()
     assert np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9) < 0.1
+
+
+def test_amp_op_lists():
+    """The op-class lists behind the AMP policy (reference amp.list_fp16_ops
+    API surface)."""
+    from mxnet_tpu.contrib import amp
+
+    lp = amp.list_lp16_ops()
+    f32 = amp.list_fp32_ops()
+    widest = amp.list_widest_type_cast_ops()
+    assert "FullyConnected" in lp and "Convolution" in lp and "dot" in lp
+    assert "softmax" in f32 and "LayerNorm" in f32
+    assert "add" in widest
+    assert not set(lp) & set(f32), "an op cannot be in both lists"
+    # back-compat alias
+    assert amp.list_fp16_ops() == lp
+
+
+def test_amp_dot_family_runs_lp16():
+    """The matmul-class ops in list_lp16_ops really change compute dtype
+    under AMP (jaxpr-verified, like the FC test)."""
+    import jax
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.contrib import amp
+    from mxnet_tpu.registry import get as get_op
+
+    amp.init("bfloat16")
+    try:
+        for op in ("dot", "batch_dot", "linalg_gemm2"):
+            fn = get_op(op).fn
+            a = (np.random.rand(2, 8, 8).astype(np.float32) if op != "dot"
+                 else np.random.rand(8, 8).astype(np.float32))
+            jaxpr = str(jax.make_jaxpr(lambda x: fn(x, x))(a))
+            assert "bf16" in jaxpr, f"{op} not bf16 under AMP:\n{jaxpr[:400]}"
+            out = fn(a, a)
+            assert out.dtype == np.float32, f"{op} must give f32 out"
+    finally:
+        amp._reset()
