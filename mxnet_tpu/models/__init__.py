@@ -5,10 +5,15 @@
   #3 BERT base/large    -> models.bert       (GluonNLP scripts/bert shape)
   #4 Transformer WMT    -> models.transformer (GluonNLP machine_translation)
   #5 GPT-2 345M         -> models.gpt2
+
+Plus detection: models.ssd (example/ssd + GluonCV SSD shape, exercising the
+full contrib MultiBox family).
 """
 from . import bert  # noqa: F401
 from . import gpt2  # noqa: F401
+from . import ssd  # noqa: F401
 from . import transformer  # noqa: F401
 from .bert import BERTModel, BERTForPretrain, get_bert  # noqa: F401
 from .gpt2 import GPT2Model, get_gpt2  # noqa: F401
+from .ssd import SSD, get_ssd  # noqa: F401
 from .transformer import Transformer, get_transformer  # noqa: F401

@@ -322,9 +322,13 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
     cls_id = jnp.where(score > threshold, cls_id, -1.0)
     score = jnp.where(score > threshold, score, -1.0)
     rows = jnp.concatenate([cls_id[..., None], score[..., None], boxes], -1)
-    return box_nms(rows, overlap_thresh=nms_threshold, valid_thresh=0.0,
-                   topk=nms_topk, coord_start=2, score_index=1, id_index=0,
-                   force_suppress=force_suppress)
+    out = box_nms(rows, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                  topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                  force_suppress=force_suppress)
+    # reference convention (multibox_detection.cc): suppressed rows carry
+    # cls_id -1 too, not just score -1 — callers filter on column 0
+    cls_col = jnp.where(out[..., 1:2] < 0, -1.0, out[..., 0:1])
+    return jnp.concatenate([cls_col, out[..., 1:]], axis=-1)
 
 
 # --------------------------------------------------------------------------
@@ -352,3 +356,90 @@ def index_array(data, axes=None):
 @register("_contrib_getnnz")
 def getnnz(data, axis=None):
     return jnp.sum((data != 0).astype(jnp.int32), axis=axis)
+
+
+# --------------------------------------------------------------------------
+# MultiBoxTarget (reference: contrib/multibox_target.cc) — SSD training-side
+# anchor matching + offset encoding
+# --------------------------------------------------------------------------
+@register("_contrib_MultiBoxTarget", nout=3)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to ground-truth boxes and encode regression targets.
+
+    anchor (1, A, 4 corner), label (N, M, 5) rows [cls, xmin, ymin, xmax,
+    ymax] padded with cls=-1, cls_pred (N, num_classes, A) (used only for
+    hard negative mining when enabled). Returns:
+      loc_target (N, A*4), loc_mask (N, A*4), cls_target (N, A) where
+      cls_target = matched class + 1 (0 = background).
+
+    Matching (multibox_target.cc): each gt's best anchor is force-matched;
+    any anchor whose best-gt IoU exceeds overlap_threshold matches that gt.
+    Vectorized over anchors/gt with static shapes (no per-gt greedy loop —
+    ties broken by argmax like the reference's bipartite pass).
+    """
+    A = anchor.shape[-2]
+    anc = anchor.reshape(A, 4)
+    v = jnp.asarray(variances, jnp.float32)
+
+    def one(lab, cpred):
+        cls = lab[:, 0]                      # (M,)
+        boxes = lab[:, 1:5]                  # (M, 4)
+        valid = cls >= 0                     # padded rows: cls == -1
+        iou = _pairwise_iou(anc, boxes)      # (A, M), shared impl
+        iou = jnp.where(valid[None, :], iou, -1.0)
+
+        best_gt = jnp.argmax(iou, axis=1)            # (A,) anchor's best gt
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou > overlap_threshold
+        # force-match: each valid gt claims its best anchor. Invalid (pad)
+        # rows scatter to the out-of-range index A, which jax drops — they
+        # must not clobber a valid gt's entry at anchor 0.
+        best_anchor = jnp.argmax(iou, axis=0)        # (M,)
+        safe_anchor = jnp.where(valid, best_anchor, A)
+        forced = jnp.zeros((A,), bool)
+        forced = forced.at[safe_anchor].set(True, mode="drop")
+        forced_gt = jnp.full((A,), -1, jnp.int32)
+        forced_gt = forced_gt.at[safe_anchor].set(
+            jnp.arange(cls.shape[0], dtype=jnp.int32), mode="drop")
+        gt_idx = jnp.where(forced & (forced_gt >= 0), forced_gt,
+                           best_gt.astype(jnp.int32))
+        matched = matched | forced
+
+        mb = boxes[gt_idx]                           # (A, 4) matched gt box
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        aw = jnp.clip(anc[:, 2] - anc[:, 0], 1e-12)
+        ah = jnp.clip(anc[:, 3] - anc[:, 1], 1e-12)
+        gcx = (mb[:, 0] + mb[:, 2]) / 2
+        gcy = (mb[:, 1] + mb[:, 3]) / 2
+        gw = jnp.clip(mb[:, 2] - mb[:, 0], 1e-12)
+        gh = jnp.clip(mb[:, 3] - mb[:, 1], 1e-12)
+        loc_t = jnp.stack([(gcx - acx) / aw / v[0], (gcy - acy) / ah / v[1],
+                           jnp.log(gw / aw) / v[2], jnp.log(gh / ah) / v[3]],
+                          axis=-1)                   # (A, 4)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0)
+        loc_m = jnp.broadcast_to(matched[:, None], loc_t.shape).astype(jnp.float32)
+        cls_t = jnp.where(matched, cls[gt_idx] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negative mining: keep the top-k background anchors by
+            # background-class loss proxy (1 - P(bg)); rest -> ignore_label.
+            # negative_mining_thresh (reference default 0.5): only anchors
+            # whose proxy exceeds it are eligible for mining at all.
+            bg_conf = cpred[0]                       # (A,) background prob
+            proxy = 1.0 - bg_conf
+            eligible = (~matched) & (proxy > negative_mining_thresh)
+            neg_score = jnp.where(eligible, proxy, -jnp.inf)
+            k = jnp.maximum(
+                (matched.sum() * negative_mining_ratio).astype(jnp.int32),
+                int(minimum_negative_samples))
+            order = jnp.argsort(-neg_score)
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A, dtype=jnp.int32))
+            keep_neg = eligible & (rank < k)
+            cls_t = jnp.where(matched | keep_neg, cls_t, float(ignore_label))
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_target, loc_mask, cls_target = jax.vmap(one)(label, cls_pred)
+    return loc_target, loc_mask, cls_target

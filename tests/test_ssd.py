@@ -1,0 +1,134 @@
+"""SSD detection model: the full contrib detection family end-to-end
+(MultiBoxPrior -> MultiBoxTarget -> loss -> MultiBoxDetection)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models.ssd import get_ssd, ssd_loss, ssd_train_targets
+
+
+def _toy_batch(n=8, size=32, seed=0):
+    """Images with one bright square; label = its box, class 0."""
+    rs = np.random.RandomState(seed)
+    imgs = np.zeros((n, 3, size, size), np.float32)
+    labels = np.full((n, 1, 5), -1.0, np.float32)
+    for i in range(n):
+        s = rs.randint(8, 16)
+        y = rs.randint(0, size - s)
+        x = rs.randint(0, size - s)
+        imgs[i, :, y:y + s, x:x + s] = 1.0
+        labels[i, 0] = [0.0, x / size, y / size, (x + s) / size, (y + s) / size]
+    return nd.array(imgs), nd.array(labels)
+
+
+def test_ssd_forward_shapes():
+    mx.random.seed(0)
+    net = get_ssd(num_classes=2)
+    net.initialize()
+    x = nd.ones((2, 3, 32, 32))
+    anchors, cls_preds, box_preds = net(x)
+    A = anchors.shape[1]
+    # 3 stages at 16/8/4 resolution, 4 anchors per pixel
+    assert A == (16 * 16 + 8 * 8 + 4 * 4) * 4
+    assert cls_preds.shape == (2, A, 3)
+    assert box_preds.shape == (2, A * 4)
+
+
+def test_multibox_target_matching():
+    anchors = nd.array(np.array(
+        [[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0],
+          [0.0, 0.5, 0.5, 1.0]]], np.float32))
+    label = nd.array(np.array(
+        [[[1.0, 0.05, 0.05, 0.45, 0.45], [-1, 0, 0, 0, 0]]], np.float32))
+    cls_pred = nd.zeros((1, 3, 3))
+    lt, lm, ct = nd.contrib.MultiBoxTarget(anchors, label, cls_pred)
+    assert lt.shape == (1, 12) and lm.shape == (1, 12) and ct.shape == (1, 3)
+    np.testing.assert_allclose(ct.asnumpy(), [[2.0, 0.0, 0.0]])  # cls+1
+    m = lm.asnumpy().reshape(1, 3, 4)
+    np.testing.assert_allclose(m[0, 0], 1.0)
+    np.testing.assert_allclose(m[0, 1:], 0.0)
+    # encoded w offset: log(0.4/0.5)/0.2
+    np.testing.assert_allclose(lt.asnumpy().reshape(1, 3, 4)[0, 0, 2],
+                               np.log(0.4 / 0.5) / 0.2, rtol=1e-5)
+
+
+def test_multibox_target_hard_negative_mining():
+    a = np.random.RandomState(0).rand(1, 16, 4).astype(np.float32).copy()
+    a[..., 2:] = a[..., :2] + 0.3  # valid corner boxes
+    anchors = nd.array(np.clip(a, 0, 1))
+    label = nd.array(np.array([[[0.0, 0.1, 0.1, 0.4, 0.4]]], np.float32))
+    cls_prob = nd.softmax(nd.array(np.random.RandomState(1)
+                                   .rand(1, 2, 16).astype(np.float32)), axis=1)
+    lt, lm, ct = nd.contrib.MultiBoxTarget(
+        anchors, label, cls_prob, negative_mining_ratio=3.0,
+        minimum_negative_samples=1)
+    c = ct.asnumpy()[0]
+    n_pos = (c > 0).sum()
+    n_neg = (c == 0).sum()
+    n_ign = (c == -1).sum()
+    assert n_pos >= 1
+    assert n_neg <= max(3 * n_pos, 1)
+    assert n_pos + n_neg + n_ign == 16
+
+
+def test_ssd_trains_and_detects():
+    """End-to-end: loss falls on the toy box task; detect() emits rows in
+    the reference's (cls, score, box) layout."""
+    mx.random.seed(0)
+    net = get_ssd(num_classes=1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    imgs, labels = _toy_batch(8, 32)
+    losses = []
+    for step in range(12):
+        with autograd.record():
+            anchors, cls_preds, box_preds = net(imgs)
+            loc_t, loc_m, cls_t = ssd_train_targets(anchors, labels, cls_preds)
+            loss = ssd_loss(cls_preds, box_preds, cls_t, loc_t, loc_m)
+        loss.backward()
+        trainer.step(imgs.shape[0])
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0], losses
+
+    out = net.detect(imgs)
+    assert out.shape[0] == 8 and out.shape[2] == 6
+    rows = out.asnumpy()[0]
+    kept = rows[rows[:, 0] >= 0]
+    if len(kept):  # scores in [0,1], boxes clipped to [0,1]
+        assert (kept[:, 1] >= 0).all() and (kept[:, 1] <= 1).all()
+        assert (kept[:, 2:] >= 0).all() and (kept[:, 2:] <= 1).all()
+
+
+def test_multibox_target_pad_rows_cannot_clobber_anchor0():
+    """A padded gt row must not erase a valid gt's force-match at anchor 0
+    (scatter-clobber regression)."""
+    # anchor 0 is the ONLY plausible anchor; gt IoU below threshold so the
+    # match can only come from force-matching
+    anchors = nd.array(np.array([[[0.0, 0.0, 1.0, 1.0],
+                                  [0.9, 0.9, 1.0, 1.0]]], np.float32))
+    label = nd.array(np.array(
+        [[[2.0, 0.0, 0.0, 0.3, 0.3],      # small gt, IoU ~0.09 w/ anchor 0
+          [-1.0, 0, 0, 0, 0]]], np.float32))   # pad row AFTER the valid one
+    cls_pred = nd.zeros((1, 4, 2))
+    lt, lm, ct = nd.contrib.MultiBoxTarget(anchors, label, cls_pred,
+                                           overlap_threshold=0.5)
+    # anchor 0 must be force-matched to class 2 (+1 => 3), not background
+    np.testing.assert_allclose(ct.asnumpy()[0, 0], 3.0)
+
+
+def test_multibox_target_mining_thresh():
+    """negative_mining_thresh gates which negatives are mined."""
+    a = np.random.RandomState(0).rand(1, 8, 4).astype(np.float32).copy()
+    a[..., 2:] = a[..., :2] + 0.3
+    anchors = nd.array(np.clip(a, 0, 1))
+    label = nd.array(np.array([[[0.0, 0.1, 0.1, 0.4, 0.4]]], np.float32))
+    # background prob 1.0 everywhere -> proxy 0 -> NOTHING eligible to mine
+    cls_prob = nd.array(np.stack([np.ones((1, 8), np.float32),
+                                  np.zeros((1, 8), np.float32)], axis=1))
+    lt, lm, ct = nd.contrib.MultiBoxTarget(
+        anchors, label, cls_prob, negative_mining_ratio=3.0,
+        negative_mining_thresh=0.5)
+    c = ct.asnumpy()[0]
+    assert (c[c <= 0] == -1).all(), c  # every unmatched anchor ignored
