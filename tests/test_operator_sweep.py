@@ -5,11 +5,18 @@ gradients over every differentiable op, error paths).
 Round-2 verdict ask #3: f32/bf16/f16 parametrization, check_numeric_gradient
 coverage, error-path messages. Small shapes keep the whole sweep CPU-cheap.
 """
+import zlib
+
 import numpy as np
 import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd
+
+
+def _seed(name):
+    """Deterministic per-case seed (PYTHONHASHSEED-proof)."""
+    return zlib.crc32(name.encode()) % 2 ** 31
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.test_utils import check_numeric_gradient
 
@@ -97,7 +104,7 @@ def test_unary_vs_numpy(name, fn, domain, dtype):
     if dtype != "float32" and name in ("gamma", "gammaln", "erf", "arccosh",
                                        "arctanh", "tan"):
         pytest.skip("low-precision tolerance too loose to be meaningful")
-    x_nd, x = _mk((3, 4), dtype, domain, seed=__import__('zlib').crc32(name.encode()) % 2 ** 31)
+    x_nd, x = _mk((3, 4), dtype, domain, seed=_seed(name))
     # the op computes in its input dtype; the oracle in f32 on the ROUNDED
     # input (so bf16 quantization error does not count against the op)
     x_round = np.asarray(x_nd.asnumpy(), np.float32)
@@ -215,7 +222,7 @@ _GRAD_CASES = {
 @pytest.mark.parametrize("case", sorted(_GRAD_CASES), ids=sorted(_GRAD_CASES))
 def test_numeric_gradient(case):
     fn, shapes, domain = _GRAD_CASES[case]
-    rs = np.random.RandomState(__import__('zlib').crc32(case.encode()) % 2 ** 31)
+    rs = np.random.RandomState(_seed(case))
     inputs = [rs.uniform(*domain, size=s).astype(np.float32) for s in shapes]
     check_numeric_gradient(fn, inputs, eps=1e-3, rtol=2e-2, atol=2e-3)
 
@@ -265,3 +272,67 @@ def test_error_registry_duplicate():
 
     with pytest.raises(ValueError, match="twice"):
         register("add")(lambda x: x)
+
+
+# --------------------------------------------------------------------------
+# eager-vs-jit consistency (SURVEY §4 fixture #3: check_consistency's
+# backend-vs-backend oracle, here interp-vs-compiled on one platform)
+# --------------------------------------------------------------------------
+_JIT_CASES = {
+    "exp": ((3, 4), {}),
+    "log_softmax": ((4, 8), {"axis": -1}),
+    "softmax": ((4, 8), {"axis": -1}),
+    "tanh": ((3, 4), {}),
+    "sigmoid": ((3, 4), {}),
+    "erf": ((3, 4), {}),
+    "square": ((3, 4), {}),
+    "cumsum": ((3, 4), {}),
+    "sum": ((3, 4), {"axis": 1}),
+    "mean": ((3, 4), {}),
+    "norm": ((3, 4), {}),
+    "sort": ((3, 7), {}),
+    "argsort": ((3, 7), {}),
+    "topk": ((2, 9), {"k": 3}),
+    "LayerNorm": None,  # multi-input, below
+    "gelu": ((3, 4), {}),
+    "relu6": ((3, 4), {}),
+    "logsumexp": ((3, 4), {"axis": 1}),
+    "linalg_det": None,
+}
+
+
+@pytest.mark.parametrize("name", [k for k, v in _JIT_CASES.items() if v],
+                         ids=[k for k, v in _JIT_CASES.items() if v])
+def test_eager_vs_jit_consistency(name):
+    import jax
+
+    shape, kwargs = _JIT_CASES[name]
+    from mxnet_tpu.registry import get as get_op
+
+    fn = get_op(name).fn
+    rs = np.random.RandomState(_seed(name))
+    x = rs.uniform(0.1, 2.0, size=shape).astype(np.float32)
+    eager = np.asarray(fn(x, **kwargs))
+    jitted = np.asarray(jax.jit(lambda a: fn(a, **kwargs))(x))
+    np.testing.assert_allclose(eager, jitted, rtol=1e-6, atol=1e-7,
+                               err_msg=name)
+
+
+def test_eager_vs_jit_multi_input():
+    import jax
+
+    from mxnet_tpu.registry import get as get_op
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 16).astype(np.float32)
+    g = rs.rand(16).astype(np.float32)
+    b = rs.rand(16).astype(np.float32)
+    ln = get_op("LayerNorm").fn
+    np.testing.assert_allclose(np.asarray(ln(x, g, b)),
+                               np.asarray(jax.jit(ln)(x, g, b)),
+                               rtol=1e-6, atol=1e-6)
+    a = rs.rand(4, 4).astype(np.float32)
+    spd = a @ a.T + 3 * np.eye(4, dtype=np.float32)
+    det = get_op("linalg_det").fn
+    np.testing.assert_allclose(np.asarray(det(spd)),
+                               np.asarray(jax.jit(det)(spd)), rtol=1e-5)
