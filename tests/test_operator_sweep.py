@@ -12,13 +12,13 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import check_consistency, check_numeric_gradient
 
 
 def _seed(name):
     """Deterministic per-case seed (PYTHONHASHSEED-proof)."""
     return zlib.crc32(name.encode()) % 2 ** 31
-from mxnet_tpu.base import MXNetError
-from mxnet_tpu.test_utils import check_numeric_gradient
 
 # dtype-aware tolerances (reference: test_utils.py default_tols)
 _TOLS = {"float32": (1e-5, 1e-6), "bfloat16": (3e-2, 3e-2),
@@ -304,35 +304,20 @@ _JIT_CASES = {
 @pytest.mark.parametrize("name", [k for k, v in _JIT_CASES.items() if v],
                          ids=[k for k, v in _JIT_CASES.items() if v])
 def test_eager_vs_jit_consistency(name):
-    import jax
-
     shape, kwargs = _JIT_CASES[name]
-    from mxnet_tpu.registry import get as get_op
-
-    fn = get_op(name).fn
     rs = np.random.RandomState(_seed(name))
     x = rs.uniform(0.1, 2.0, size=shape).astype(np.float32)
-    eager = np.asarray(fn(x, **kwargs))
-    jitted = np.asarray(jax.jit(lambda a: fn(a, **kwargs))(x))
-    np.testing.assert_allclose(eager, jitted, rtol=1e-6, atol=1e-7,
-                               err_msg=name)
+    check_consistency(lambda a: getattr(nd, name)(a, **kwargs), [x],
+                      rtol=1e-6, atol=1e-7)
 
 
 def test_eager_vs_jit_multi_input():
-    import jax
-
-    from mxnet_tpu.registry import get as get_op
-
     rs = np.random.RandomState(0)
     x = rs.randn(4, 16).astype(np.float32)
     g = rs.rand(16).astype(np.float32)
     b = rs.rand(16).astype(np.float32)
-    ln = get_op("LayerNorm").fn
-    np.testing.assert_allclose(np.asarray(ln(x, g, b)),
-                               np.asarray(jax.jit(ln)(x, g, b)),
-                               rtol=1e-6, atol=1e-6)
+    check_consistency(lambda a, gg, bb: nd.LayerNorm(a, gg, bb), [x, g, b],
+                      rtol=1e-6, atol=1e-6)
     a = rs.rand(4, 4).astype(np.float32)
     spd = a @ a.T + 3 * np.eye(4, dtype=np.float32)
-    det = get_op("linalg_det").fn
-    np.testing.assert_allclose(np.asarray(det(spd)),
-                               np.asarray(jax.jit(det)(spd)), rtol=1e-5)
+    check_consistency(lambda m: nd.linalg_det(m), [spd], rtol=1e-5)
