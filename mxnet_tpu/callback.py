@@ -4,7 +4,8 @@ from __future__ import annotations
 import logging
 import time
 
-__all__ = ["Speedometer", "do_checkpoint", "LogValidationMetricsCallback"]
+__all__ = ["Speedometer", "do_checkpoint", "LogValidationMetricsCallback",
+           "ProgressBar", "log_train_metric"]
 
 
 class Speedometer:
@@ -64,3 +65,37 @@ class LogValidationMetricsCallback:
             return
         for name, value in param.eval_metric.get_name_value():
             logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)
+
+
+class ProgressBar:
+    """Text progress bar per batch (reference callback.ProgressBar)."""
+
+    def __init__(self, total, length=80):
+        self.total = max(int(total), 1)
+        self.length = int(length)
+
+    def __call__(self, param):
+        count = getattr(param, "nbatch", 0)
+        filled = int(round(self.length * min(count, self.total) / self.total))
+        bar = "=" * filled + "-" * (self.length - filled)
+        print(f"\r[{bar}] {count}/{self.total}", end="", flush=True)
+        if count >= self.total:
+            print()
+
+
+def log_train_metric(period, auto_reset=False):
+    """Log the evaluation metric every ``period`` batches (reference
+    callback.log_train_metric)."""
+
+    def _callback(param):
+        if param.nbatch % max(int(period), 1) == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value() \
+                if hasattr(param.eval_metric, "get_name_value") \
+                else [param.eval_metric.get()]
+            for name, value in name_value:
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+
+    return _callback

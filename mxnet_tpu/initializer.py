@@ -15,7 +15,8 @@ import jax.numpy as jnp
 from .base import dtype_np
 
 __all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
-           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "registry", "create"]
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "Mixed", "Load", "registry", "create"]
 
 
 class Initializer:
@@ -142,10 +143,63 @@ class LSTMBias(Initializer):
         return b.at[n:2 * n].set(self.forget_bias).astype(dtype_np(dtype))
 
 
+class Mixed(Initializer):
+    """Patterns -> initializers; first regex match wins (reference
+    initializer.Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise ValueError("Mixed: len(patterns) != len(initializers)")
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def init_for_name(self, name, shape, dtype, key):
+        for pat, ini in self.map:
+            if pat.search(name):
+                return ini.init_for_name(name, shape, dtype, key)
+        raise ValueError(f"Mixed: no pattern matched parameter {name!r}; "
+                         "add a catch-all '.*' entry")
+
+
+class Load(Initializer):
+    """Initialize from a dict of arrays / .params file, falling back to
+    ``default_init`` for missing names (reference initializer.Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .serialization import load_ndarrays
+
+            param = load_ndarrays(param)
+        if not hasattr(param, "items"):
+            raise ValueError(
+                "Load: params must be a name->array dict (a list-saved "
+                ".params file carries no names to match against)")
+        self.param = {k.replace("arg:", "").replace("aux:", ""): v
+                      for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def init_for_name(self, name, shape, dtype, key):
+        if name in self.param:
+            arr = self.param[name]
+            arr = arr.asnumpy() if hasattr(arr, "asnumpy") else arr
+            if tuple(arr.shape) != tuple(shape):
+                raise ValueError(
+                    f"Load: parameter {name!r} shape {arr.shape} != {shape}")
+            if self.verbose:
+                import logging
+
+                logging.info("Initialized %s by loading", name)
+            return jnp.asarray(arr, dtype_np(dtype))
+        if self.default_init is None:
+            raise ValueError(f"Load: no value for {name!r} and no default_init")
+        return self.default_init.init_for_name(name, shape, dtype, key)
+
+
 registry = {
     "zeros": Zero, "zero": Zero, "ones": One, "one": One, "constant": Constant,
     "uniform": Uniform, "normal": Normal, "gaussian": Normal, "orthogonal": Orthogonal,
     "xavier": Xavier, "msra_prelu": MSRAPrelu, "bilinear": Bilinear, "lstmbias": LSTMBias,
+    "mixed": Mixed, "load": Load,
 }
 
 

@@ -12,7 +12,8 @@ import numpy as np
 
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE", "RMSE",
            "CrossEntropy", "Perplexity", "Loss", "PearsonCorrelation", "MCC",
-           "CustomMetric", "CompositeEvalMetric", "create"]
+           "NegativeLogLikelihood", "CustomMetric", "CompositeEvalMetric",
+           "create"]
 
 
 def _as_raw(x):
@@ -229,6 +230,22 @@ class MCC(EvalMetric):
         return self.name, num / den if den else 0.0
 
 
+class NegativeLogLikelihood(EvalMetric):
+    """Mean -log P(label) (reference metric.py NegativeLogLikelihood)."""
+
+    def __init__(self, eps=1e-12, name="nll-loss", **kw):
+        self.eps = eps
+        super().__init__(name, **kw)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_listify(labels), _listify(preds)):
+            lab = np.asarray(_as_raw(label)).astype(np.int64).ravel()
+            p = np.asarray(_as_raw(pred)).reshape(len(lab), -1)
+            picked = p[np.arange(len(lab)), lab]
+            self.sum_metric = self.sum_metric - np.log(picked + self.eps).sum()
+            self.num_inst += len(lab)
+
+
 class CustomMetric(EvalMetric):
     def __init__(self, feval, name="custom", allow_extra_outputs=False, **kw):
         self._feval = feval
@@ -277,6 +294,7 @@ _REGISTRY = {
     "acc": Accuracy, "accuracy": Accuracy, "top_k_accuracy": TopKAccuracy, "top_k_acc": TopKAccuracy,
     "f1": F1, "mae": MAE, "mse": MSE, "rmse": RMSE, "ce": CrossEntropy, "cross-entropy": CrossEntropy,
     "perplexity": Perplexity, "loss": Loss, "pcc": PearsonCorrelation, "mcc": MCC,
+    "nll_loss": NegativeLogLikelihood, "nll-loss": NegativeLogLikelihood,
 }
 
 

@@ -101,3 +101,54 @@ def test_trainer_lr_scheduler_integration():
         loss.backward()
         tr.step(1)
     assert abs(tr.learning_rate - 0.01) < 1e-6  # 4 updates, step=2 -> factor^2
+
+
+def test_negative_log_likelihood_metric():
+    import numpy as np
+
+    m = mx.metric.NegativeLogLikelihood()
+    preds = nd.array(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32))
+    labels = nd.array(np.array([0, 1], np.float32))
+    m.update(labels, preds)
+    name, val = m.get()
+    expect = -(np.log(0.9) + np.log(0.8)) / 2
+    assert name == "nll-loss"
+    np.testing.assert_allclose(val, expect, rtol=1e-6)
+
+
+def test_mixed_and_load_initializers(tmp_path):
+    import numpy as np
+
+    from mxnet_tpu.gluon import nn
+
+    # Mixed: weight -> One, rest -> Zero (the layer's own bias_initializer
+    # takes precedence over the global init, reference semantics)
+    net = nn.Dense(3, in_units=2)
+    net.initialize(mx.init.Mixed([".*weight", ".*"],
+                                 [mx.init.One(), mx.init.Zero()]))
+    np.testing.assert_allclose(net.weight.data().asnumpy(), 1.0)
+    np.testing.assert_allclose(net.bias.data().asnumpy(), 0.0)
+
+    # Load: from saved params, default for missing
+    f = str(tmp_path / "w.params")
+    nd.save(f, {net.weight.name: nd.full((3, 2), 7.0)})
+    net2 = nn.Dense(3, in_units=2, prefix=net.prefix)
+    net2.initialize(mx.init.Load(f, default_init=mx.init.Zero()))
+    np.testing.assert_allclose(net2.weight.data().asnumpy(), 7.0)
+    np.testing.assert_allclose(net2.bias.data().asnumpy(), 0.0)
+
+
+def test_callback_progressbar_and_log_train_metric(capsys):
+    from collections import namedtuple
+
+    P = namedtuple("P", ["nbatch", "epoch", "eval_metric"])
+    bar = mx.callback.ProgressBar(total=4, length=8)
+    for i in range(1, 5):
+        bar(P(nbatch=i, epoch=0, eval_metric=None))
+    out = capsys.readouterr().out
+    assert "4/4" in out and "=" * 8 in out
+
+    m = mx.metric.Accuracy()
+    m.update(nd.array([1.0]), nd.array([[0.1, 0.9]]))
+    cb = mx.callback.log_train_metric(period=1)
+    cb(P(nbatch=1, epoch=0, eval_metric=m))  # logs without raising
