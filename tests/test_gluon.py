@@ -224,3 +224,94 @@ def test_trainer_save_load_states(tmp_path):
     tr2 = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.1})
     tr2.load_states(f)
     assert tr2._optimizer.num_update == tr._optimizer.num_update
+
+
+def test_load_parameters_error_paths(tmp_path):
+    """Reference error semantics: missing params raise unless allow_missing;
+    extra params raise unless ignore_extra."""
+    from mxnet_tpu.base import MXNetError
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(3, in_units=2, prefix="lp_"))
+    net.initialize()
+    f = str(tmp_path / "full.params")
+    net.save_parameters(f)
+
+    bigger = nn.HybridSequential()
+    bigger.add(nn.Dense(3, in_units=2, prefix="lp_"), nn.Dense(1, prefix="x_"))
+    bigger.initialize()
+    _ = bigger(nd.ones((1, 2)))  # materialize deferred params before saving
+    with pytest.raises(MXNetError, match="missing"):
+        bigger.load_parameters(f)
+    bigger.load_parameters(f, allow_missing=True)  # ok
+
+    f2 = str(tmp_path / "big.params")
+    bigger.save_parameters(f2)
+    with pytest.raises(MXNetError, match="unknown"):
+        net.load_parameters(f2)
+    net.load_parameters(f2, ignore_extra=True)  # ok
+
+
+def test_trainer_state_roundtrip_preserves_momentum(tmp_path):
+    """save_states/load_states restores optimizer state so training
+    continues identically (reference Trainer state checkpoint)."""
+
+    def build_and_steps(n_steps, save_to=None):
+        mx.random.seed(11)
+        net = nn.Dense(2, in_units=3, prefix="ts_")
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+        x = nd.ones((4, 3))
+        y = nd.zeros((4, 2))
+        outs = []
+        for i in range(n_steps):
+            with autograd.record():
+                loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            tr.step(4)
+            outs.append(net.weight.data().asnumpy().copy())
+            if save_to and i == 1:
+                net.save_parameters(save_to + ".params")
+                tr.save_states(save_to + ".states")
+        return net, tr, outs
+
+    base = str(tmp_path / "ckpt")
+    _, _, full_run = build_and_steps(5, save_to=base)
+
+    # resume: fresh net+trainer, load params+states after "step 2", continue
+    net2 = nn.Dense(2, in_units=3, prefix="ts2_")
+    net2.initialize()
+    tr2 = gluon.Trainer(net2.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9})
+    net2.load_parameters(base + ".params")
+    tr2.load_states(base + ".states")
+    x = nd.ones((4, 3)); y = nd.zeros((4, 2))
+    resumed = []
+    for _ in range(3):
+        with autograd.record():
+            loss = ((net2(x) - y) ** 2).mean()
+        loss.backward()
+        tr2.step(4)
+        resumed.append(net2.weight.data().asnumpy().copy())
+    np.testing.assert_allclose(resumed[0], full_run[2], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(resumed[2], full_run[4], rtol=1e-5, atol=1e-6)
+
+
+def test_lr_scheduler_curves():
+    """Numeric shape of each scheduler (reference lr_scheduler.py)."""
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0 and s(11) == pytest.approx(0.5) and s(21) == pytest.approx(0.25)
+
+    m = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1, base_lr=1.0)
+    assert m(1) == 1.0 and m(6) == pytest.approx(0.1) and m(16) == pytest.approx(0.01)
+
+    p = mx.lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=2)
+    assert p(0) == pytest.approx(1.0)
+    assert p(100) == pytest.approx(0.0, abs=1e-9)
+    assert 0 < p(50) < 1.0
+
+    c = mx.lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.1)
+    assert c(0) == pytest.approx(1.0)
+    assert c(100) == pytest.approx(0.1)
+    assert c(100) < c(50) < c(0)
