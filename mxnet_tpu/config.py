@@ -39,6 +39,10 @@ _KNOBS: Dict[str, tuple] = {
                         "tested)"),
     "flash_attention": (bool, True, ("MXNET_TPU_FLASH_ATTENTION",),
                         "use the Pallas flash kernel when shapes allow"),
+    "flash_pallas_bwd": (bool, True, ("MXNET_TPU_FLASH_PALLAS_BWD",),
+                         "FlashAttention-2 Pallas backward kernels (dq + "
+                         "dkv); off = XLA chunked-recompute backward "
+                         "(~2.5x slower on v5e but kernel-free)"),
     "default_dtype": (str, "float32", ("MXNET_DEFAULT_DTYPE",), "creation dtype"),
     "storage_fallback_warn": (bool, True, ("MXNET_STORAGE_FALLBACK_WARN",),
                               "warn when a sparse input densifies at an op "
