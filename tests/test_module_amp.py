@@ -329,3 +329,47 @@ def test_amp_dot_family_runs_lp16():
             assert out.dtype == np.float32, f"{op} must give f32 out"
     finally:
         amp._reset()
+
+
+def test_bf16_cast_net_conv_trains_end_to_end():
+    """A net.cast('bfloat16') CNN must train through TrainStep with AMP on —
+    regression: the conv op used preferred_element_type=f32, whose jax
+    transpose rule rejects the mixed-dtype cotangent at grad time."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, optimizer
+    from mxnet_tpu.contrib import amp
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    from mxnet_tpu.parallel import TrainStep
+
+    amp.init("bfloat16")
+    try:
+        mx.random.seed(0)
+        net = get_model("lenet", classes=10)
+        net.initialize()
+        rs = np.random.RandomState(0)
+        x = nd.array(rs.randn(2, 1, 28, 28).astype("float32"))
+        y = nd.array(rs.randint(0, 10, (2,)), dtype="int32")
+        _ = net(x)
+        net.cast("bfloat16")
+
+        def loss_fn(out, y):
+            import jax.numpy as jnp
+
+            logits = (out._data if hasattr(out, "_data") else out).astype(
+                jnp.float32)
+            yv = (y._data if hasattr(y, "_data") else y).astype(jnp.int32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.take_along_axis(logp, yv[:, None], axis=-1).mean()
+
+        ts = TrainStep(net, loss_fn, optimizer.SGD(learning_rate=0.1),
+                       mesh=None, n_model_inputs=1)
+        losses = []
+        for _ in range(3):
+            loss = ts(x, y)
+            losses.append(float(np.asarray(jax.device_get(loss))))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+    finally:
+        amp._reset()
