@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Word-level LSTM language model (reference shape:
+example/gluon/word_language_model/train.py — the classic PTB RNN-LM).
+
+Trains an Embedding -> multi-layer LSTM -> tied/untied Dense decoder on a
+corpus of token ids, reporting perplexity. With no --data file a synthetic
+Zipf-ish corpus is generated so the script runs hermetically.
+"""
+import argparse
+import math
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn, rnn
+
+
+class RNNModel(gluon.HybridBlock):
+    def __init__(self, vocab_size, embed_size=200, hidden_size=200,
+                 num_layers=2, dropout=0.2, tie_weights=False, **kwargs):
+        super().__init__(**kwargs)
+        self.vocab_size = vocab_size
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.encoder = nn.Embedding(vocab_size, embed_size)
+            self.rnn = rnn.LSTM(hidden_size, num_layers=num_layers,
+                                dropout=dropout, layout="TNC")
+            if tie_weights and embed_size != hidden_size:
+                raise ValueError("tied weights need embed_size == hidden_size")
+            self.decoder = nn.Dense(vocab_size, flatten=False,
+                                    params=self.encoder.params
+                                    if tie_weights else None)
+
+    def hybrid_forward(self, F, inputs, state=None):
+        # inputs: (T, N) int ids
+        emb = self.drop(self.encoder(inputs))
+        if state is None:
+            out = self.rnn(emb)
+        else:
+            out, state = self.rnn(emb, state)
+        out = self.drop(out)
+        dec = self.decoder(out)  # (T, N, vocab)
+        return dec if state is None else (dec, state)
+
+    def begin_state(self, batch_size):
+        return self.rnn.begin_state(batch_size)
+
+
+def synthetic_corpus(n_tokens=200000, vocab=1000, seed=0):
+    """Zipf-distributed ids with a little bigram structure so the model has
+    something learnable."""
+    rs = np.random.RandomState(seed)
+    base = rs.zipf(1.3, n_tokens) % vocab
+    # inject determinism: every even position strongly predicts the next
+    base[1::2] = (base[0::2][: len(base[1::2])] * 7 + 3) % vocab
+    return base.astype(np.int32)
+
+
+def batchify(data, batch_size):
+    n = len(data) // batch_size
+    return data[: n * batch_size].reshape(batch_size, n).T  # (T, N)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data", default=None,
+                    help="path to a tokenized id file (np.load-able); "
+                         "synthetic corpus if omitted")
+    ap.add_argument("--vocab", type=int, default=1000)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--bptt", type=int, default=35)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--clip", type=float, default=0.25)
+    ap.add_argument("--tied", action="store_true")
+    ap.add_argument("--embed-size", type=int, default=200)
+    ap.add_argument("--hidden-size", type=int, default=200)
+    args = ap.parse_args()
+
+    corpus = (np.load(args.data) if args.data
+              else synthetic_corpus(vocab=args.vocab))
+    vocab = int(corpus.max()) + 1
+    data = batchify(corpus, args.batch_size)
+
+    model = RNNModel(vocab, args.embed_size, args.hidden_size,
+                     tie_weights=args.tied)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": args.lr, "clip_gradient": args.clip})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        total_loss, n_batches = 0.0, 0
+        for i in range(0, data.shape[0] - 1 - args.bptt, args.bptt):
+            x = nd.array(data[i:i + args.bptt], dtype="int32")
+            y = nd.array(data[i + 1:i + 1 + args.bptt], dtype="int32")
+            with autograd.record():
+                out = model(x)  # (T, N, vocab)
+                loss = loss_fn(out.reshape(-1, vocab), y.reshape(-1))
+            loss.backward()
+            trainer.step(x.shape[1])
+            total_loss += float(loss.mean().asnumpy())
+            n_batches += 1
+        ppl = math.exp(min(total_loss / max(n_batches, 1), 20))
+        print(f"epoch {epoch}: loss {total_loss / max(n_batches, 1):.4f} "
+              f"ppl {ppl:.2f}")
+    model.export("word_lm")
+    return total_loss / max(n_batches, 1)
+
+
+if __name__ == "__main__":
+    main()

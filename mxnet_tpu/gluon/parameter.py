@@ -193,14 +193,41 @@ class ParameterDict:
     def __contains__(self, key):
         return key in self._params
 
+    @staticmethod
+    def _check_shared(p, name, kwargs):
+        """A shared hit must satisfy the declaring layer's shape/dtype —
+        a mismatch would otherwise surface as a confusing downstream matmul
+        failure (or silent wrong training) far from the tie point."""
+        want = kwargs.get("shape")
+        if want is not None and p.shape is not None:
+            if tuple(want) != tuple(p.shape) and 0 not in tuple(want):
+                raise ValueError(
+                    f"shared parameter {p.name} has shape {p.shape}, but "
+                    f"'{name}' is declared with shape {tuple(want)}")
+        return p
+
     def get(self, name, **kwargs):
         """Create-or-retrieve (the layer-side param declaration API)."""
+        raw = name
         name = self._prefix + name
         if name in self._params:
             return self._params[name]
-        if self._shared is not None and name in self._shared:
-            self._params[name] = self._shared[name]
-            return self._params[name]
+        if self._shared is not None:
+            if name in self._shared:
+                self._params[name] = self._check_shared(
+                    self._shared[name], name, kwargs)
+                return self._params[name]
+            # structural remap (reference parameter.py shared lookup): a
+            # block built with ``params=other.params`` shares by the
+            # UNPREFIXED name — e.g. tied-embedding decoders:
+            # Dense(..., params=encoder.params) resolves "weight" to the
+            # encoder's "<encoder_prefix>weight" parameter
+            shared_prefix = getattr(self._shared, "prefix", "")
+            alt = shared_prefix + raw
+            if alt in self._shared:
+                self._params[name] = self._check_shared(
+                    self._shared[alt], name, kwargs)
+                return self._params[name]
         p = Parameter(name, **kwargs)
         self._params[name] = p
         return p

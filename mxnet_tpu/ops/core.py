@@ -494,3 +494,62 @@ register("cumsum")(lambda x, axis=None, dtype=None: jnp.cumsum(x, axis=None if a
 register("isnan")(lambda x: jnp.isnan(x).astype(jnp.float32))
 register("isinf")(lambda x: jnp.isinf(x).astype(jnp.float32))
 register("isfinite")(lambda x: jnp.isfinite(x).astype(jnp.float32))
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=()):
+    """Broadcast size-1 axes to the given sizes (reference
+    broadcast_reduce_op: one (axis, size) pair or parallel tuples)."""
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    if len(axes) != len(sizes):
+        raise ValueError(f"broadcast_axis: axis {axes} and size {sizes} must "
+                         "have the same length")
+    shape = list(data.shape)
+    for a, s in zip(axes, sizes):
+        if shape[a] != 1:
+            raise ValueError(f"broadcast_axis: axis {a} has size {shape[a]}, "
+                             "expected 1")
+        shape[a] = int(s)
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+register("degrees")(lambda x: jnp.degrees(x))
+register("radians")(lambda x: jnp.radians(x))
+
+
+@register("make_loss", aliases=("MakeLoss",))
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    """Mark an output as a loss head (reference make_loss op): forward is
+    IDENTITY; grad_scale and normalization shape only the backward signal —
+    'batch' divides by batch size, 'valid' by the count of entries above
+    valid_thresh, 'null' applies grad_scale alone."""
+    import jax
+
+    @jax.custom_vjp
+    def _ml(x):
+        return x
+
+    def _fwd(x):
+        return x, x
+
+    def _bwd(x, g):
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / x.shape[0]
+        elif normalization == "valid":
+            n = jnp.maximum(jnp.sum((x > valid_thresh).astype(jnp.float32)),
+                            1.0)
+            return ((g * scale / n).astype(x.dtype),)
+        return ((g * scale).astype(x.dtype),)
+
+    _ml.defvjp(_fwd, _bwd)
+    return _ml(data)
+
+
+@register("SVMOutput", aliases=("svm_output",))
+def svm_output(data, label=None, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """Forward = identity scores (reference svm_output.cc); the hinge-loss
+    gradient fusion is delegated to autograd via gluon.loss.HingeLoss."""
+    return data

@@ -67,16 +67,16 @@ def convolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1, 
     stride, dilate, pad = _pair(stride), _pair(dilate), _pair(pad)
     orig_dtype = data.dtype
     adt = _amp_compute_dtype()
-    if adt is not None and orig_dtype == jnp.float32:
-        data, weight = data.astype(adt), weight.astype(adt)
     # NOTE: no preferred_element_type here — jax's conv transpose rule can't
     # mix the upcast f32 cotangent with low-precision operands (TypeError at
     # grad time; round-3 finding). bf16 is safe without it: its exponent
     # range equals f32's (no overflow) and the MXU accumulates partial
     # products in f32 internally. f16's 65504 max IS overflowable across a
-    # large fan-in, and cuDNN accumulates f32 there — so f16 convs compute
-    # in f32 (correctness over the rare-on-TPU f16 path).
-    if data.dtype == jnp.float16:
+    # large fan-in, and cuDNN accumulates f32 there — so f16 convs stay in
+    # f32 (AMP-f16 skips the downcast; f16-cast nets upcast).
+    if adt == jnp.bfloat16 and orig_dtype == jnp.float32:
+        data, weight = data.astype(adt), weight.astype(adt)
+    elif data.dtype == jnp.float16:
         data, weight = data.astype(jnp.float32), weight.astype(jnp.float32)
     out = lax.conv_general_dilated(
         data, weight,
@@ -101,13 +101,13 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=(1, 1), dilate=(1
     kh, kw = weight.shape[-2], weight.shape[-1]
     orig_dtype = data.dtype
     adt = _amp_compute_dtype()
-    if adt is not None and orig_dtype == jnp.float32:
-        # AMP: MXU compute in bf16/f16, f32 accumulate (amp._LP16_OPS)
-        data, weight = data.astype(adt), weight.astype(adt)
     # transposed conv = lhs-dilated conv with flipped kernel (IOHW).
     # No preferred_element_type — see convolution() above (conv transpose
-    # rule breaks on mixed-dtype cotangents; f16 upcast for overflow safety).
-    if data.dtype == jnp.float16:
+    # rule breaks on mixed-dtype cotangents; f16 stays f32 for overflow
+    # safety, AMP-bf16 computes natively).
+    if adt == jnp.bfloat16 and orig_dtype == jnp.float32:
+        data, weight = data.astype(adt), weight.astype(adt)
+    elif data.dtype == jnp.float16:
         data, weight = data.astype(jnp.float32), weight.astype(jnp.float32)
     out = lax.conv_general_dilated(
         data, jnp.flip(weight, (-1, -2)).swapaxes(0, 1),

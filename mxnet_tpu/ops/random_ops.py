@@ -74,3 +74,77 @@ def sample_unique_zipfian(range_max, shape=(), key=None):
     u = jax.random.uniform(_key(key), tuple(shape))
     out = jnp.exp(u * jnp.log(float(range_max))).astype(jnp.int64) - 1
     return jnp.clip(out, 0, range_max - 1)
+
+
+# --------------------------------------------------------------------------
+# Per-element sample_* family (reference sample_op.cc: distribution params
+# given as ARRAYS, one draw per parameter element, optional trailing shape).
+# --------------------------------------------------------------------------
+def _per_elem_shape(param, shape):
+    extra = (tuple(shape) if isinstance(shape, (tuple, list))
+             else ((int(shape),) if shape else ()))
+    return tuple(param.shape) + extra, extra
+
+
+@register("_sample_uniform", aliases=("sample_uniform",), stochastic=True)
+def sample_uniform(low, high, shape=(), dtype="float32", key=None):
+    low = jnp.asarray(low)
+    out_shape, extra = _per_elem_shape(low, shape)
+    u = jax.random.uniform(_key(key), out_shape, dtype_np(dtype))
+    lo = jnp.reshape(low, low.shape + (1,) * len(extra))
+    hi = jnp.reshape(jnp.asarray(high), low.shape + (1,) * len(extra))
+    return (lo + u * (hi - lo)).astype(dtype_np(dtype))
+
+
+@register("_sample_normal", aliases=("sample_normal",), stochastic=True)
+def sample_normal(mu, sigma, shape=(), dtype="float32", key=None):
+    mu = jnp.asarray(mu)
+    out_shape, extra = _per_elem_shape(mu, shape)
+    z = jax.random.normal(_key(key), out_shape, dtype_np(dtype))
+    m = jnp.reshape(mu, mu.shape + (1,) * len(extra))
+    s = jnp.reshape(jnp.asarray(sigma), mu.shape + (1,) * len(extra))
+    return (m + z * s).astype(dtype_np(dtype))
+
+
+@register("_sample_gamma", aliases=("sample_gamma",), stochastic=True)
+def sample_gamma(alpha, beta, shape=(), dtype="float32", key=None):
+    alpha = jnp.asarray(alpha)
+    out_shape, extra = _per_elem_shape(alpha, shape)
+    a = jnp.reshape(alpha, alpha.shape + (1,) * len(extra))
+    g = jax.random.gamma(_key(key), jnp.broadcast_to(a, out_shape),
+                         dtype=dtype_np(dtype))
+    b = jnp.reshape(jnp.asarray(beta), alpha.shape + (1,) * len(extra))
+    return (g * b).astype(dtype_np(dtype))
+
+
+@register("_sample_exponential", aliases=("sample_exponential",), stochastic=True)
+def sample_exponential(lam, shape=(), dtype="float32", key=None):
+    lam = jnp.asarray(lam)
+    out_shape, extra = _per_elem_shape(lam, shape)
+    e = jax.random.exponential(_key(key), out_shape, dtype_np(dtype))
+    l = jnp.reshape(lam, lam.shape + (1,) * len(extra))
+    return (e / l).astype(dtype_np(dtype))
+
+
+@register("_sample_poisson", aliases=("sample_poisson",), stochastic=True)
+def sample_poisson(lam, shape=(), dtype="float32", key=None):
+    lam = jnp.asarray(lam)
+    out_shape, extra = _per_elem_shape(lam, shape)
+    l = jnp.reshape(lam, lam.shape + (1,) * len(extra))
+    out = jax.random.poisson(_key(key), jnp.broadcast_to(l, out_shape))
+    return out.astype(dtype_np(dtype))
+
+
+@register("_sample_negative_binomial", aliases=("sample_negative_binomial",),
+          stochastic=True)
+def sample_negative_binomial(k, p, shape=(), dtype="float32", key=None):
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p)) — the reference's definition
+    k = jnp.asarray(k, jnp.float32)
+    out_shape, extra = _per_elem_shape(k, shape)
+    kk = jnp.reshape(k, k.shape + (1,) * len(extra))
+    pp = jnp.reshape(jnp.asarray(p, jnp.float32), k.shape + (1,) * len(extra))
+    key = _key(key)
+    k1, k2 = jax.random.split(key)
+    rate = jax.random.gamma(k1, jnp.broadcast_to(kk, out_shape)) \
+        * (1.0 - jnp.broadcast_to(pp, out_shape)) / jnp.broadcast_to(pp, out_shape)
+    return jax.random.poisson(k2, rate).astype(dtype_np(dtype))

@@ -302,3 +302,115 @@ def test_reshape_reverse():
     np.testing.assert_array_equal(
         nd.reshape(x, shape=(-1, 0), reverse=True).asnumpy().ravel(),
         np.arange(24, dtype=np.float32))
+
+
+def test_broadcast_axis_and_trig_units():
+    x = nd.array(np.ones((2, 1, 3), np.float32))
+    out = nd.broadcast_axis(x, axis=1, size=4)
+    assert out.shape == (2, 4, 3)
+    np.testing.assert_allclose(out.asnumpy(), np.ones((2, 4, 3), np.float32))
+    with pytest.raises(ValueError):
+        nd.broadcast_axis(x, axis=0, size=5)  # axis 0 has size 2, not 1
+    np.testing.assert_allclose(
+        nd.degrees(nd.array([np.pi, np.pi / 2])).asnumpy(), [180.0, 90.0],
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.radians(nd.array([180.0])).asnumpy(), [np.pi], rtol=1e-6)
+
+
+def test_make_loss_and_svm_output_identity():
+    x = nd.array(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+    # forward is ALWAYS identity (reference: grad_scale only shapes backward)
+    np.testing.assert_allclose(nd.make_loss(x).asnumpy(), x.asnumpy())
+    np.testing.assert_allclose(nd.make_loss(x, grad_scale=2.0).asnumpy(),
+                               x.asnumpy())
+    np.testing.assert_allclose(nd.SVMOutput(x).asnumpy(), x.asnumpy())
+
+
+def test_make_loss_backward_scaling():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.registry import get as get_op
+
+    ml = get_op("make_loss").fn
+    x = jnp.ones((4, 2), jnp.float32)
+    g_null = jax.grad(lambda x: ml(x, grad_scale=3.0).sum())(x)
+    np.testing.assert_allclose(np.asarray(g_null), 3.0)
+    g_batch = jax.grad(
+        lambda x: ml(x, grad_scale=1.0, normalization="batch").sum())(x)
+    np.testing.assert_allclose(np.asarray(g_batch), 1.0 / 4.0)
+    # 'valid': divide by count of entries above valid_thresh (here all 8)
+    g_valid = jax.grad(
+        lambda x: ml(x, normalization="valid", valid_thresh=0.5).sum())(x)
+    np.testing.assert_allclose(np.asarray(g_valid), 1.0 / 8.0)
+
+
+def test_broadcast_axis_mismatched_tuples_raise():
+    x = nd.array(np.ones((2, 1, 1), np.float32))
+    with pytest.raises(ValueError):
+        nd.broadcast_axis(x, axis=(1, 2), size=(4,))
+
+
+def test_shared_param_shape_mismatch_raises():
+    from mxnet_tpu.gluon.parameter import ParameterDict
+
+    base = ParameterDict(prefix="enc_")
+    base.get("weight", shape=(10, 4))
+    shared = ParameterDict(prefix="dec_", shared=base)
+    with pytest.raises(ValueError):
+        shared.get("weight", shape=(7, 4))
+    # matching shape ties cleanly
+    p = shared.get("weight", shape=(10, 4))
+    assert p is base.get("weight")
+
+
+def test_sample_family_per_element_params():
+    """sample_* draw one batch per PARAMETER ELEMENT (reference
+    sample_op.cc), unlike random_* which take scalar params + shape."""
+    import mxnet_tpu as mx
+
+    mx.random.seed(7)
+    mu = nd.array(np.array([0.0, 100.0], np.float32))
+    sig = nd.array(np.array([1.0, 0.1], np.float32))
+    s = nd.sample_normal(mu, sig, shape=(500,))
+    assert s.shape == (2, 500)
+    m = s.asnumpy().mean(axis=1)
+    assert abs(m[0]) < 0.5 and abs(m[1] - 100.0) < 0.5
+
+    low = nd.array(np.array([0.0, 5.0], np.float32))
+    high = nd.array(np.array([1.0, 6.0], np.float32))
+    u = nd.sample_uniform(low, high, shape=(200,)).asnumpy()
+    assert u.shape == (2, 200)
+    assert (u[0] >= 0).all() and (u[0] <= 1).all()
+    assert (u[1] >= 5).all() and (u[1] <= 6).all()
+
+    lam = nd.array(np.array([2.0, 20.0], np.float32))
+    p = nd.sample_poisson(lam, shape=(500,)).asnumpy()
+    assert abs(p[0].mean() - 2.0) < 0.5 and abs(p[1].mean() - 20.0) < 2.0
+
+    g = nd.sample_gamma(nd.array(np.array([2.0], np.float32)),
+                        nd.array(np.array([3.0], np.float32)),
+                        shape=(800,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.8  # E[gamma(a, b)] = a*b
+
+    k = nd.array(np.array([3.0], np.float32))
+    pr = nd.array(np.array([0.5], np.float32))
+    nb = nd.sample_negative_binomial(k, pr, shape=(800,)).asnumpy()
+    assert abs(nb.mean() - 3.0) < 0.8  # E = k(1-p)/p
+
+    e = nd.sample_exponential(nd.array(np.array([4.0], np.float32)),
+                              shape=(800,)).asnumpy()
+    assert abs(e.mean() - 0.25) < 0.1
+
+
+def test_sample_family_seed_reproducible():
+    import mxnet_tpu as mx
+
+    mu = nd.array(np.zeros(3, np.float32))
+    sig = nd.array(np.ones(3, np.float32))
+    mx.random.seed(123)
+    a = nd.sample_normal(mu, sig, shape=(4,)).asnumpy()
+    mx.random.seed(123)
+    b = nd.sample_normal(mu, sig, shape=(4,)).asnumpy()
+    np.testing.assert_array_equal(a, b)

@@ -1,0 +1,68 @@
+"""Word-language-model example smoke: the LSTM LM trains to a falling loss
+on the synthetic corpus (reference shape: example/gluon/word_language_model)."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+
+def test_synthetic_corpus_and_batchify():
+    from train_word_lm import batchify, synthetic_corpus
+
+    corpus = synthetic_corpus(n_tokens=1000, vocab=50)
+    assert corpus.dtype == np.int32
+    assert corpus.min() >= 0 and corpus.max() < 50
+    data = batchify(corpus, 8)
+    assert data.shape == (1000 // 8, 8)
+
+
+def test_word_lm_trains_to_falling_loss():
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from train_word_lm import RNNModel, batchify, synthetic_corpus
+
+    mx.random.seed(0)
+    corpus = synthetic_corpus(n_tokens=4000, vocab=40)
+    vocab = int(corpus.max()) + 1
+    data = batchify(corpus, 8)
+    model = RNNModel(vocab, embed_size=32, hidden_size=32, num_layers=1,
+                     dropout=0.0)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    bptt = 10
+    losses = []
+    for i in range(0, min(data.shape[0] - 1 - bptt, 15 * bptt), bptt):
+        x = nd.array(data[i:i + bptt], dtype="int32")
+        y = nd.array(data[i + 1:i + 1 + bptt], dtype="int32")
+        with autograd.record():
+            out = model(x)
+            loss = loss_fn(out.reshape(-1, vocab), y.reshape(-1))
+        loss.backward()
+        trainer.step(x.shape[1])
+        losses.append(float(loss.mean().asnumpy()))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_word_lm_tied_weights():
+    import mxnet_tpu as mx
+    from train_word_lm import RNNModel
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        RNNModel(100, embed_size=32, hidden_size=64, tie_weights=True)
+    m = RNNModel(50, embed_size=32, hidden_size=32, tie_weights=True)
+    m.initialize(mx.init.Xavier())
+    from mxnet_tpu import nd
+
+    out = m(nd.array(np.zeros((5, 2), np.int32), dtype="int32"))
+    assert out.shape == (5, 2, 50)
+    # decoder weight IS the embedding table (shared Parameter object)
+    enc_w = m.encoder.params.get("weight")
+    dec_w = m.decoder.params.get("weight")
+    assert enc_w is dec_w
