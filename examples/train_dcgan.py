@@ -72,7 +72,8 @@ def train(epochs=1, batch_size=16, nz=64, lr=2e-4, n_samples=256,
     if dataset == "mnist":
         from mxnet_tpu.gluon.data.vision import MNIST
 
-        raw = np.stack([np.asarray(d) for d, _ in MNIST(train=True)][:n_samples])
+        ds = MNIST(train=True)
+        raw = np.stack([np.asarray(ds[i][0]) for i in range(n_samples)])
         data = (np.pad(raw.reshape(-1, 1, 28, 28).astype(np.float32) / 255.0,
                        ((0, 0), (0, 0), (2, 2), (2, 2))) * 2 - 1)
     else:

@@ -105,7 +105,9 @@ def main():
         ppl = math.exp(min(total_loss / max(n_batches, 1), 20))
         print(f"epoch {epoch}: loss {total_loss / max(n_batches, 1):.4f} "
               f"ppl {ppl:.2f}")
-    model.export("word_lm")
+    # RNN layers are stateful over batch size, so the symbolic export path
+    # doesn't apply; checkpoint the weights directly
+    model.save_parameters("word_lm.params")
     return total_loss / max(n_batches, 1)
 
 

@@ -18,6 +18,10 @@ from .densenet import (  # noqa: F401
     DenseNet, densenet121, densenet161, densenet169, densenet201,
 )
 from .inception import Inception3, inception_v3  # noqa: F401
+from .resnext import (  # noqa: F401
+    ResNext, get_resnext, resnext50_32x4d, resnext101_32x4d,
+    se_resnext50_32x4d, se_resnext101_32x4d,
+)
 
 _models = {
     "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1, "resnet50_v1": resnet50_v1,
@@ -34,6 +38,9 @@ _models = {
     "densenet121": densenet121, "densenet161": densenet161,
     "densenet169": densenet169, "densenet201": densenet201,
     "inceptionv3": inception_v3,
+    "resnext50_32x4d": resnext50_32x4d, "resnext101_32x4d": resnext101_32x4d,
+    "se_resnext50_32x4d": se_resnext50_32x4d,
+    "se_resnext101_32x4d": se_resnext101_32x4d,
 }
 
 

@@ -221,13 +221,17 @@ class ParameterDict:
             # block built with ``params=other.params`` shares by the
             # UNPREFIXED name — e.g. tied-embedding decoders:
             # Dense(..., params=encoder.params) resolves "weight" to the
-            # encoder's "<encoder_prefix>weight" parameter
+            # encoder's "<encoder_prefix>weight" parameter. The tie is
+            # stored under the parameter's CANONICAL name so that
+            # collect_params() merging dedupes it — otherwise the Trainer
+            # would register the tied table twice (double optimizer state,
+            # double allreduce contribution).
             shared_prefix = getattr(self._shared, "prefix", "")
             alt = shared_prefix + raw
             if alt in self._shared:
-                self._params[name] = self._check_shared(
-                    self._shared[alt], name, kwargs)
-                return self._params[name]
+                p = self._check_shared(self._shared[alt], name, kwargs)
+                self._params[p.name] = p
+                return p
         p = Parameter(name, **kwargs)
         self._params[name] = p
         return p

@@ -12,6 +12,8 @@ MXNet quirks preserved on purpose:
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -518,14 +520,10 @@ register("degrees")(lambda x: jnp.degrees(x))
 register("radians")(lambda x: jnp.radians(x))
 
 
-@register("make_loss", aliases=("MakeLoss",))
-def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
-    """Mark an output as a loss head (reference make_loss op): forward is
-    IDENTITY; grad_scale and normalization shape only the backward signal —
-    'batch' divides by batch size, 'valid' by the count of entries above
-    valid_thresh, 'null' applies grad_scale alone."""
-    import jax
-
+@functools.lru_cache(maxsize=None)
+def _make_loss_fn(grad_scale, valid_thresh, normalization):
+    # one custom_vjp per distinct config, cached so repeated make_loss calls
+    # reuse the same traced function (fresh closures would retrace per call)
     @jax.custom_vjp
     def _ml(x):
         return x
@@ -544,7 +542,17 @@ def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
         return ((g * scale).astype(x.dtype),)
 
     _ml.defvjp(_fwd, _bwd)
-    return _ml(data)
+    return _ml
+
+
+@register("make_loss", aliases=("MakeLoss",))
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    """Mark an output as a loss head (reference make_loss op): forward is
+    IDENTITY; grad_scale and normalization shape only the backward signal —
+    'batch' divides by batch size, 'valid' by the count of entries above
+    valid_thresh, 'null' applies grad_scale alone."""
+    return _make_loss_fn(float(grad_scale), float(valid_thresh),
+                         str(normalization))(data)
 
 
 @register("SVMOutput", aliases=("svm_output",))

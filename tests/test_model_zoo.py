@@ -9,7 +9,7 @@ from mxnet_tpu import gluon, nd
     ("resnet34_v2", 32), ("vgg11", 32), ("vgg11_bn", 32),
     ("mobilenet0.25", 32), ("mobilenetv2_0.5", 32),
     ("squeezenet1.1", 64), ("densenet121", 32), ("alexnet", 224),
-    ("inceptionv3", 299),
+    ("inceptionv3", 299), ("resnext50_32x4d", 64), ("se_resnext50_32x4d", 64),
 ])
 def test_zoo_forward(name, size):
     net = gluon.model_zoo.get_model(name, classes=11)
@@ -26,7 +26,7 @@ def test_zoo_unknown_model():
 @pytest.mark.parametrize("name,size", [
     ("lenet", 28), ("resnet18_v1", 32), ("vgg11", 32), ("alexnet", 224),
     ("squeezenet1.0", 64), ("densenet121", 32), ("inceptionv3", 299),
-    ("mobilenet0.25", 32),
+    ("mobilenet0.25", 32), ("se_resnext50_32x4d", 64),
 ])
 def test_zoo_hybridize_equivalence(name, size):
     """Eager forward == hybridized forward for every zoo family — THE core
