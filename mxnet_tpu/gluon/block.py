@@ -214,6 +214,16 @@ class Block:
             ret.update({k: v for k, v in self._params.items() if pat.match(k)})
         for child in self._children.values():
             ret.update(child.collect_params(select))
+        # tied parameters (params= sharing) appear under each sharer's local
+        # name — keep the first occurrence only, so Trainer/optimizer see one
+        # entry (no double state, no double allreduce contribution)
+        seen = set()
+        for k in list(ret.keys()):
+            pid = id(ret[k])
+            if pid in seen:
+                ret.pop(k)
+            else:
+                seen.add(pid)
         return ret
 
     def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):

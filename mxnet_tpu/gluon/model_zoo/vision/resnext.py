@@ -61,8 +61,10 @@ class Block(HybridBlock):
         x = self.body(x)
         if self.se is not None:
             w = F.Pooling(x, global_pool=True, pool_type="avg")
-            w = self.se(w.reshape(w.shape[0], -1))
-            x = F.broadcast_mul(x, w.reshape(w.shape[0], -1, 1, 1))
+            # shape-free reshape codes (0 = copy dim) keep the SE branch
+            # exportable: Symbols have no .shape to read
+            w = self.se(F.reshape(w, shape=(0, -1)))
+            x = F.broadcast_mul(x, F.reshape(w, shape=(0, -1, 1, 1)))
         if self.downsample is not None:
             residual = self.downsample(residual)
         return F.Activation(x + residual, act_type="relu")

@@ -221,17 +221,16 @@ class ParameterDict:
             # block built with ``params=other.params`` shares by the
             # UNPREFIXED name — e.g. tied-embedding decoders:
             # Dense(..., params=encoder.params) resolves "weight" to the
-            # encoder's "<encoder_prefix>weight" parameter. The tie is
-            # stored under the parameter's CANONICAL name so that
-            # collect_params() merging dedupes it — otherwise the Trainer
-            # would register the tied table twice (double optimizer state,
-            # double allreduce contribution).
+            # encoder's "<encoder_prefix>weight" parameter. Stored under the
+            # LOCAL name (prefix-based save/load and select-regexes keep
+            # working on the sharing block); Block.collect_params dedupes
+            # the tie by object identity so the Trainer sees it once.
             shared_prefix = getattr(self._shared, "prefix", "")
             alt = shared_prefix + raw
             if alt in self._shared:
-                p = self._check_shared(self._shared[alt], name, kwargs)
-                self._params[p.name] = p
-                return p
+                self._params[name] = self._check_shared(
+                    self._shared[alt], name, kwargs)
+                return self._params[name]
         p = Parameter(name, **kwargs)
         self._params[name] = p
         return p
@@ -241,6 +240,9 @@ class ParameterDict:
         if name not in self._params:
             self._params[name] = Constant(name, value)
         return self._params[name]
+
+    def pop(self, name, default=None):
+        return self._params.pop(name, default)
 
     def update(self, other):
         for k, v in other.items():
