@@ -74,13 +74,17 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False):
                 # ring steps where the visiting K/V shard lies entirely in
                 # the future (src_idx > idx) are fully masked — branch them
                 # out instead of computing-then-masking, saving ~half the
-                # attention FLOPs across the ring on average
-                q_pos = idx * Tq + lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0)
-                k_pos = src_idx * Tk + lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
-                mask = (q_pos >= k_pos)[None, None]
-
+                # attention FLOPs across the ring on average. The mask is
+                # built INSIDE the branch: cond hoists closed-over values,
+                # so constructing it outside would materialize the (Tq, Tk)
+                # iotas on skipped steps too.
                 def _compute(args):
                     m, l, acc = args
+                    q_pos = idx * Tq + lax.broadcasted_iota(
+                        jnp.int32, (Tq, Tk), 0)
+                    k_pos = src_idx * Tk + lax.broadcasted_iota(
+                        jnp.int32, (Tq, Tk), 1)
+                    mask = (q_pos >= k_pos)[None, None]
                     return _block_attn(q_blk, k_cur, v_cur, m, l, acc, scale,
                                        mask)
 

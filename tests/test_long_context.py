@@ -1,0 +1,29 @@
+"""Long-context example smoke (SURVEY §5.7): sequence-parallel ring
+attention fwd+bwd over the virtual sp mesh, and a flash-length single-chip
+LM step."""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+
+def test_ring_lm_step_over_sp_mesh():
+    from long_context_lm import build_sp_mesh, ring_lm_step
+
+    mesh = build_sp_mesh(8)
+    val, shapes = ring_lm_step(mesh, batch=1, heads=2, seq_global=1024, d=16)
+    assert np.isfinite(val) and val > 0
+    assert shapes == [(1, 2, 1024, 16)] * 3
+
+
+def test_single_chip_long_seq_lm_trains():
+    from long_context_lm import single_chip_flash_lm
+
+    # CPU path: attention takes the einsum branch (flash gates on TPU), but
+    # the script is identical to what runs flash on hardware
+    losses = single_chip_flash_lm(seq=512, steps=3, vocab=64, units=64,
+                                  heads=2)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

@@ -66,9 +66,9 @@ def test_word_lm_tied_weights():
     enc_w = m.encoder.params.get("weight")
     dec_w = m.decoder.params.get("weight")
     assert enc_w is dec_w
-    # and the tie is stored under its canonical name, so collect_params
-    # dedupes it — Trainer must see the table exactly once (no double
-    # optimizer state / double allreduce)
+    # the tie lives under each sharer's local name, and collect_params
+    # dedupes by object identity — Trainer must see the table exactly once
+    # (no double optimizer state / double allreduce)
     all_params = m.collect_params()
     hits = [n for n, p in all_params.items() if p is enc_w]
     assert len(hits) == 1, hits
