@@ -22,8 +22,10 @@ import numpy as np
 
 # on a CPU host, expose 8 virtual devices so the sp mesh actually rotates;
 # harmless on a real TPU slice (the flag only shapes the host platform) —
-# must be set before jax's first import
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# must be set (appended, not clobbered) before jax's first import
+_FLAG = "--xla_force_host_platform_device_count=8"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
 
 
 def build_sp_mesh(n_devices=None):
@@ -32,6 +34,12 @@ def build_sp_mesh(n_devices=None):
 
     devs = jax.devices()
     n = n_devices or len(devs)
+    if len(devs) < (n_devices or 2):
+        # a 1-device "ring" never rotates — the demo would silently prove
+        # nothing (e.g. jax was imported before our XLA_FLAGS edit)
+        raise RuntimeError(
+            f"only {len(devs)} device(s) visible; the sp mesh needs >= 2 "
+            "(is jax pre-imported with a different XLA_FLAGS?)")
     return Mesh(np.array(devs[:n]), ("sp",))
 
 

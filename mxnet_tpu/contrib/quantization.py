@@ -25,7 +25,7 @@ from ..registry import register
 
 __all__ = ["quantize_array", "dequantize_array", "calib_minmax", "calib_entropy",
            "quantize_net", "quantized_fully_connected", "quantized_conv",
-           "convert_to_int8", "QuantizedDense"]
+           "convert_to_int8", "QuantizedDense", "QuantizedConv2D"]
 
 
 def quantize_array(x, scale=None, axis=None):
@@ -50,28 +50,46 @@ def calib_minmax(samples):
 
 
 def calib_entropy(samples, num_bins=2048, num_quantized_bins=255):
-    """KL-divergence (entropy) calibration, reference algorithm shape."""
+    """KL-divergence (entropy) calibration, reference algorithm shape.
+
+    The KL is taken between the FULL histogram and the clip-then-quantize
+    approximation expanded back over all bins — comparing only the sliced
+    prefix (as a naive reading of the algorithm does) scores every
+    threshold below 255 bins as lossless (KL = 0), because the clipping
+    error itself never enters the objective, and the search then collapses
+    to the smallest candidate. With full-support comparison, clipped tail
+    mass piled into the threshold bin is penalized wherever the true
+    distribution actually extends past the threshold (bounded tanh-like
+    activations keep ~amax; long-tail relu-like ones clip their outliers).
+    """
     data = np.abs(np.concatenate([np.asarray(s).ravel() for s in samples]))
-    amax = data.max() + 1e-12
+    amax = float(data.max()) + 1e-12
     hist, edges = np.histogram(data, bins=num_bins, range=(0, amax))
+    p_full = hist.astype(np.float64)
+    total = p_full.sum()
+    if total == 0:
+        return amax / 127.0
+    p_full /= total
+    eps = 1e-10
     best_kl, best_t = np.inf, amax
-    for i in range(num_quantized_bins // 2, num_bins + 1, num_bins // 64 or 1):
+    for i in range(num_quantized_bins, num_bins + 1, num_bins // 64 or 1):
         t = edges[i] if i < len(edges) else amax
-        p = hist[:i].astype(np.float64).copy()
-        p[-1] += hist[i:].sum()  # clip outliers into last bin
-        if p.sum() == 0:
-            continue
-        # quantize p into num_quantized_bins then expand back
+        # clip: tail mass lands in the threshold bin
+        clipped = p_full[:i].copy()
+        clipped[-1] += p_full[i:].sum()
+        # quantize the clipped range into num_quantized_bins levels
         factor = max(1, i // num_quantized_bins)
-        q = np.zeros_like(p)
+        q = np.zeros(i)
         for j in range(0, i, factor):
-            chunk = p[j:j + factor]
-            nz = (chunk > 0).sum()
+            chunk = clipped[j:j + factor]
+            nz = int((chunk > 0).sum())
             if nz:
-                q[j:j + factor] = np.where(chunk > 0, chunk.sum() / nz, 0)
-        pn, qn = p / p.sum(), q / max(q.sum(), 1e-12)
-        mask = pn > 0
-        kl = float(np.sum(pn[mask] * np.log(pn[mask] / np.maximum(qn[mask], 1e-12))))
+                q[j:j + factor] = np.where(chunk > 0, chunk.sum() / nz, 0.0)
+        q_full = np.concatenate([q, np.zeros(num_bins - i)])
+        q_full = q_full / max(q_full.sum(), eps)
+        pe = p_full + eps
+        qe = q_full + eps
+        kl = float(np.sum(pe * np.log(pe / qe)))
         if kl < best_kl:
             best_kl, best_t = kl, t
     return best_t / 127.0
@@ -127,20 +145,29 @@ def quantized_conv(dataq, weightq, bias=None, kernel=None, stride=(1, 1),
     return out.astype(out_dtype)
 
 
-class QuantizedDense:
-    """Inference-only replacement for ``gluon.nn.Dense`` holding int8 weights
-    (produced by :func:`convert_to_int8`). Activations are quantized with the
-    calibrated static scale when available, else dynamically per batch."""
+class _QuantizedLayer:
+    """Shared int8-inference plumbing for the converted layer wrappers:
+    NDArray unwrap, static-or-dynamic activation scale, int8 clip/round,
+    full Activation-registry tail, dtype restore. Subclasses supply
+    ``_compute(xq, a_scale)``."""
 
-    def __init__(self, wq, w_scale, bias=None, activation=None, act_scale=None):
+    def __init__(self, wq, w_scale, bias=None, activation=None,
+                 act_scale=None):
         self._wq = wq
         self._ws = jnp.ravel(jnp.asarray(w_scale, jnp.float32))
         self._bias = bias
         self._act = activation
         self._act_scale = act_scale
 
+    def _bias_raw(self):
+        from ..ndarray import NDArray
+
+        return (self._bias._data if isinstance(self._bias, NDArray)
+                else self._bias)
+
     def __call__(self, x):
         from ..ndarray import NDArray
+        from ..ops.nn import activation as _activation
 
         data = x._data if isinstance(x, NDArray) else jnp.asarray(x)
         orig_dtype = data.dtype
@@ -149,25 +176,64 @@ class QuantizedDense:
                    if self._act_scale is not None
                    else jnp.max(jnp.abs(xf)) / 127.0 + 1e-12)
         xq = jnp.clip(jnp.round(xf / a_scale), -127, 127).astype(jnp.int8)
-        out = quantized_fully_connected(
-            xq, self._wq,
-            bias=self._bias._data if isinstance(self._bias, NDArray)
-            else self._bias,
-            data_scale=a_scale, weight_scale=self._ws)
-        if self._act == "relu":
-            out = jnp.maximum(out, 0)
-        elif self._act == "tanh":
-            out = jnp.tanh(out)
+        out = self._compute(xq, a_scale)
+        if self._act is not None:
+            # the full Activation registry (relu/sigmoid/tanh/softrelu/...)
+            # — silently dropping an unknown activation would emit
+            # pre-activation values with no error
+            out = _activation(out, act_type=self._act)
         return NDArray(out.astype(orig_dtype))
 
 
-def convert_to_int8(net, calib_data=None, exclude_patterns=("embed",)):
-    """Swap every ``Dense`` child of a Gluon block tree for a
-    :class:`QuantizedDense` with real int8 weights. Returns the (mutated)
-    net and {layer_name: weight_scale}. With ``calib_data`` (list of input
-    batches), activation scales are calibrated min-max by running the f32 net
-    once with capture hooks; otherwise activations quantize dynamically."""
+class QuantizedDense(_QuantizedLayer):
+    """Inference-only replacement for ``gluon.nn.Dense`` holding int8 weights
+    (produced by :func:`convert_to_int8`). Activations are quantized with the
+    calibrated static scale when available, else dynamically per batch."""
+
+    def _compute(self, xq, a_scale):
+        return quantized_fully_connected(
+            xq, self._wq, bias=self._bias_raw(),
+            data_scale=a_scale, weight_scale=self._ws)
+
+
+class QuantizedConv2D(_QuantizedLayer):
+    """Inference-only replacement for ``gluon.nn.Conv2D`` holding int8
+    weights (produced by :func:`convert_to_int8`)."""
+
+    def __init__(self, wq, w_scale, bias, kernel, strides, padding, dilation,
+                 groups, activation=None, act_scale=None):
+        super().__init__(wq, w_scale, bias=bias, activation=activation,
+                         act_scale=act_scale)
+        self._kernel = kernel
+        self._strides = strides
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+
+    def _compute(self, xq, a_scale):
+        return quantized_conv(
+            xq, self._wq, bias=self._bias_raw(),
+            kernel=self._kernel, stride=self._strides, pad=self._padding,
+            dilate=self._dilation, num_group=self._groups,
+            data_scale=a_scale, weight_scale=self._ws)
+
+
+def convert_to_int8(net, calib_data=None, exclude_patterns=("embed",),
+                    calib_mode="minmax"):
+    """Swap every ``Dense`` and ``Conv2D`` child of a Gluon block tree for
+    its int8 counterpart (s8×s8→s32 with one requant scale). Returns the
+    (mutated) net and {layer_name: weight_scale}. With ``calib_data`` (list
+    of input batches), activation scales come from running the f32 net once
+    with capture hooks — ``calib_mode`` picks min-max or KL-divergence
+    (entropy) thresholding (reference calibration modes); otherwise
+    activations quantize dynamically per batch."""
     from ..gluon import nn as _gnn
+
+    if calib_mode not in ("minmax", "entropy"):
+        raise ValueError(f"calib_mode must be minmax|entropy, got {calib_mode}")
+
+    def _quantizable(child):
+        return isinstance(child, (_gnn.Dense, _gnn.Conv2D))
 
     # run eagerly from here on: stale jit programs would bypass the calib
     # hooks (and keep executing f32 after conversion), and tracing through a
@@ -181,40 +247,60 @@ def convert_to_int8(net, calib_data=None, exclude_patterns=("embed",)):
     act_stats = {}
     if calib_data is not None:
         hooked = []
+        samples = {}
 
         def _capture(blk, name):
             orig = blk.forward
 
             def fwd(x, *a, **k):
-                act_stats.setdefault(name, 0.0)
-                act_stats[name] = max(act_stats[name],
-                                      float(jnp.max(jnp.abs(x._data))))
+                if calib_mode == "entropy":
+                    # bounded histogram sample per layer; .copy() detaches
+                    # the strided view from the full activation buffer
+                    xa = np.abs(np.asarray(x._data)).ravel()
+                    if xa.size > 65536:
+                        xa = xa[:: xa.size // 65536 + 1]
+                    samples.setdefault(name, []).append(xa.copy())
+                else:
+                    # device-side reduction: only a scalar crosses to host
+                    act_stats[name] = max(act_stats.get(name, 0.0),
+                                          float(jnp.max(jnp.abs(x._data))))
                 return orig(x, *a, **k)
 
             blk.forward = fwd
             hooked.append((blk, orig))
 
         for name, child in _walk_blocks(net):
-            if isinstance(child, _gnn.Dense):
+            if _quantizable(child):
                 _capture(child, name)
         for batch in calib_data:
             net(batch)
         for blk, orig in hooked:
             blk.forward = orig
+        if calib_mode == "entropy":
+            for name, chunks in samples.items():
+                # calib_entropy returns the scale directly (threshold/127)
+                act_stats[name] = 127.0 * calib_entropy(chunks)
 
     scales = {}
     for parent, key, child, name in _walk_children(net):
-        if not isinstance(child, _gnn.Dense):
+        if not _quantizable(child):
             continue
         if any(s in name for s in exclude_patterns) or child.weight._nd is None:
             continue
         wq, ws = quantize_array(child.weight.data()._data, axis=0)
         bias = child.bias.data() if child.bias is not None and child.bias._nd is not None else None
         a_scale = (act_stats[name] / 127.0 + 1e-12) if name in act_stats else None
-        qd = QuantizedDense(wq, ws, bias=bias,
-                            activation=getattr(child, "_act", None),
-                            act_scale=a_scale)
-        parent._children[key] = qd
+        if isinstance(child, _gnn.Dense):
+            q = QuantizedDense(wq, ws, bias=bias,
+                               activation=getattr(child, "_act", None),
+                               act_scale=a_scale)
+        else:
+            q = QuantizedConv2D(wq, ws, bias, child._kernel, child._strides,
+                                child._padding, child._dilation,
+                                child._groups,
+                                activation=getattr(child, "_act", None),
+                                act_scale=a_scale)
+        parent._children[key] = q
         scales[name] = np.asarray(ws)
     return net, scales
 
