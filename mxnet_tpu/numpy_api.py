@@ -126,12 +126,38 @@ np.ones = lambda shape, dtype="float32", ctx=None, device=None: nd.ones(shape, c
 np.full = lambda shape, fill_value, dtype="float32", ctx=None: nd.full(shape, fill_value, ctx, dtype)
 np.float32 = "float32"
 np.float16 = "float16"
+np.float64 = "float64"
 np.int32 = "int32"
 np.int64 = "int64"
 np.bool_ = "bool"
 np.pi = jnp.pi
+np.e = jnp.e
 np.inf = jnp.inf
+np.nan = jnp.nan
 np.newaxis = None
+np.empty = np.zeros  # XLA has no uninitialized alloc; zeros is the analog
+np.identity = lambda n, dtype="float32": nd.eye(n, dtype=dtype)
+np.absolute = nd.abs
+np.tan = nd.tan
+np.all = _wrap1(lambda a, **k: jnp.all(jnp.asarray(a), **k))
+np.any = _wrap1(lambda a, **k: jnp.any(jnp.asarray(a), **k))
+np.nonzero = lambda a: tuple(
+    NDArray(i) for i in jnp.nonzero(jnp.asarray(_unwrap_in(a))))
+
+# np.linalg subnamespace (reference: mxnet.np.linalg over the linalg ops)
+linalg = types.ModuleType("mxnet_tpu.np.linalg")
+linalg.norm = lambda a, ord=None, axis=None, keepdims=False: NDArray(
+    jnp.linalg.norm(a._data, ord=ord, axis=axis, keepdims=keepdims))
+linalg.inv = lambda a: nd.linalg_inverse(a)
+linalg.det = lambda a: nd.linalg_det(a)
+linalg.slogdet = lambda a: nd.linalg_slogdet(a)
+linalg.cholesky = lambda a: nd.linalg_potrf(a)
+linalg.svd = lambda a: tuple(NDArray(x) for x in jnp.linalg.svd(
+    a._data, full_matrices=False))
+linalg.eigh = lambda a: tuple(NDArray(x) for x in jnp.linalg.eigh(a._data))
+linalg.solve = lambda a, b: NDArray(jnp.linalg.solve(a._data, b._data))
+np.linalg = linalg
+sys.modules["mxnet_tpu.np.linalg"] = linalg
 
 # npx extension surface
 npx.softmax = lambda x, axis=-1: nd.softmax(x, axis=axis)

@@ -414,3 +414,24 @@ def test_sample_family_seed_reproducible():
     mx.random.seed(123)
     b = nd.sample_normal(mu, sig, shape=(4,)).asnumpy()
     np.testing.assert_array_equal(a, b)
+
+
+def test_np_linalg_and_logic_surface():
+    import mxnet_tpu as mx
+
+    a = mx.np.array([[4.0, 2.0], [2.0, 3.0]])
+    np.testing.assert_allclose(float(mx.np.linalg.det(a).asnumpy()), 8.0,
+                               rtol=1e-5)
+    L = mx.np.linalg.cholesky(a)
+    np.testing.assert_allclose(
+        (L.asnumpy() @ L.asnumpy().T), a.asnumpy(), rtol=1e-5, atol=1e-6)
+    x = mx.np.linalg.solve(a, mx.np.array([1.0, 2.0]))
+    np.testing.assert_allclose(a.asnumpy() @ x.asnumpy(), [1.0, 2.0],
+                               rtol=1e-5, atol=1e-6)
+    nrm = mx.np.linalg.norm(mx.np.array([3.0, 4.0]))
+    np.testing.assert_allclose(float(nrm.asnumpy()), 5.0, rtol=1e-6)
+    assert bool(mx.np.all(a > 0).asnumpy())
+    assert not bool(mx.np.any(a > 10).asnumpy())
+    (idx,) = mx.np.nonzero(mx.np.array([0.0, 5.0, 0.0, 7.0]))
+    np.testing.assert_array_equal(idx.asnumpy(), [1, 3])
+    np.testing.assert_allclose(mx.np.identity(2).asnumpy(), np.eye(2))

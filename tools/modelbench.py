@@ -123,10 +123,10 @@ def bench_gpt2(steps, kind, name="gpt2_345m", batch=4, seq=1024):
                          f"options {sorted(gpt2.gpt2_configs)}"}
     cfg0 = gpt2.gpt2_configs[name]
     seq = min(seq, cfg0["max_length"])  # OOB positions would embed garbage
+    cfg = cfg0
     while batch >= 1:
         try:
             mx.random.seed(0)
-            cfg = gpt2.gpt2_configs[name]
             net = gpt2.GPT2Model(**cfg, dropout=0.0)
             net.initialize()
             rs = np.random.RandomState(0)
@@ -137,14 +137,12 @@ def bench_gpt2(steps, kind, name="gpt2_345m", batch=4, seq=1024):
             _ = net(ids)
             net.cast("bfloat16")
 
-            def loss_fn(out, labels):
-                return gpt2.lm_loss(out, labels)
-
-            ts = TrainStep(net, loss_fn, optimizer.Adam(learning_rate=1e-4),
+            ts = TrainStep(net, gpt2.lm_loss,
+                           optimizer.Adam(learning_rate=1e-4),
                            mesh=None, n_model_inputs=1)
             L, U, H, V = (cfg["num_layers"], cfg["units"],
-                          cfg["hidden_size"] if "hidden_size" in cfg
-                          else 4 * cfg["units"], cfg["vocab_size"])
+                          cfg.get("hidden_size", 4 * cfg["units"]),
+                          cfg["vocab_size"])
             per_tok = (4 * U * U + 2 * U * H + 2 * seq * U) * 2 * L
             flops = 3 * batch * seq * (per_tok + U * V * 2)
             res = _measure(ts, (ids, labels), steps, flops, kind)
@@ -165,7 +163,29 @@ def main():
     ap.add_argument("--models", default="resnet50,gpt2_345m")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--json", default=None)
+    ap.add_argument("--probe-timeout", type=int, default=90)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) before backend "
+                         "init; skips the TPU probe")
     args = ap.parse_args()
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    else:
+        # the axon plugin can hang forever inside jax.devices() when the
+        # tunnel is down (bench.py's round-1 failure mode) — probe in a
+        # subprocess with a hard timeout before this process touches the
+        # backend
+        from bench import _probe_backend
+
+        probe = _probe_backend(args.probe_timeout, retries=1)
+        if probe is None:
+            print(json.dumps({"error": "backend probe hung/crashed "
+                              f"({args.probe_timeout}s); not touching jax"}),
+                  flush=True)
+            return
 
     import jax
 
