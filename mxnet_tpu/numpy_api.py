@@ -146,16 +146,17 @@ np.nonzero = lambda a: tuple(
 
 # np.linalg subnamespace (reference: mxnet.np.linalg over the linalg ops)
 linalg = types.ModuleType("mxnet_tpu.np.linalg")
-linalg.norm = lambda a, ord=None, axis=None, keepdims=False: NDArray(
-    jnp.linalg.norm(a._data, ord=ord, axis=axis, keepdims=keepdims))
+linalg.norm = _wrap1(jnp.linalg.norm)
 linalg.inv = lambda a: nd.linalg_inverse(a)
 linalg.det = lambda a: nd.linalg_det(a)
 linalg.slogdet = lambda a: nd.linalg_slogdet(a)
 linalg.cholesky = lambda a: nd.linalg_potrf(a)
 linalg.svd = lambda a: tuple(NDArray(x) for x in jnp.linalg.svd(
-    a._data, full_matrices=False))
-linalg.eigh = lambda a: tuple(NDArray(x) for x in jnp.linalg.eigh(a._data))
-linalg.solve = lambda a, b: NDArray(jnp.linalg.solve(a._data, b._data))
+    jnp.asarray(_unwrap_in(a)), full_matrices=False))
+linalg.eigh = lambda a: tuple(NDArray(x) for x in jnp.linalg.eigh(
+    jnp.asarray(_unwrap_in(a))))
+linalg.solve = lambda a, b: NDArray(jnp.linalg.solve(
+    jnp.asarray(_unwrap_in(a)), jnp.asarray(_unwrap_in(b))))
 np.linalg = linalg
 sys.modules["mxnet_tpu.np.linalg"] = linalg
 

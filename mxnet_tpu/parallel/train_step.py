@@ -100,8 +100,27 @@ class TrainStep:
         raw = loss._data if isinstance(loss, NDArray) else loss
         return jnp.mean(raw.astype(jnp.float32))
 
+    def _resolve_mults(self):
+        """Static per-name lr/wd multipliers, resolving the same channels as
+        Optimizer._get_lr/_get_wd (Parameter attrs, opt.set_lr_mult/
+        set_wd_mult, opt.param_dict) so TrainStep and the imperative Trainer
+        freeze/scale the same parameters. Snapshot at compile time — the
+        multipliers fold into the jitted program as constants."""
+        opt = self.optimizer
+        lr_mult, wd_mult = {}, {}
+        for p in self._plist:
+            pd = opt.param_dict.get(p.name, p)
+            lm = float(getattr(p, "lr_mult", 1.0)) \
+                * float(getattr(pd, "lr_mult", 1.0) if pd is not p else 1.0)
+            wm = float(getattr(p, "wd_mult", 1.0)) \
+                * float(getattr(pd, "wd_mult", 1.0) if pd is not p else 1.0)
+            lr_mult[p.name] = lm * float(opt.lr_mult.get(p.name, 1.0))
+            wd_mult[p.name] = wm * float(opt.wd_mult.get(p.name, 1.0))
+        return lr_mult, wd_mult
+
     def _make_step(self, n_batch):
         opt = self.optimizer
+        lr_mult, wd_mult = self._resolve_mults()
 
         def step(params, opt_state, step_count, batch, key, lr, wd):
             loss, grads = jax.value_and_grad(self._loss_of)(params, batch, key)
@@ -111,7 +130,9 @@ class TrainStep:
                 if name not in opt_state:
                     continue
                 w, g = params[name], grads[name]
-                nw, ns = opt.update_raw(w, g, opt_state[name], lr, wd, t)
+                nw, ns = opt.update_raw(w, g, opt_state[name],
+                                        lr * lr_mult.get(name, 1.0),
+                                        wd * wd_mult.get(name, 1.0), t)
                 new_params[name] = nw
                 new_state[name] = ns
             return new_params, new_state, t, loss
