@@ -37,6 +37,7 @@ from . import module  # noqa: F401
 from . import module as mod  # noqa: F401
 from . import operator  # noqa: F401
 from . import rnn  # noqa: F401
+from . import model  # noqa: F401
 from . import monitor  # noqa: F401
 from .monitor import Monitor  # noqa: F401
 from . import visualization  # noqa: F401

@@ -109,11 +109,12 @@ class TrainStep:
         opt = self.optimizer
         lr_mult, wd_mult = {}, {}
         for p in self._plist:
-            pd = opt.param_dict.get(p.name, p)
-            lm = float(getattr(p, "lr_mult", 1.0)) \
-                * float(getattr(pd, "lr_mult", 1.0) if pd is not p else 1.0)
-            wm = float(getattr(p, "wd_mult", 1.0)) \
-                * float(getattr(pd, "wd_mult", 1.0) if pd is not p else 1.0)
+            # mirror Optimizer._get_lr exactly: the param_dict entry (when
+            # present) REPLACES the Parameter as the attribute source, then
+            # the name-keyed set_lr_mult dict multiplies on top
+            src = opt.param_dict.get(p.name, p)
+            lm = float(getattr(src, "lr_mult", 1.0))
+            wm = float(getattr(src, "wd_mult", 1.0))
             lr_mult[p.name] = lm * float(opt.lr_mult.get(p.name, 1.0))
             wd_mult[p.name] = wm * float(opt.wd_mult.get(p.name, 1.0))
         return lr_mult, wd_mult

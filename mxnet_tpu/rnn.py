@@ -203,3 +203,113 @@ class BidirectionalCell(BaseRNNCell):
         if merge_outputs:
             outs = sym.stack(*outs, axis=layout.find("T"))
         return outs, l_states + r_states
+
+
+class BucketSentenceIter:
+    """Bucketing data iterator for variable-length sequences (reference:
+    ``python/mxnet/rnn/io.py`` BucketSentenceIter — the classic companion of
+    :class:`~mxnet_tpu.module.BucketingModule`).
+
+    ``sentences`` is a list of id-lists; each is placed in the smallest
+    bucket that fits (longer ones are dropped, like the reference), padded
+    with ``invalid_label``, and yielded as :class:`io.DataBatch` with
+    ``bucket_key`` = the bucket length, so BucketingModule compiles one
+    program per bucket.
+    """
+
+    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 layout="NT", shuffle_seed=None):
+        import numpy as _onp
+
+        if layout not in ("NT", "TN"):
+            raise ValueError(f"layout must be 'NT' or 'TN', got {layout!r}")
+        self.layout = layout
+        if buckets is None:
+            lens = sorted({len(s) for s in sentences if len(s) > 0})
+            buckets = lens[-8:] if len(lens) > 8 else lens
+        if not buckets:
+            raise ValueError("BucketSentenceIter: no buckets — pass buckets= "
+                             "or provide at least one non-empty sentence")
+        self.buckets = sorted(buckets)
+        self.batch_size = batch_size
+        self.invalid_label = invalid_label
+        self.data_name = data_name
+        self.label_name = label_name
+        self.dtype = dtype
+        self._rs = _onp.random.RandomState(shuffle_seed)
+        self._shuffle = shuffle_seed is not None
+
+        self.data = [[] for _ in self.buckets]
+        n_dropped = 0
+        for s in sentences:
+            if not len(s):
+                continue
+            for i, blen in enumerate(self.buckets):
+                if len(s) <= blen:
+                    row = _onp.full(blen, invalid_label, _onp.int64)
+                    row[: len(s)] = s
+                    self.data[i].append(row)
+                    break
+            else:
+                n_dropped += 1
+        if n_dropped:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "BucketSentenceIter: dropped %d sentences longer than the "
+                "largest bucket (%d)", n_dropped, self.buckets[-1])
+        self.data = [_onp.asarray(rows) if rows
+                     else _onp.empty((0, blen), _onp.int64)
+                     for rows, blen in zip(self.data, self.buckets)]
+        self.default_bucket_key = max(self.buckets)
+        shape = ((batch_size, self.default_bucket_key) if layout == "NT"
+                 else (self.default_bucket_key, batch_size))
+        self.provide_data = [(data_name, shape)]
+        self.provide_label = [(label_name, shape)]
+        self.reset()
+
+    def reset(self):
+        self._plan = []
+        for i, rows in enumerate(self.data):
+            if self._shuffle:
+                self._rs.shuffle(rows)
+            for j in range(0, len(rows) - self.batch_size + 1,
+                           self.batch_size):
+                self._plan.append((i, j))
+        if self._shuffle:
+            self._rs.shuffle(self._plan)
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        from .io.io import DataBatch
+        from . import nd
+
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        i, j = self._plan[self._cursor]
+        self._cursor += 1
+        blen = self.buckets[i]
+        rows = self.data[i][j: j + self.batch_size]
+        # label = next-token shift, invalid-padded (reference behavior)
+        import numpy as _onp
+
+        labels = _onp.full_like(rows, self.invalid_label)
+        labels[:, :-1] = rows[:, 1:]
+        if self.layout == "TN":
+            rows, labels = rows.T, labels.T
+            shape = (blen, self.batch_size)
+        else:
+            shape = (self.batch_size, blen)
+        return DataBatch(
+            data=[nd.array(rows.astype(self.dtype))],
+            label=[nd.array(labels.astype(self.dtype))],
+            bucket_key=blen,
+            provide_data=[(self.data_name, shape)],
+            provide_label=[(self.label_name, shape)])

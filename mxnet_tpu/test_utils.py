@@ -36,6 +36,19 @@ def default_rtols(dtype):
     return _DEFAULT_RTOL.get(d, 1e-4), _DEFAULT_ATOL.get(d, 1e-5)
 
 
+def list_gpus():
+    """Reference ``test_utils.list_gpus``: CUDA device indices — always []
+    on TPU (feature-gated reference tests then skip their GPU branches)."""
+    return []
+
+
+def list_tpus():
+    import jax
+
+    return list(range(len([d for d in jax.devices()
+                           if d.platform == "tpu"])))
+
+
 def default_context():
     return current_context()
 

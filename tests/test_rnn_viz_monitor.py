@@ -235,3 +235,105 @@ def test_unroll_valid_length_masks_and_selects_states():
     assert abs(o[:, 1]).min() >= 0.0  # batch 1 fully valid (no mask)
     # state for batch 0 equals the output at its last valid step (GRU: h)
     np.testing.assert_allclose(states[0].asnumpy()[0], o[1, 0], rtol=1e-6)
+
+
+def test_bucket_sentence_iter_buckets_and_labels():
+    """BucketSentenceIter (reference rnn/io.py): smallest-fitting bucket,
+    invalid-label padding, next-token-shift labels, per-bucket batches."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    sents = [[1, 2, 3], [4, 5], [6, 7, 8, 9, 10], [11, 12, 13],
+             [14, 15, 16, 17], [18, 19], [20, 21, 22], [23, 24]]
+    it = mx.rnn.BucketSentenceIter(sents, batch_size=2, buckets=[3, 5],
+                                   invalid_label=-1)
+    batches = list(it)
+    assert batches, "no batches"
+    seen_keys = set()
+    for b in batches:
+        seen_keys.add(b.bucket_key)
+        data = b.data[0].asnumpy()
+        label = b.label[0].asnumpy()
+        assert data.shape == (2, b.bucket_key)
+        # labels are data shifted left, invalid-padded at the end
+        np.testing.assert_array_equal(label[:, :-1], data[:, 1:])
+        assert (label[:, -1] == -1).all()
+    assert 3 in seen_keys and 5 in seen_keys
+    # reset() replays the same plan
+    it.reset()
+    assert len(list(it)) == len(batches)
+
+
+def test_bucket_sentence_iter_drops_overlong():
+    import mxnet_tpu as mx
+
+    it = mx.rnn.BucketSentenceIter([[1, 2], [1] * 99], batch_size=1,
+                                   buckets=[4])
+    assert sum(1 for _ in it) == 1  # the 99-token sentence was dropped
+
+
+def test_model_checkpoint_roundtrip_and_feedforward():
+    import tempfile
+    import warnings
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    x = mx.sym.var("data")
+    net = mx.sym.FullyConnected(x, num_hidden=4, name="fc1")
+    arg = {"fc1_weight": nd.ones((4, 3)), "fc1_bias": nd.zeros((4,))}
+    with tempfile.TemporaryDirectory() as td:
+        prefix = td + "/m"
+        mx.model.save_checkpoint(prefix, 3, net, arg)
+        sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 3)
+        assert sorted(arg2) == ["fc1_bias", "fc1_weight"]
+        np.testing.assert_allclose(arg2["fc1_weight"].asnumpy(),
+                                   np.ones((4, 3)))
+        assert aux2 == {}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        ff = mx.model.FeedForward(net, num_epoch=1)
+    assert ff.symbol is net
+    assert mx.test_utils.list_gpus() == []
+
+
+def test_bucket_sentence_iter_tn_layout_and_errors():
+    import numpy as np
+    import pytest
+
+    import mxnet_tpu as mx
+
+    it = mx.rnn.BucketSentenceIter([[1, 2, 3], [4, 5, 6]], batch_size=2,
+                                   buckets=[3], layout="TN")
+    (b,) = list(it)
+    assert b.data[0].shape == (3, 2)  # time-major
+    np.testing.assert_array_equal(b.provide_data[0][1], (3, 2))
+    with pytest.raises(ValueError, match="layout"):
+        mx.rnn.BucketSentenceIter([[1]], batch_size=1, buckets=[2],
+                                  layout="XY")
+    with pytest.raises(ValueError, match="no buckets"):
+        mx.rnn.BucketSentenceIter([[], []], batch_size=1)
+
+
+def test_feedforward_save_without_fit():
+    import tempfile
+    import warnings
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    x = mx.sym.var("data")
+    net = mx.sym.FullyConnected(x, num_hidden=2, name="fc")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        ff = mx.model.FeedForward(net, arg_params={
+            "fc_weight": nd.ones((2, 3)), "fc_bias": nd.zeros((2,))})
+    with tempfile.TemporaryDirectory() as td:
+        ff.save(td + "/m", 0)  # no fit() ran — must not crash
+        _, arg, _ = mx.model.load_checkpoint(td + "/m", 0)
+        np.testing.assert_allclose(arg["fc_weight"].asnumpy(), np.ones((2, 3)))
