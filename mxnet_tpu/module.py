@@ -233,8 +233,14 @@ class Module(BaseModule):
         from . import autograd
 
         head = self._outputs[0]
-        loss = head if head.size == 1 else head.sum()
-        autograd.backward([loss])
+        # non-scalar heads backprop with an implicit ones cotangent
+        # (reference executor semantics; output ops like SoftmaxOutput carry
+        # their own fused gradient and ignore it). Summing here would build
+        # an un-taped op outside the record scope.
+        if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+            out_grads = [out_grads]
+        autograd.backward([head], head_grads=[out_grads[0]] if out_grads
+                          else None)
 
     def update(self):
         ws = list(self._arg_params.values())

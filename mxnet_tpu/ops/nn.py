@@ -13,6 +13,8 @@ compiler-friendly TPU formulation.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -229,13 +231,78 @@ def softmax_cross_entropy(data, label):
     return jnp.sum(nll)
 
 
+@functools.lru_cache(maxsize=None)
+def _softmax_output_fn(grad_scale, ignore_label, use_ignore, normalization,
+                       out_grad, smooth_alpha):
+    """The reference op's FUSED gradient (softmax_output-inl.h): backward
+    w.r.t. data is ``(softmax - smoothed_one_hot(label)) * grad_scale`` —
+    independent of the incoming cotangent unless ``out_grad=True`` (then the
+    cotangent scales it elementwise, reference semantics). This is what lets
+    classic symbols train with SoftmaxOutput as the graph head
+    (Module.backward seeds ones)."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=())
+    def _so(data, label):
+        return jax.nn.softmax(data, axis=-1)
+
+    def _fwd(data, label):
+        p = jax.nn.softmax(data, axis=-1)
+        return p, (p, label)
+
+    def _bwd(res, g):
+        p, label = res
+        idx = label.astype(jnp.int32)
+        k = p.shape[-1]
+        onehot = jax.nn.one_hot(idx, k, dtype=p.dtype)
+        if smooth_alpha:
+            # reference label smoothing: 1-a on the target class, a/(k-1)
+            # spread over the others
+            onehot = onehot * (1.0 - smooth_alpha) \
+                + (1.0 - onehot) * (smooth_alpha / max(k - 1, 1))
+        ds = (p - onehot) * grad_scale
+        if out_grad:
+            ds = ds * g.astype(p.dtype)
+        if use_ignore:
+            keep = (idx != int(ignore_label)).astype(p.dtype)[..., None]
+            ds = ds * keep
+        if normalization == "batch":
+            ds = ds / p.shape[0]
+        elif normalization == "valid" and use_ignore:
+            n = jnp.maximum(jnp.sum(
+                (idx != int(ignore_label)).astype(jnp.float32)), 1.0)
+            ds = ds / n
+        elif normalization == "valid":
+            ds = ds / p.shape[0]
+        # integer labels need float0 cotangents (jax custom_vjp contract)
+        if jnp.issubdtype(label.dtype, jnp.integer):
+            import numpy as _onp
+
+            dlabel = _onp.zeros(label.shape, jax.dtypes.float0)
+        else:
+            dlabel = jnp.zeros_like(label)
+        return ds.astype(p.dtype), dlabel
+
+    _so.defvjp(_fwd, _bwd)
+    return _so
+
+
 @register("SoftmaxOutput", aliases=("softmax_output",))
 def softmax_output(data, label=None, grad_scale=1.0, ignore_label=-1, use_ignore=False,
                    multi_output=False, preserve_shape=False, normalization="null",
                    out_grad=False, smooth_alpha=0.0):
-    """Forward = softmax; the loss-gradient fusion of the reference op is
-    delegated to autograd (loss modules are the blessed path)."""
-    return jax.nn.softmax(data, axis=-1)
+    """Forward = softmax over the last axis. With a label, the backward is
+    the reference's fused ``p - smoothed_one_hot(label)`` (see
+    _softmax_output_fn); label-free calls are plain differentiable softmax."""
+    if label is None:
+        return jax.nn.softmax(data, axis=-1)
+    if multi_output:
+        raise NotImplementedError(
+            "SoftmaxOutput(multi_output=True) (the (n, c, d...) layout) is "
+            "not supported; reshape to (n*d, c) instead")
+    fn = _softmax_output_fn(float(grad_scale), int(ignore_label),
+                            bool(use_ignore), str(normalization),
+                            bool(out_grad), float(smooth_alpha))
+    return fn(data, label)
 
 
 # --------------------------------------------------------------------------

@@ -551,6 +551,18 @@ class Executor:
                 self.arg_dict[k]._data = v._data
 
 
+# ops whose parameter inputs the reference auto-creates as named variables
+# when the caller passes only data (``sym.FullyConnected(x, num_hidden=10)``
+# grows an ``<name>_weight``/``<name>_bias`` — the canonical tutorial form;
+# nnvm's FListInputNames + Symbol::Compose did this upstream)
+_AUTO_PARAM_SUFFIXES = {
+    "FullyConnected": ("weight", "bias"),
+    "Convolution": ("weight", "bias"),
+    "Deconvolution": ("weight", "bias"),
+    "Embedding": ("weight",),
+}
+
+
 def __getattr__(name):
     try:
         opdef = _registry.get(name)
@@ -560,8 +572,37 @@ def __getattr__(name):
     def sym_op(*args, name=None, **kwargs):
         inputs = [a for a in args if isinstance(a, Symbol)]
         data_kw = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
-        inputs.extend(data_kw.values())
         params = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+        suffixes = _AUTO_PARAM_SUFFIXES.get(opdef.name)
+        if suffixes:
+            # resolve by INPUT NAME (reference FListInputNames): slot order
+            # is (data, *suffixes); keyword Symbols land in their named slot,
+            # positional Symbols fill remaining slots left-to-right, and
+            # still-empty param slots get auto-created named variables
+            need = [s for s in suffixes
+                    if not (s == "bias" and params.get("no_bias"))]
+            slot_names = ["data"] + need
+            slots = {k: data_kw.pop(k) for k in list(data_kw)
+                     if k in slot_names}
+            pos = iter(inputs)
+            resolved = []
+            for sn in slot_names:
+                if sn in slots:
+                    resolved.append(slots[sn])
+                else:
+                    nxt = next(pos, None)
+                    resolved.append(nxt)
+            extra = list(pos)
+            if resolved[0] is None and not extra:
+                # no data input at all — fall through to the generic path
+                inputs = inputs + list(data_kw.values())
+                return _apply(opdef.name, inputs, params, name)
+            if any(r is None for r in resolved[1:]):
+                name = name or _auto_name(opdef.name)
+            resolved = [r if r is not None else var(f"{name}_{sn}")
+                        for r, sn in zip(resolved, slot_names)]
+            return _apply(opdef.name, resolved + extra, params, name)
+        inputs.extend(data_kw.values())
         return _apply(opdef.name, inputs, params, name)
 
     sym_op.__name__ = name

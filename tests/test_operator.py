@@ -216,3 +216,47 @@ def test_attention_interleaved_matches_reference_shape():
     p /= p.sum(-1, keepdims=True)
     ref = (p @ v).transpose(2, 0, 1, 3).reshape(T, B, H * Ch)
     np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_output_fused_gradient():
+    """SoftmaxOutput with a label carries the reference's fused backward:
+    d(data) = (softmax - one_hot(label)) * grad_scale, INDEPENDENT of the
+    incoming cotangent — that is what makes SoftmaxOutput-headed symbols
+    train under Module.backward's ones seed."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.registry import get as get_op
+
+    so = get_op("SoftmaxOutput").fn
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(4, 5), jnp.float32)
+    y = jnp.asarray(rs.randint(0, 5, (4,)), jnp.float32)
+
+    p = jax.nn.softmax(x, axis=-1)
+    expected = p - jax.nn.one_hot(y.astype(jnp.int32), 5)
+
+    # ones cotangent (Module's seed)
+    _, vjp = jax.vjp(lambda x: so(x, y), x)
+    (dx,) = vjp(jnp.ones((4, 5), jnp.float32))
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(expected),
+                               rtol=1e-5, atol=1e-6)
+    # ANY cotangent gives the same gradient (reference output-op semantics)
+    (dx2,) = vjp(jnp.full((4, 5), 7.0, jnp.float32))
+    np.testing.assert_allclose(np.asarray(dx2), np.asarray(dx))
+
+    # grad_scale and ignore_label (EVERY row carrying the ignored id zeroes)
+    ignored = int(y[0])
+    _, vjp3 = jax.vjp(lambda x: so(x, y, grad_scale=0.5, use_ignore=True,
+                                   ignore_label=ignored), x)
+    (dx3,) = vjp3(jnp.ones((4, 5), jnp.float32))
+    d3 = np.asarray(dx3)
+    keep = np.asarray(y) != ignored
+    np.testing.assert_allclose(d3[~keep], 0.0)
+    np.testing.assert_allclose(d3[keep], 0.5 * np.asarray(expected)[keep],
+                               rtol=1e-5, atol=1e-6)
+
+    # label-free: plain differentiable softmax (cotangent-dependent)
+    _, vjp4 = jax.vjp(lambda x: so(x), x)
+    (dx4,) = vjp4(jnp.ones((4, 5), jnp.float32))
+    np.testing.assert_allclose(np.asarray(dx4), 0.0, atol=1e-6)

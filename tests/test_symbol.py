@@ -148,3 +148,57 @@ def test_sliced_multi_output_names_align():
     from mxnet_tpu.base import MXNetError
     with pytest.raises(MXNetError, match="out of range"):
         grp2[7]
+
+
+def test_sym_auto_param_vars_by_keyword():
+    """Keyword-passed parameter Symbols land in their NAMED slot (reference
+    FListInputNames), never positionally."""
+    import numpy as np
+
+    from mxnet_tpu import symbol as sym
+
+    x = sym.var("data")
+    b = sym.var("mybias")
+    # bias passed by keyword, weight auto-created
+    y = sym.FullyConnected(x, bias=b, num_hidden=4, name="fc")
+    args = y.list_arguments()
+    assert args == ["data", "fc_weight", "mybias"], args
+    from mxnet_tpu import nd
+
+    ex = y.bind(args={"data": nd.array(np.ones((2, 3), np.float32)),
+                      "fc_weight": nd.array(np.zeros((4, 3), np.float32)),
+                      "mybias": nd.array(np.full((4,), 2.0, np.float32))})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, 2.0)  # zero weight + bias 2
+
+
+def test_sym_auto_param_int_label_softmax_output_trains():
+    """Auto-var symbols + int32 labels through Module (float0 cotangent)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.io.io import DataBatch
+
+    data = mx.sym.var("data")
+    label = mx.sym.var("softmax_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, label, name="softmax")
+    mod = mx.mod.Module(out)
+    mod.bind(data_shapes=[("data", (4, 5))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.rand(4, 5).astype(np.float32))
+    y = nd.array(rs.randint(0, 3, (4,)), dtype="int32")
+    losses = []
+    for _ in range(8):
+        mod.forward(DataBatch(data=[x], label=[y]), is_train=True)
+        mod.backward()
+        mod.update()
+        p = mod.get_outputs()[0].asnumpy()
+        losses.append(-np.log(np.maximum(
+            p[np.arange(4), y.asnumpy().astype(int)], 1e-9)).mean())
+    assert losses[-1] < losses[0] - 0.1, losses
