@@ -39,10 +39,13 @@ class Block(HybridBlock):
         self.body.add(BatchNorm())
 
         if use_se:
+            # biased layers to match the GluonCV SE block's 1x1 convs
+            # (bias=True there), keeping param structure/count aligned with
+            # reference checkpoints
             self.se = HybridSequential(prefix="")
-            self.se.add(Dense(channels // 4, use_bias=False))
+            self.se.add(Dense(channels // 4, use_bias=True))
             self.se.add(Activation("relu"))
-            self.se.add(Dense(channels * 4, use_bias=False))
+            self.se.add(Dense(channels * 4, use_bias=True))
             self.se.add(Activation("sigmoid"))
         else:
             self.se = None

@@ -202,12 +202,14 @@ class Module(BaseModule):
         is_train = self._for_training if is_train is None else is_train
         if is_train:
             with autograd.record():
-                self._outputs = [self._eval_symbol(env)]
+                self._outputs = self._eval_symbol(env)
         else:
-            self._outputs = [self._eval_symbol(env)]
+            self._outputs = self._eval_symbol(env)
         return self
 
     def _eval_symbol(self, env):
+        """Evaluate the bound symbol; returns one NDArray per output head
+        (Group symbols — reference GraphExecutor outputs — have several)."""
         from .ndarray import invoke
         from . import registry
 
@@ -223,7 +225,9 @@ class Module(BaseModule):
                 memo[key] = out if isinstance(out, tuple) else (out,)
             return memo[key][s._out_index]
 
-        return ev(self._symbol)
+        heads = (self._symbol._inputs if self._symbol._op == "_group"
+                 else [self._symbol])
+        return [ev(h) for h in heads]
 
     def forward_backward(self, data_batch):
         self.forward(data_batch, is_train=True)
@@ -232,14 +236,19 @@ class Module(BaseModule):
     def backward(self, out_grads=None):
         from . import autograd
 
-        head = self._outputs[0]
+        heads = list(self._outputs)
         # non-scalar heads backprop with an implicit ones cotangent
         # (reference executor semantics; output ops like SoftmaxOutput carry
         # their own fused gradient and ignore it). Summing here would build
-        # an un-taped op outside the record scope.
+        # an un-taped op outside the record scope. Every head participates —
+        # Group symbols backprop all outputs, each with its own cotangent.
         if out_grads is not None and not isinstance(out_grads, (list, tuple)):
             out_grads = [out_grads]
-        autograd.backward([head], head_grads=[out_grads[0]] if out_grads
+        if out_grads is not None and len(out_grads) != len(heads):
+            raise ValueError(
+                f"Module.backward got {len(out_grads)} out_grads for "
+                f"{len(heads)} outputs; pass one cotangent per output")
+        autograd.backward(heads, head_grads=list(out_grads) if out_grads
                           else None)
 
     def update(self):

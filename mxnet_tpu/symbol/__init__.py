@@ -592,10 +592,13 @@ def __getattr__(name):
                 else:
                     nxt = next(pos, None)
                     resolved.append(nxt)
-            extra = list(pos)
+            # keyword Symbols outside the named slots (e.g. aux states) ride
+            # along after the resolved slots instead of being dropped
+            extra = list(pos) + list(data_kw.values())
             if resolved[0] is None and not extra:
-                # no data input at all — fall through to the generic path
-                inputs = inputs + list(data_kw.values())
+                # no data input at all — restore popped slots and fall
+                # through to the generic path
+                inputs = inputs + list(slots.values())
                 return _apply(opdef.name, inputs, params, name)
             if any(r is None for r in resolved[1:]):
                 name = name or _auto_name(opdef.name)

@@ -373,3 +373,35 @@ def test_bf16_cast_net_conv_trains_end_to_end():
         assert losses[-1] < losses[0]
     finally:
         amp._reset()
+
+
+def test_module_backward_multi_output_group():
+    """Group symbols backprop EVERY head with its own cotangent (reference
+    GraphExecutor semantics); round-3 advisor flagged that only
+    out_grads[0] was honored."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    x = mx.sym.Variable("data")
+    h1 = mx.sym.FullyConnected(x, num_hidden=2, no_bias=True, name="fc1")
+    h2 = mx.sym.FullyConnected(x, num_hidden=2, no_bias=True, name="fc2")
+    g = mx.sym.Group([h1, h2])
+    mod = mx.mod.Module(g, data_names=("data",), label_names=())
+    it = NDArrayIter(np.ones((4, 3), dtype=np.float32), None, batch_size=4)
+    mod.bind(data_shapes=it.provide_data, label_shapes=None)
+    mod.init_params(initializer=mx.init.One())
+    it.reset()
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    cot1 = nd.ones((4, 2)) * 2.0
+    cot2 = nd.ones((4, 2)) * 5.0
+    mod.backward([cot1, cot2])
+    g1 = np.asarray(mod._arg_params["fc1_weight"]._grad)
+    g2 = np.asarray(mod._arg_params["fc2_weight"]._grad)
+    # dW = cot^T @ x; x = ones(4,3) -> each entry = 4 * cot value
+    np.testing.assert_allclose(g1, np.full((2, 3), 8.0), rtol=1e-6)
+    np.testing.assert_allclose(g2, np.full((2, 3), 20.0), rtol=1e-6)
+    # mismatched arity must raise, not silently drop
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        mod.backward([cot1])
