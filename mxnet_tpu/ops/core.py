@@ -96,6 +96,7 @@ for _name, _fn, _al in [
     ("erfinv", jax.scipy.special.erfinv, ()),
     ("gamma", lambda x: jnp.exp(jax.scipy.special.gammaln(x)), ()),
     ("gammaln", jax.scipy.special.gammaln, ()),
+    ("digamma", jax.scipy.special.digamma, ()),
     ("logical_not", lambda x: jnp.logical_not(x).astype(jnp.result_type(x)), ()),
     ("negative", jnp.negative, ("_np_negative",)),
     ("reciprocal", jnp.reciprocal, ()),

@@ -289,3 +289,48 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
             else:
                 rows.append(jnp.abs(a_c - b_c).mean(axis=1))
     return jnp.stack(rows, axis=1)
+
+
+# --------------------------------------------------------------------------
+# AMP graph-pass ops (reference: src/operator/tensor/amp_cast.cc,
+# src/operator/contrib/all_finite.cc). The TPU AMP implementation is
+# policy-based (contrib/amp.py casts at the matmul boundary), but exported
+# symbol JSONs and reference scripts name these ops explicitly — so they
+# exist as real registry entries with reference semantics.
+# --------------------------------------------------------------------------
+@register("amp_cast")
+def amp_cast(data, dtype="float32"):
+    """Float-to-float cast inserted by the AMP graph pass; non-float inputs
+    pass through unchanged (reference AMPCastType behavior)."""
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        return data
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("amp_multicast", nout=-1)
+def amp_multicast(*data, num_outputs=None):
+    """Cast every floating input to the widest floating dtype present
+    (reference AMPMultiCastType: common widest type across inputs)."""
+    floats = [a.dtype for a in data if jnp.issubdtype(a.dtype, jnp.floating)]
+    if not floats:
+        return tuple(data)
+    target = jnp.result_type(*floats)
+    return tuple(a.astype(target) if jnp.issubdtype(a.dtype, jnp.floating)
+                 else a for a in data)
+
+
+@register("all_finite")
+def all_finite(data, init_output=True):
+    """1-element float array: 1.0 iff every element is finite (reference
+    all_finite.cc — the dynamic-loss-scaling overflow probe)."""
+    return jnp.isfinite(data).all().astype(jnp.float32).reshape((1,))
+
+
+@register("multi_all_finite", nout=1)
+def multi_all_finite(*data, num_arrays=None, init_output=True):
+    """AND of all_finite over every input array in one fused op (reference
+    multi_all_finite — one kernel over the whole gradient set)."""
+    ok = jnp.array(True)
+    for a in data:
+        ok = jnp.logical_and(ok, jnp.isfinite(a).all())
+    return ok.astype(jnp.float32).reshape((1,))

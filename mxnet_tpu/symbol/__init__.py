@@ -260,11 +260,16 @@ class Symbol:
 
     # -- binding -------------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        # reference MXExecutorSimpleBindEx infers every missing argument
+        # shape from the provided (data) shapes before allocating
+        known = {k: tuple(v) for k, v in shapes.items()}
+        inferred = _infer_shapes_partial(self, dict(known)) or {}
         args = {}
         for name in self.list_arguments():
-            if name not in shapes:
+            shp = known.get(name) or inferred.get(name)
+            if shp is None:
                 raise MXNetError(f"simple_bind: missing shape for {name}")
-            args[name] = NDArray(jnp.zeros(tuple(shapes[name]), jnp.float32))
+            args[name] = NDArray(jnp.zeros(tuple(shp), jnp.float32))
         return Executor(self, args, grad_req)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",

@@ -405,3 +405,51 @@ def test_module_backward_multi_output_group():
     import pytest as _pytest
     with _pytest.raises(ValueError):
         mod.backward([cot1])
+
+
+def test_amp_graph_pass_ops_registered():
+    """Reference op names inserted by the AMP graph pass
+    (src/operator/tensor/amp_cast.cc, contrib/all_finite.cc) must exist as
+    real registry entries so exported symbol JSONs load (round-3 verdict)."""
+    from mxnet_tpu import nd, registry
+
+    for name in ("amp_cast", "amp_multicast", "all_finite",
+                 "multi_all_finite", "digamma"):
+        registry.get(name)  # raises if absent
+
+    x = nd.array(np.array([[1.0, 2.0]], dtype=np.float32))
+    assert nd.amp_cast(x, dtype="float16").dtype == np.float16
+    ints = nd.array(np.array([1, 2], dtype=np.int32))
+    assert nd.amp_cast(ints, dtype="float16").dtype == np.int32
+
+    a16 = nd.amp_cast(x, dtype="float16")
+    outs = nd.amp_multicast(a16, x, num_outputs=2)
+    assert outs[0].dtype == np.float32 and outs[1].dtype == np.float32
+
+    good = nd.array(np.ones((3, 3), dtype=np.float32))
+    bad = nd.array(np.array([np.inf, 1.0], dtype=np.float32))
+    assert float(nd.all_finite(good).asnumpy()[0]) == 1.0
+    assert float(nd.all_finite(bad).asnumpy()[0]) == 0.0
+    assert float(nd.multi_all_finite(good, bad, num_arrays=2).asnumpy()[0]) == 0.0
+    assert float(nd.multi_all_finite(good, good, num_arrays=2).asnumpy()[0]) == 1.0
+
+    # digamma(1) = -euler_gamma
+    dg = nd.digamma(nd.array(np.array([1.0], dtype=np.float32)))
+    np.testing.assert_allclose(dg.asnumpy(), [-0.5772157], rtol=1e-5)
+
+
+def test_symbol_json_with_amp_cast_loads_and_runs():
+    """A symbol JSON that names amp_cast (as AMP-converted exports do) must
+    load and execute — reference scripts depend on these registry names."""
+    import mxnet_tpu as mx
+
+    x = mx.sym.Variable("data")
+    h = mx.sym.amp_cast(x, dtype="float16")
+    y = mx.sym.FullyConnected(h, num_hidden=4, no_bias=True, name="fc")
+    js = y.tojson()
+    assert "amp_cast" in js
+    loaded = mx.sym.load_json(js)
+    ex = loaded.simple_bind(data=(2, 3))
+    ex.arg_dict["fc_weight"][:] = mx.nd.ones((4, 3))
+    out = ex.forward(data=mx.nd.ones((2, 3)))[0]
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 4), 3.0), rtol=1e-2)
