@@ -334,3 +334,56 @@ def multi_all_finite(*data, num_arrays=None, init_output=True):
     for a in data:
         ok = jnp.logical_and(ok, jnp.isfinite(a).all())
     return ok.astype(jnp.float32).reshape((1,))
+
+
+# --------------------------------------------------------------------------
+# explicit sharding constraint (TPU-native; no reference analog — the
+# reference's placement is group2ctx/PlaceDevice, which GSPMD annotations
+# replace per SURVEY §2.3). Model code pins layouts at known transition
+# points so the partitioner never falls back to involuntary remat.
+# --------------------------------------------------------------------------
+@register("_sharding_constraint")
+def sharding_constraint(data, spec=()):
+    """``jax.lax.with_sharding_constraint`` against the active mesh.
+
+    ``spec`` entries per dimension: None (unconstrained), an axis name, a
+    tuple of axis names, or the alias ``"data"`` (= every batch-bearing mesh
+    axis present: dp, fsdp). Identity when no mesh is active, when a named
+    axis is absent/size-1, or when the axis product does not divide the dim —
+    so the op is safe in eager/single-chip paths.
+    """
+    from .. import _mesh_state
+
+    mesh = _mesh_state.current_mesh()
+    if mesh is None:
+        return data
+
+    def axes_of(entry):
+        if entry is None:
+            return ()
+        if entry == "data":
+            names = ("dp", "fsdp")
+        elif isinstance(entry, (tuple, list)):
+            names = tuple(entry)
+        else:
+            names = (entry,)
+        return tuple(n for n in names
+                     if n in mesh.shape and mesh.shape[n] > 1)
+
+    resolved = []
+    for dim, entry in zip(data.shape, tuple(spec)[: data.ndim]):
+        axes = axes_of(entry)
+        prod = 1
+        for n in axes:
+            prod *= mesh.shape[n]
+        if not axes or dim % prod != 0:
+            resolved.append(None)
+        else:
+            resolved.append(axes if len(axes) > 1 else axes[0])
+    resolved += [None] * (data.ndim - len(resolved))
+    if all(r is None for r in resolved):
+        return data
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.lax.with_sharding_constraint(
+        data, NamedSharding(mesh, P(*resolved)))

@@ -94,3 +94,88 @@ def test_train_step_loss_decreases_under_dp():
     ts, args = _build_mlp_step(mesh)
     losses = [float(np.asarray(jax.device_get(ts(*args)))) for _ in range(8)]
     assert losses[-1] < losses[0]
+
+
+def _build_bert_step(mesh, rules):
+    from mxnet_tpu.models import bert
+
+    mx.random.seed(0)
+    net = bert.get_bert("bert_tiny", pretrain_head=True, vocab_size=512,
+                        max_length=64)
+    net.initialize()
+    B, T, M = 8, 16, 4
+    rs = np.random.RandomState(0)
+    ids = nd.array(rs.randint(0, 512, (B, T)), dtype="int32")
+    types = nd.zeros((B, T), dtype="int32")
+    valid = nd.full((B,), T, dtype="int32")
+    pos = nd.array(rs.randint(0, T, (B, M)), dtype="int32")
+    labels = nd.array(rs.randint(0, 512, (B, M)), dtype="int32")
+    weights = nd.ones((B, M))
+    nsp_labels = nd.array(rs.randint(0, 2, (B,)), dtype="int32")
+    _ = net(ids, types, valid, pos)
+
+    def loss_fn(out, labels, weights, nsp_labels):
+        mlm, nsp = out
+        return bert.pretrain_loss(mlm, nsp, labels, weights, nsp_labels)
+
+    ts = TrainStep(net, loss_fn, optimizer.Adam(learning_rate=1e-4),
+                   mesh=mesh, rules=rules, n_model_inputs=4)
+    return ts, (ids, types, valid, pos, labels, weights, nsp_labels)
+
+
+def test_tp_step_emits_tp_collectives_without_involuntary_remat(capfd):
+    """Round-3 verdict ask #2: the dp x tp BERT step must (a) carry tp
+    collectives (megatron row/column-parallel matmuls synchronize via
+    all-reduce or reduce-scatter/all-gather on the tp axis) and (b) compile
+    WITHOUT the SPMD 'Involuntary full rematerialization' fallback that the
+    round-3 MULTICHIP tail recorded."""
+    from mxnet_tpu.parallel.sharding import DEFAULT_BERT_RULES
+
+    mesh = make_mesh(MeshConfig(dp=4, tp=2))
+    ts, args = _build_bert_step(mesh, DEFAULT_BERT_RULES)
+    compiled = ts.lower_hlo(*args).compile()
+    text = compiled.as_text()
+    n_collective = (len(re.findall(r"all-reduce(?:-start)?\(", text))
+                    + len(re.findall(r"reduce-scatter\(", text))
+                    + len(re.findall(r"all-gather(?:-start)?\(", text)))
+    assert n_collective >= 2, "tp step produced almost no collectives"
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, err[-2000:]
+
+
+def test_fsdp_step_gathers_and_scatters_without_involuntary_remat(capfd):
+    """ZeRO compute/storage split: fsdp params all-gather for compute and
+    grads reduce-scatter back; no involuntary remat (this was the actual
+    source of the round-3 warning — the vocab-sharded MLM decoder)."""
+    from mxnet_tpu.parallel.sharding import ShardingRules
+
+    mesh = make_mesh(MeshConfig(dp=4, fsdp=2))
+    rules = ShardingRules(fsdp_axis="fsdp", min_fsdp_size=1024)
+    ts, args = _build_bert_step(mesh, rules)
+    assert ts._compute_specs, "no param picked up the ZeRO compute split"
+    compiled = ts.lower_hlo(*args).compile()
+    text = compiled.as_text()
+    assert re.search(r"all-gather(?:-start)?\(", text), \
+        "fsdp step has no all-gather (params not gathered for compute)"
+    assert (re.search(r"reduce-scatter\(", text)
+            or re.search(r"all-reduce(?:-start)?\(", text)), \
+        "fsdp step has no grad reduction collective"
+    err = capfd.readouterr().err
+    assert "Involuntary full rematerialization" not in err, err[-2000:]
+
+
+def test_sp_ring_attention_uses_collective_permute():
+    """Sequence-parallel ring attention moves KV blocks with ppermute over
+    the sp axis — the ICI-riding collective (SURVEY §5.7)."""
+    from mxnet_tpu.parallel import ring_attention as ra
+
+    mesh = make_mesh(MeshConfig(sp=8))
+    q = jnp.ones((1, 2, 16 * 8, 8), jnp.float32) * 0.1
+
+    def f(q):
+        return ra.ring_attention(q, q, q, mesh, axis="sp", causal=True)
+
+    with mesh:
+        text = jax.jit(f).lower(q).compile().as_text()
+    assert "collective-permute" in text, \
+        "ring attention lowered without collective-permute"
