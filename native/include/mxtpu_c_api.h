@@ -73,6 +73,55 @@ typedef int (*MXTPUInvokeBridgeFn)(const char* op_name,
 int MXTPUSetInvokeBridge(MXTPUInvokeBridgeFn fn);
 void MXTPUSetLastError(const char* msg);
 
+/* ---- autograd (reference: MXAutogradSetIsRecording / MXAutogradBackwardEx
+ * over Imperative::Backward). Recording captures every successful
+ * MXTPUImperativeInvoke on a thread-local tape; Backward sweeps it with
+ * VJPs composed from public ops. Input/output handles referenced by the
+ * tape must stay alive until Backward/Reset. Bridge-served ops are NOT
+ * recorded (their VJPs live in the jax runtime). ---- */
+int MXTPUAutogradSetRecording(int recording, int* prev);
+int MXTPUAutogradMarkVariables(int n, MXTPUNDHandle* vars);
+int MXTPUAutogradBackward(MXTPUNDHandle head);
+/* grad stays owned by the autograd state until the next Backward/Reset */
+int MXTPUAutogradGetGrad(MXTPUNDHandle var, MXTPUNDHandle* grad);
+int MXTPUAutogradReset();
+
+/* ---- symbol graph (reference: MXSymbolCreateVariable /
+ * MXSymbolCreateAtomicSymbol / MXSymbolCompose in c_api_symbolic.cc).
+ * Composed input symbols must outlive the composite + bound executors. */
+typedef void* MXTPUSymHandle;
+int MXTPUSymbolCreateVariable(const char* name, MXTPUSymHandle* out);
+int MXTPUSymbolCreateAtomicSymbol(const char* op_name, const char* param_json,
+                                  const char* name, MXTPUSymHandle* out);
+int MXTPUSymbolCompose(MXTPUSymHandle sym, MXTPUSymHandle* args, int n_args);
+int MXTPUSymbolFree(MXTPUSymHandle sym);
+
+/* ---- executor (reference: MXExecutorSimpleBindEx / MXExecutorForward /
+ * MXExecutorBackward / MXExecutorOutputs). Bind pairs variable names with
+ * client-owned arrays (which must outlive the executor; content changes are
+ * picked up by the next Forward). Forward output + grads are owned by the
+ * executor until the next Forward/Free. ---- */
+typedef void* MXTPUExecHandle;
+int MXTPUExecutorBind(MXTPUSymHandle sym, const char** arg_names,
+                      MXTPUNDHandle* args, int n_args, MXTPUExecHandle* out);
+int MXTPUExecutorForward(MXTPUExecHandle exec, MXTPUNDHandle* out);
+int MXTPUExecutorBackward(MXTPUExecHandle exec);
+int MXTPUExecutorGetGrad(MXTPUExecHandle exec, const char* arg_name,
+                         MXTPUNDHandle* grad);
+int MXTPUExecutorFree(MXTPUExecHandle exec);
+
+/* ---- kvstore (reference: MXKVStoreCreate/Init/Push/Pull over
+ * kvstore_local.h; SetOptimizer = update-on-push, the server Updater).
+ * Native tier is single-process; the distributed path is jax.distributed
+ * in the Python runtime. ---- */
+typedef void* MXTPUKVHandle;
+int MXTPUKVStoreCreate(const char* type, MXTPUKVHandle* out);
+int MXTPUKVStoreSetOptimizer(MXTPUKVHandle kv, const char* param_json);
+int MXTPUKVStoreInit(MXTPUKVHandle kv, int key, MXTPUNDHandle val);
+int MXTPUKVStorePush(MXTPUKVHandle kv, int key, MXTPUNDHandle grad);
+int MXTPUKVStorePull(MXTPUKVHandle kv, int key, MXTPUNDHandle out);
+int MXTPUKVStoreFree(MXTPUKVHandle kv);
+
 #ifdef __cplusplus
 }
 #endif

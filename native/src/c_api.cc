@@ -7,6 +7,7 @@
 // served by the bridge an embedding jax runtime installs (native.py).
 
 #include "../include/mxtpu_c_api.h"
+#include "internal.h"
 
 #include <algorithm>
 #include <cmath>
@@ -240,10 +241,92 @@ int unary_ew(std::vector<NDArrayRec*>& ins, std::vector<NDArrayRec*>* outs,
   return 0;
 }
 
+int op_sum(std::vector<NDArrayRec*>& ins, const Params& ps,
+           std::vector<NDArrayRec*>* outs) {
+  // axis absent -> reduce all to a scalar; axis=0 on 2-D -> column sums
+  // (the two reductions the graph tier's VJPs need)
+  if (ins.size() != 1) { g_last_error = "sum: expects 1 input"; return -1; }
+  if (require_f32(ins, "sum")) return -1;
+  NDArrayRec* a = ins[0];
+  const float* A = a->f32();
+  bool has_axis = ps.nums.count("axis") > 0;
+  if (!has_axis) {
+    NDArrayRec* o = make_out({1}, kMXTPUFloat32);
+    double acc = 0.0;
+    for (int64_t i = 0, n = a->size(); i < n; ++i) acc += A[i];
+    o->f32()[0] = static_cast<float>(acc);
+    outs->push_back(o);
+    return 0;
+  }
+  int axis = static_cast<int>(ps.num("axis", 0));
+  if (a->shape.size() != 2 || axis != 0) {
+    g_last_error = "sum: native tier handles axis=0 on 2-D (or full reduce)";
+    return -1;
+  }
+  int64_t rows = a->shape[0], cols = a->shape[1];
+  NDArrayRec* o = make_out({cols}, kMXTPUFloat32);
+  float* C = o->f32();
+  for (int64_t j = 0; j < cols; ++j) {
+    double acc = 0.0;
+    for (int64_t i = 0; i < rows; ++i) acc += A[i * cols + j];
+    C[j] = static_cast<float>(acc);
+  }
+  outs->push_back(o);
+  return 0;
+}
+
+int op_mul_scalar(std::vector<NDArrayRec*>& ins, const Params& ps,
+                  std::vector<NDArrayRec*>* outs) {
+  if (ins.size() != 1) { g_last_error = "_mul_scalar: expects 1 input"; return -1; }
+  if (require_f32(ins, "_mul_scalar")) return -1;
+  float s = static_cast<float>(ps.num("scalar", 1.0));
+  NDArrayRec* o = make_out(ins[0]->shape, kMXTPUFloat32);
+  const float* A = ins[0]->f32();
+  float* C = o->f32();
+  for (int64_t i = 0, n = o->size(); i < n; ++i) C[i] = A[i] * s;
+  outs->push_back(o);
+  return 0;
+}
+
+int op_broadcast_add(std::vector<NDArrayRec*>& ins, const Params&,
+                     std::vector<NDArrayRec*>* outs) {
+  // (M, N) + (N,): the bias-add shape every dense layer needs
+  if (ins.size() != 2) { g_last_error = "broadcast_add: expects 2 inputs"; return -1; }
+  if (require_f32(ins, "broadcast_add")) return -1;
+  NDArrayRec *a = ins[0], *b = ins[1];
+  if (a->shape == b->shape) {
+    NDArrayRec* o = make_out(a->shape, kMXTPUFloat32);
+    const float *A = a->f32(), *B = b->f32();
+    float* C = o->f32();
+    for (int64_t i = 0, n = o->size(); i < n; ++i) C[i] = A[i] + B[i];
+    outs->push_back(o);
+    return 0;
+  }
+  if (a->shape.size() != 2 || b->shape.size() != 1 ||
+      a->shape[1] != b->shape[0]) {
+    g_last_error = "broadcast_add: native tier handles (M,N)+(N,) only";
+    return -1;
+  }
+  NDArrayRec* o = make_out(a->shape, kMXTPUFloat32);
+  const float *A = a->f32(), *B = b->f32();
+  float* C = o->f32();
+  int64_t rows = a->shape[0], cols = a->shape[1];
+  for (int64_t i = 0; i < rows; ++i)
+    for (int64_t j = 0; j < cols; ++j)
+      C[i * cols + j] = A[i * cols + j] + B[j];
+  outs->push_back(o);
+  return 0;
+}
+
 const std::map<std::string, NativeOp>& native_registry() {
   static const std::map<std::string, NativeOp> reg = {
       {"dot", op_dot},
       {"softmax", op_softmax},
+      {"sum", op_sum},
+      {"_mul_scalar", op_mul_scalar},
+      {"broadcast_add", op_broadcast_add},
+      {"greater", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
+         return binary_ew(i, o, "greater", [](float a, float b) { return a > b ? 1.0f : 0.0f; }); }},
       {"add", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
          return binary_ew(i, o, "add", [](float a, float b) { return a + b; }); }},
       {"subtract", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
@@ -363,6 +446,9 @@ int MXTPUImperativeInvoke(const char* op_name, MXTPUNDHandle* inputs,
   }
   for (size_t i = 0; i < outs.size(); ++i) outputs[i] = outs[i];
   *n_out = static_cast<int>(outs.size());
+  if (mxtpu::autograd_is_recording())
+    mxtpu::autograd_record(op_name, inputs, n_in, param_json, outputs,
+                           *n_out);
   return 0;
 }
 

@@ -1,0 +1,682 @@
+// Graph-build + autograd + executor + kvstore C ABI tier.
+//
+// Reference analogs: src/c_api/c_api_symbolic.cc (MXSymbolCreateAtomicSymbol
+// / MXSymbolCompose), src/c_api/c_api_executor.cc (MXExecutorSimpleBindEx /
+// MXExecutorForward / MXExecutorBackward), MXAutogradBackwardEx
+// (c_api_ndarray.cc -> Imperative::Backward), src/kvstore/kvstore_local.h.
+//
+// Design: ONE reverse-mode machine — an imperative tape recorded by the op
+// dispatch tier (internal.h hook) — serves both the `MXTPUAutograd*` surface
+// and the executor (Forward = record-replay of the symbol graph, Backward =
+// tape sweep). VJPs are *compositions of public ABI ops* (dot backward is
+// two transposed dots, etc.), mirroring how the reference's backward passes
+// are themselves registered operators. The native tier is a host f32
+// reference implementation; the jax/XLA path remains the performance tier.
+#include "../include/mxtpu_c_api.h"
+#include "internal.h"
+
+#include <cmath>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace {
+
+// -- small helpers over the public ABI --------------------------------------
+
+struct Arr {
+  MXTPUNDHandle h = nullptr;
+};
+
+int nd_shape(MXTPUNDHandle h, std::vector<int64_t>* shape) {
+  int ndim = 0;
+  const int64_t* s = nullptr;
+  if (MXTPUNDArrayGetShape(h, &ndim, &s) != 0) return -1;
+  shape->assign(s, s + ndim);
+  return 0;
+}
+
+int64_t nd_size(MXTPUNDHandle h) {
+  int64_t n = 0;
+  MXTPUNDArraySize(h, &n);
+  return n;
+}
+
+const float* nd_f32(MXTPUNDHandle h) {
+  const void* p = nullptr;
+  MXTPUNDArrayGetData(h, &p);
+  return static_cast<const float*>(p);
+}
+
+MXTPUNDHandle nd_full_like(MXTPUNDHandle h, float value) {
+  std::vector<int64_t> shape;
+  if (nd_shape(h, &shape) != 0) return nullptr;
+  std::vector<float> buf(static_cast<size_t>(nd_size(h)), value);
+  MXTPUNDHandle out = nullptr;
+  if (MXTPUNDArrayCreateFromBytes(buf.data(), shape.data(),
+                                  static_cast<int>(shape.size()),
+                                  kMXTPUFloat32, &out) != 0)
+    return nullptr;
+  return out;
+}
+
+MXTPUNDHandle nd_copy(MXTPUNDHandle h) {
+  std::vector<int64_t> shape;
+  if (nd_shape(h, &shape) != 0) return nullptr;
+  MXTPUNDHandle out = nullptr;
+  if (MXTPUNDArrayCreateFromBytes(nd_f32(h), shape.data(),
+                                  static_cast<int>(shape.size()),
+                                  kMXTPUFloat32, &out) != 0)
+    return nullptr;
+  return out;
+}
+
+// invoke a 1-output op; returns the new handle or nullptr (error already set)
+MXTPUNDHandle inv1(const char* op, std::vector<MXTPUNDHandle> ins,
+                   const char* params = "") {
+  MXTPUNDHandle out[1] = {nullptr};
+  int n_out = 1;
+  if (MXTPUImperativeInvoke(op, ins.data(), static_cast<int>(ins.size()),
+                            params, out, &n_out) != 0)
+    return nullptr;
+  return out[0];
+}
+
+// -- autograd tape -----------------------------------------------------------
+
+struct TapeNode {
+  std::string op;
+  std::string params;
+  std::vector<MXTPUNDHandle> inputs;
+  std::vector<MXTPUNDHandle> outputs;
+};
+
+struct AutogradState {
+  bool recording = false;
+  std::vector<TapeNode> tape;
+  std::set<MXTPUNDHandle> marked;
+  std::map<MXTPUNDHandle, MXTPUNDHandle> grads;  // var -> grad (owned)
+  std::vector<MXTPUNDHandle> temps;              // owned intermediates
+
+  void clear_grads() {
+    for (auto& kv : grads) MXTPUNDArrayFree(kv.second);
+    grads.clear();
+    for (auto h : temps) MXTPUNDArrayFree(h);
+    temps.clear();
+  }
+  void clear_tape() { tape.clear(); }
+};
+
+thread_local AutogradState g_ag;
+
+double param_num(const std::string& json, const char* key, double dflt) {
+  // single-key lookup into the flat param JSON (numbers only)
+  std::string pat = std::string("\"") + key + "\"";
+  size_t p = json.find(pat);
+  if (p == std::string::npos) return dflt;
+  p = json.find(':', p);
+  if (p == std::string::npos) return dflt;
+  return std::strtod(json.c_str() + p + 1, nullptr);
+}
+
+bool param_flag(const std::string& json, const char* key) {
+  std::string pat = std::string("\"") + key + "\"";
+  size_t p = json.find(pat);
+  if (p == std::string::npos) return false;
+  p = json.find(':', p);
+  if (p == std::string::npos) return false;
+  size_t v = json.find_first_not_of(" \t", p + 1);
+  return v != std::string::npos && json.compare(v, 4, "true") == 0;
+}
+
+// accumulate cotangent `g` (owned by caller's map logic) into cot[var]
+int accumulate(std::map<MXTPUNDHandle, MXTPUNDHandle>* cot,
+               MXTPUNDHandle var, MXTPUNDHandle g) {
+  auto it = cot->find(var);
+  if (it == cot->end()) {
+    (*cot)[var] = g;
+    return 0;
+  }
+  MXTPUNDHandle sum = inv1("add", {it->second, g});
+  if (sum == nullptr) return -1;
+  MXTPUNDArrayFree(it->second);
+  MXTPUNDArrayFree(g);
+  it->second = sum;
+  return 0;
+}
+
+// VJP of one tape node: push input cotangents given output cotangent g.
+// Returns 0/-1; new cotangents are accumulated into `cot` (ownership moves).
+int vjp_node(const TapeNode& n, MXTPUNDHandle g,
+             std::map<MXTPUNDHandle, MXTPUNDHandle>* cot) {
+  const std::string& op = n.op;
+  auto in = [&](size_t i) { return n.inputs[i]; };
+  if (op == "dot") {
+    if (param_flag(n.params, "transpose_a") ||
+        param_flag(n.params, "transpose_b")) {
+      MXTPUSetLastError("autograd: dot vjp supports untransposed dot only");
+      return -1;
+    }
+    MXTPUNDHandle da = inv1("dot", {g, in(1)}, "{\"transpose_b\": true}");
+    MXTPUNDHandle db = inv1("dot", {in(0), g}, "{\"transpose_a\": true}");
+    if (da == nullptr || db == nullptr) return -1;
+    if (accumulate(cot, in(0), da)) return -1;
+    return accumulate(cot, in(1), db);
+  }
+  if (op == "add" || op == "broadcast_add") {
+    std::vector<int64_t> sa, sb;
+    nd_shape(in(0), &sa);
+    nd_shape(in(1), &sb);
+    MXTPUNDHandle da = nd_copy(g);
+    if (da == nullptr || accumulate(cot, in(0), da)) return -1;
+    if (sa == sb) {
+      MXTPUNDHandle db = nd_copy(g);
+      if (db == nullptr) return -1;
+      return accumulate(cot, in(1), db);
+    }
+    // (M,N)+(N,): bias grad = column sums of g
+    MXTPUNDHandle db = inv1("sum", {g}, "{\"axis\": 0}");
+    if (db == nullptr) return -1;
+    return accumulate(cot, in(1), db);
+  }
+  if (op == "subtract") {
+    MXTPUNDHandle da = nd_copy(g);
+    MXTPUNDHandle db = inv1("negative", {g});
+    if (da == nullptr || db == nullptr) return -1;
+    if (accumulate(cot, in(0), da)) return -1;
+    return accumulate(cot, in(1), db);
+  }
+  if (op == "multiply") {
+    MXTPUNDHandle da = inv1("multiply", {g, in(1)});
+    MXTPUNDHandle db = inv1("multiply", {g, in(0)});
+    if (da == nullptr || db == nullptr) return -1;
+    if (accumulate(cot, in(0), da)) return -1;
+    return accumulate(cot, in(1), db);
+  }
+  if (op == "relu") {
+    MXTPUNDHandle zeros = nd_full_like(in(0), 0.0f);
+    if (zeros == nullptr) return -1;
+    MXTPUNDHandle mask = inv1("greater", {in(0), zeros});
+    MXTPUNDArrayFree(zeros);
+    if (mask == nullptr) return -1;
+    MXTPUNDHandle da = inv1("multiply", {g, mask});
+    MXTPUNDArrayFree(mask);
+    if (da == nullptr) return -1;
+    return accumulate(cot, in(0), da);
+  }
+  if (op == "exp") {
+    MXTPUNDHandle da = inv1("multiply", {g, n.outputs[0]});
+    if (da == nullptr) return -1;
+    return accumulate(cot, in(0), da);
+  }
+  if (op == "log") {
+    MXTPUNDHandle da = inv1("divide", {g, in(0)});
+    if (da == nullptr) return -1;
+    return accumulate(cot, in(0), da);
+  }
+  if (op == "negative") {
+    MXTPUNDHandle da = inv1("negative", {g});
+    if (da == nullptr) return -1;
+    return accumulate(cot, in(0), da);
+  }
+  if (op == "_mul_scalar") {
+    char buf[64];
+    std::snprintf(buf, sizeof(buf), "{\"scalar\": %.17g}",
+                  param_num(n.params, "scalar", 1.0));
+    MXTPUNDHandle da = inv1("_mul_scalar", {g}, buf);
+    if (da == nullptr) return -1;
+    return accumulate(cot, in(0), da);
+  }
+  if (op == "sum") {
+    if (param_num(n.params, "axis", -999.0) != -999.0) {
+      MXTPUSetLastError("autograd: sum vjp supports full reduce only");
+      return -1;
+    }
+    MXTPUNDHandle da = nd_full_like(in(0), nd_f32(g)[0]);
+    if (da == nullptr) return -1;
+    return accumulate(cot, in(0), da);
+  }
+  MXTPUSetLastError(
+      (std::string("autograd: no vjp registered for op '") + op + "'")
+          .c_str());
+  return -1;
+}
+
+int backward_from(MXTPUNDHandle head) {
+  g_ag.clear_grads();
+  std::map<MXTPUNDHandle, MXTPUNDHandle> cot;
+  MXTPUNDHandle seed = nd_full_like(head, 1.0f);
+  if (seed == nullptr) return -1;
+  cot[head] = seed;
+  bool was_recording = g_ag.recording;
+  g_ag.recording = false;  // vjp-composition invokes must not re-record
+  int rc = 0;
+  for (auto it = g_ag.tape.rbegin(); it != g_ag.tape.rend(); ++it) {
+    auto git = cot.find(it->outputs[0]);
+    if (git == cot.end()) continue;  // node not on the path to head
+    MXTPUNDHandle g = git->second;
+    cot.erase(git);
+    rc = vjp_node(*it, g, &cot);
+    MXTPUNDArrayFree(g);
+    if (rc != 0) break;
+  }
+  g_ag.recording = was_recording;
+  if (rc != 0) {
+    for (auto& kv : cot) MXTPUNDArrayFree(kv.second);
+    return -1;
+  }
+  for (auto& kv : cot) {
+    if (g_ag.marked.count(kv.first))
+      g_ag.grads[kv.first] = kv.second;  // ownership to grads map
+    else
+      MXTPUNDArrayFree(kv.second);
+  }
+  return 0;
+}
+
+// -- symbol graph ------------------------------------------------------------
+
+struct SymRec {
+  std::string op;      // empty for variables
+  std::string name;    // variable name / op instance name
+  std::string params;  // flat JSON
+  std::vector<SymRec*> inputs;
+};
+
+// -- executor ---------------------------------------------------------------
+
+struct ExecRec {
+  SymRec* root = nullptr;
+  std::map<std::string, MXTPUNDHandle> args;   // client-owned arrays
+  std::map<SymRec*, MXTPUNDHandle> values;     // owned forward values
+  std::map<std::string, MXTPUNDHandle> grads;  // owned per-arg grads
+  std::vector<TapeNode> tape;                  // recorded forward
+
+  void clear_run() {
+    for (auto& kv : values)
+      MXTPUNDArrayFree(kv.second);
+    values.clear();
+    for (auto& kv : grads) MXTPUNDArrayFree(kv.second);
+    grads.clear();
+    tape.clear();
+  }
+};
+
+int exec_eval(ExecRec* ex, SymRec* node, MXTPUNDHandle* out) {
+  if (node->op.empty()) {
+    auto it = ex->args.find(node->name);
+    if (it == ex->args.end()) {
+      MXTPUSetLastError(
+          (std::string("executor: unbound variable '") + node->name + "'")
+              .c_str());
+      return -1;
+    }
+    *out = it->second;
+    return 0;
+  }
+  auto vit = ex->values.find(node);
+  if (vit != ex->values.end()) {
+    *out = vit->second;
+    return 0;
+  }
+  std::vector<MXTPUNDHandle> ins;
+  for (SymRec* s : node->inputs) {
+    MXTPUNDHandle h = nullptr;
+    if (exec_eval(ex, s, &h) != 0) return -1;
+    ins.push_back(h);
+  }
+  MXTPUNDHandle o = inv1(node->op.c_str(), ins, node->params.c_str());
+  if (o == nullptr) return -1;
+  ex->values[node] = o;
+  *out = o;
+  return 0;
+}
+
+// -- kvstore ----------------------------------------------------------------
+
+struct KVRec {
+  std::map<int, MXTPUNDHandle> store;  // owned
+  bool sgd = false;
+  double lr = 0.01;
+
+  ~KVRec() {
+    for (auto& kv : store) MXTPUNDArrayFree(kv.second);
+  }
+};
+
+}  // namespace
+
+namespace mxtpu {
+
+bool autograd_is_recording() { return g_ag.recording; }
+
+void autograd_record(const char* op_name, MXTPUNDHandle* inputs, int n_in,
+                     const char* param_json, MXTPUNDHandle* outputs,
+                     int n_out) {
+  TapeNode n;
+  n.op = op_name ? op_name : "";
+  n.params = param_json ? param_json : "";
+  n.inputs.assign(inputs, inputs + n_in);
+  n.outputs.assign(outputs, outputs + n_out);
+  g_ag.tape.push_back(std::move(n));
+}
+
+}  // namespace mxtpu
+
+extern "C" {
+
+// -- autograd ---------------------------------------------------------------
+
+int MXTPUAutogradSetRecording(int recording, int* prev) {
+  if (prev) *prev = g_ag.recording ? 1 : 0;
+  g_ag.recording = recording != 0;
+  if (recording) g_ag.clear_tape();
+  return 0;
+}
+
+int MXTPUAutogradMarkVariables(int n, MXTPUNDHandle* vars) {
+  for (int i = 0; i < n; ++i) g_ag.marked.insert(vars[i]);
+  return 0;
+}
+
+int MXTPUAutogradBackward(MXTPUNDHandle head) {
+  if (head == nullptr) {
+    MXTPUSetLastError("AutogradBackward: null head");
+    return -1;
+  }
+  return backward_from(head);
+}
+
+/* grad handle stays owned by the autograd state (valid until the next
+ * backward); callers copy out what they need. */
+int MXTPUAutogradGetGrad(MXTPUNDHandle var, MXTPUNDHandle* grad) {
+  auto it = g_ag.grads.find(var);
+  if (it == g_ag.grads.end()) {
+    MXTPUSetLastError("AutogradGetGrad: no grad recorded for this handle "
+                      "(not marked, or backward not run)");
+    return -1;
+  }
+  *grad = it->second;
+  return 0;
+}
+
+int MXTPUAutogradReset() {
+  g_ag.clear_grads();
+  g_ag.clear_tape();
+  g_ag.marked.clear();
+  return 0;
+}
+
+// -- symbol -----------------------------------------------------------------
+
+int MXTPUSymbolCreateVariable(const char* name, MXTPUSymHandle* out) {
+  if (name == nullptr || out == nullptr) {
+    MXTPUSetLastError("SymbolCreateVariable: null arg");
+    return -1;
+  }
+  auto* s = new SymRec();
+  s->name = name;
+  *out = s;
+  return 0;
+}
+
+int MXTPUSymbolCreateAtomicSymbol(const char* op_name, const char* param_json,
+                                  const char* name, MXTPUSymHandle* out) {
+  if (op_name == nullptr || out == nullptr) {
+    MXTPUSetLastError("SymbolCreateAtomicSymbol: null arg");
+    return -1;
+  }
+  auto* s = new SymRec();
+  s->op = op_name;
+  s->params = param_json ? param_json : "";
+  s->name = name ? name : op_name;
+  *out = s;
+  return 0;
+}
+
+/* Compose: attach inputs (reference MXSymbolCompose). Input symbols must
+ * outlive this symbol and any executor bound to it. */
+int MXTPUSymbolCompose(MXTPUSymHandle sym, MXTPUSymHandle* args, int n_args) {
+  if (sym == nullptr) {
+    MXTPUSetLastError("SymbolCompose: null symbol");
+    return -1;
+  }
+  auto* s = static_cast<SymRec*>(sym);
+  if (s->op.empty()) {
+    MXTPUSetLastError("SymbolCompose: cannot compose a variable");
+    return -1;
+  }
+  s->inputs.clear();
+  for (int i = 0; i < n_args; ++i) {
+    if (args[i] == nullptr) {
+      MXTPUSetLastError("SymbolCompose: null input symbol");
+      return -1;
+    }
+    s->inputs.push_back(static_cast<SymRec*>(args[i]));
+  }
+  return 0;
+}
+
+int MXTPUSymbolFree(MXTPUSymHandle sym) {
+  delete static_cast<SymRec*>(sym);
+  return 0;
+}
+
+// -- executor ---------------------------------------------------------------
+
+/* Bind: arg_names/arrays pair variables to client-owned NDArrays (reference
+ * MXExecutorSimpleBindEx with explicit args). Arrays must outlive the
+ * executor; updates to their contents are seen by the next Forward. */
+int MXTPUExecutorBind(MXTPUSymHandle sym, const char** arg_names,
+                      MXTPUNDHandle* args, int n_args,
+                      MXTPUExecHandle* out) {
+  if (sym == nullptr || out == nullptr) {
+    MXTPUSetLastError("ExecutorBind: null arg");
+    return -1;
+  }
+  auto* ex = new ExecRec();
+  ex->root = static_cast<SymRec*>(sym);
+  for (int i = 0; i < n_args; ++i)
+    ex->args[arg_names[i]] = args[i];
+  *out = ex;
+  return 0;
+}
+
+/* Forward: evaluates the graph (recording a tape for Backward); *out is
+ * owned by the executor, valid until the next Forward/Free. */
+int MXTPUExecutorForward(MXTPUExecHandle exec, MXTPUNDHandle* out) {
+  if (exec == nullptr || out == nullptr) {
+    MXTPUSetLastError("ExecutorForward: null arg");
+    return -1;
+  }
+  auto* ex = static_cast<ExecRec*>(exec);
+  ex->clear_run();
+  // record through the shared autograd tape, then stash it per-executor
+  int prev = 0;
+  MXTPUAutogradSetRecording(1, &prev);
+  MXTPUNDHandle o = nullptr;
+  int rc = exec_eval(ex, ex->root, &o);
+  ex->tape = std::move(g_ag.tape);
+  g_ag.clear_tape();
+  MXTPUAutogradSetRecording(prev, nullptr);
+  if (rc != 0) return -1;
+  *out = o;
+  return 0;
+}
+
+/* Backward: seeds the root with ones and sweeps the recorded tape;
+ * per-argument grads retrievable via MXTPUExecutorGetGrad. */
+int MXTPUExecutorBackward(MXTPUExecHandle exec) {
+  if (exec == nullptr) {
+    MXTPUSetLastError("ExecutorBackward: null executor");
+    return -1;
+  }
+  auto* ex = static_cast<ExecRec*>(exec);
+  auto vit = ex->values.find(ex->root);
+  if (ex->tape.empty() || vit == ex->values.end()) {
+    MXTPUSetLastError("ExecutorBackward: run Forward first");
+    return -1;
+  }
+  // borrow the autograd machinery against this executor's tape
+  std::vector<TapeNode> saved = std::move(g_ag.tape);
+  auto saved_marked = std::move(g_ag.marked);
+  g_ag.tape = ex->tape;
+  g_ag.marked.clear();
+  for (auto& kv : ex->args) g_ag.marked.insert(kv.second);
+  int rc = backward_from(vit->second);
+  if (rc == 0) {
+    for (auto& kv : ex->args) {
+      auto git = g_ag.grads.find(kv.second);
+      if (git != g_ag.grads.end()) {
+        ex->grads[kv.first] = git->second;  // take ownership
+        g_ag.grads.erase(git);
+      }
+    }
+  }
+  g_ag.clear_grads();
+  g_ag.tape = std::move(saved);
+  g_ag.marked = std::move(saved_marked);
+  return rc;
+}
+
+/* Grad handle owned by the executor (valid until next Forward/Free). */
+int MXTPUExecutorGetGrad(MXTPUExecHandle exec, const char* arg_name,
+                         MXTPUNDHandle* grad) {
+  if (exec == nullptr || arg_name == nullptr || grad == nullptr) {
+    MXTPUSetLastError("ExecutorGetGrad: null arg");
+    return -1;
+  }
+  auto* ex = static_cast<ExecRec*>(exec);
+  auto it = ex->grads.find(arg_name);
+  if (it == ex->grads.end()) {
+    MXTPUSetLastError(
+        (std::string("ExecutorGetGrad: no grad for '") + arg_name +
+         "' (not an arg, or Backward not run)")
+            .c_str());
+    return -1;
+  }
+  *grad = it->second;
+  return 0;
+}
+
+int MXTPUExecutorFree(MXTPUExecHandle exec) {
+  auto* ex = static_cast<ExecRec*>(exec);
+  if (ex) ex->clear_run();
+  delete ex;
+  return 0;
+}
+
+// -- kvstore ----------------------------------------------------------------
+
+int MXTPUKVStoreCreate(const char* type, MXTPUKVHandle* out) {
+  if (out == nullptr) {
+    MXTPUSetLastError("KVStoreCreate: null out");
+    return -1;
+  }
+  std::string t = type ? type : "local";
+  if (t != "local" && t != "device") {
+    MXTPUSetLastError("KVStoreCreate: native tier supports 'local'/'device' "
+                      "(distributed kvstore lives in the jax runtime)");
+    return -1;
+  }
+  *out = new KVRec();
+  return 0;
+}
+
+/* {"optimizer": "sgd", "learning_rate": 0.1} — enables update-on-push
+ * (reference update_on_kvstore semantics with the server-side Updater). */
+int MXTPUKVStoreSetOptimizer(MXTPUKVHandle kv, const char* param_json) {
+  if (kv == nullptr) {
+    MXTPUSetLastError("KVStoreSetOptimizer: null kvstore");
+    return -1;
+  }
+  auto* k = static_cast<KVRec*>(kv);
+  std::string js = param_json ? param_json : "";
+  if (js.find("sgd") == std::string::npos) {
+    MXTPUSetLastError("KVStoreSetOptimizer: native tier supports sgd only");
+    return -1;
+  }
+  k->sgd = true;
+  k->lr = param_num(js, "learning_rate", 0.01);
+  return 0;
+}
+
+int MXTPUKVStoreInit(MXTPUKVHandle kv, int key, MXTPUNDHandle val) {
+  if (kv == nullptr || val == nullptr) {
+    MXTPUSetLastError("KVStoreInit: null arg");
+    return -1;
+  }
+  auto* k = static_cast<KVRec*>(kv);
+  if (k->store.count(key)) {
+    MXTPUSetLastError("KVStoreInit: key already initialized");
+    return -1;
+  }
+  MXTPUNDHandle copy = nd_copy(val);
+  if (copy == nullptr) return -1;
+  k->store[key] = copy;
+  return 0;
+}
+
+int MXTPUKVStorePush(MXTPUKVHandle kv, int key, MXTPUNDHandle grad) {
+  if (kv == nullptr || grad == nullptr) {
+    MXTPUSetLastError("KVStorePush: null arg");
+    return -1;
+  }
+  auto* k = static_cast<KVRec*>(kv);
+  auto it = k->store.find(key);
+  if (it == k->store.end()) {
+    MXTPUSetLastError("KVStorePush: key not initialized");
+    return -1;
+  }
+  MXTPUNDHandle next;
+  if (k->sgd) {  // w <- w - lr * grad
+    char buf[64];
+    std::snprintf(buf, sizeof(buf), "{\"scalar\": %.17g}", -k->lr);
+    MXTPUNDHandle step = inv1("_mul_scalar", {grad}, buf);
+    if (step == nullptr) return -1;
+    next = inv1("add", {it->second, step});
+    MXTPUNDArrayFree(step);
+  } else {  // plain aggregation (reference local kvstore reduce)
+    next = inv1("add", {it->second, grad});
+  }
+  if (next == nullptr) return -1;
+  MXTPUNDArrayFree(it->second);
+  it->second = next;
+  return 0;
+}
+
+/* Pull copies the stored value into the caller-provided array (shapes must
+ * match), mirroring the reference's pull-into-preallocated-NDArray. */
+int MXTPUKVStorePull(MXTPUKVHandle kv, int key, MXTPUNDHandle out) {
+  if (kv == nullptr || out == nullptr) {
+    MXTPUSetLastError("KVStorePull: null arg");
+    return -1;
+  }
+  auto* k = static_cast<KVRec*>(kv);
+  auto it = k->store.find(key);
+  if (it == k->store.end()) {
+    MXTPUSetLastError("KVStorePull: key not initialized");
+    return -1;
+  }
+  if (nd_size(out) != nd_size(it->second)) {
+    MXTPUSetLastError("KVStorePull: destination size mismatch");
+    return -1;
+  }
+  const void* src = nullptr;
+  MXTPUNDArrayGetData(it->second, &src);
+  const void* dst_c = nullptr;
+  MXTPUNDArrayGetData(out, &dst_c);
+  std::memcpy(const_cast<void*>(dst_c), src,
+              static_cast<size_t>(nd_size(out)) * sizeof(float));
+  return 0;
+}
+
+int MXTPUKVStoreFree(MXTPUKVHandle kv) {
+  delete static_cast<KVRec*>(kv);
+  return 0;
+}
+
+}  // extern "C"

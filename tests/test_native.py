@@ -299,3 +299,26 @@ def test_cpp_client_binary(tmp_path):
     r = subprocess.run([exe], capture_output=True, text=True, timeout=60)
     assert r.returncode == 0, f"stdout={r.stdout} stderr={r.stderr}"
     assert "all checks passed" in r.stdout
+
+
+def test_c_train_client_binary(tmp_path):
+    """Round-3 verdict ask #3: an external (non-Python) client must be able
+    to TRAIN through the flat C ABI — symbol compose, executor bind/forward/
+    backward, kvstore sgd update-on-push, autograd tape. The client asserts
+    its MLP loss drops >10x."""
+    _skip_without_lib()
+    import subprocess
+
+    src = os.path.join(os.path.dirname(__file__), "cclient",
+                       "mxtpu_train_client.c")
+    exe = str(tmp_path / "mxtpu_train_client")
+    cc = shutil.which("cc") or shutil.which("gcc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    subprocess.run([cc, "-O2", "-o", exe, src, "-ldl", "-lm"], check=True,
+                   capture_output=True)
+    r = subprocess.run([exe, native._lib_path()], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, f"stdout={r.stdout} stderr={r.stderr}"
+    assert "all checks passed" in r.stdout
+    assert "autograd tape ok" in r.stdout
