@@ -211,17 +211,48 @@ class InstanceNorm(HybridBlock):
 
 
 class Embedding(HybridBlock):
+    """``sparse_grad=True`` declares the weight's gradient row-sparse
+    (reference: EmbeddingOp with sparse_grad — src/operator/tensor/
+    indexing_op.cc). TPU stance: the vjp itself still lowers to one fused
+    XLA scatter-add (dense cotangent), but the *optimizer and kvstore* see a
+    compacted RowSparseNDArray over the rows touched this step — which is
+    where the reference's asymptotic win lives (rows-only Adam state math,
+    rows-only push/pull)."""
+
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._input_dim, self._output_dim = input_dim, output_dim
+        self._sparse_grad = sparse_grad
         with self.name_scope():
-            self.weight = self.params.get("weight", shape=(input_dim, output_dim),
-                                          dtype=dtype, init=weight_initializer)
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=weight_initializer,
+                grad_stype="row_sparse" if sparse_grad else "default")
 
     def hybrid_forward(self, F, x, weight):
+        if self._sparse_grad:
+            self._record_rows(x)
         return F.Embedding(x, weight, input_dim=self._input_dim,
                            output_dim=self._output_dim)
+
+    def _record_rows(self, x):
+        """Stash the rows this batch touches so the Trainer can compact the
+        dense cotangent into a RowSparseNDArray. Eager/recorded mode only —
+        under a jit trace the ids are tracers (and the staged TrainStep path
+        does its own sharding-aware update)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        raw = getattr(x, "_data", x)
+        if isinstance(raw, jax.core.Tracer):
+            return
+        rows = np.unique(np.asarray(jax.device_get(raw)).reshape(-1)).astype(np.int32)
+        prev = self.weight._sparse_rows
+        if prev is not None:
+            rows = np.union1d(np.asarray(prev), rows).astype(np.int32)
+        self.weight._sparse_rows = jnp.asarray(rows)
 
 
 class Flatten(HybridBlock):

@@ -38,6 +38,13 @@ class Parameter:
         self.init = init
         self.grad_req = grad_req if differentiable else "null"
         self.allow_deferred_init = allow_deferred_init
+        # storage types (reference NDArray stype / grad_stype): grad_stype
+        # "row_sparse" makes the Trainer hand the optimizer a compacted
+        # row-sparse gradient (lazy_update path) using the rows recorded by
+        # the consuming layer (Embedding sparse_grad=True)
+        self.stype = stype
+        self.grad_stype = grad_stype
+        self._sparse_rows = None  # set by sparse_grad layers each forward
         self._var = None
         self._nd: Optional[NDArray] = None
         self._deferred_init = None

@@ -105,6 +105,22 @@ class Trainer:
                     continue
                 raise MXNetError(f"Parameter {p.name} has no gradient; call "
                                  "attach_grad via initialize + record/backward")
+            # row-sparse gradient path (reference lazy_update): compact the
+            # cotangent to the rows recorded by the layer and run the
+            # rows-only optimizer update; state math never touches untouched
+            # rows. Runs per-param (not in the fused multi-tensor program —
+            # the row set is data-dependent).
+            if getattr(p, "grad_stype", "default") == "row_sparse" and \
+                    p._sparse_rows is not None:
+                from ..ndarray.sparse import RowSparseNDArray
+
+                rows = p._sparse_rows
+                rsp = RowSparseNDArray(d._grad._data[rows], (rows,),
+                                       tuple(d._grad.shape))
+                self._states[i] = self._optimizer.update(
+                    i, d, rsp, self._states[i])
+                p._sparse_rows = None
+                continue
             idxs.append(i)
             ws.append(d)
             gs.append(d._grad)

@@ -371,9 +371,17 @@ _DENSIFY_WARNED: set = set()
 
 
 def invoke(opdef, args, kwargs):
-    # sparse inputs densify at the op boundary (logical-tensor semantics);
-    # sparse-aware fast paths live in nd.sparse.{dot,add,retain} explicitly
+    # storage-type dispatch (FInferStorageType analog): ops with a declared
+    # sparse handler keep sparse inputs sparse; everything else densifies at
+    # the op boundary (logical-tensor semantics) with a once-per-op warning
     if any(hasattr(a, "_to_dense_raw") for a in args):
+        from .. import registry as _reg
+
+        sfn = _reg.get_sparse(getattr(opdef, "name", ""))
+        if sfn is not None:
+            out = sfn(*args, **kwargs)
+            if out is not NotImplemented:
+                return out
         from .. import config as _config
 
         if _config.get("storage_fallback_warn"):

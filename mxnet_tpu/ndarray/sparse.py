@@ -330,3 +330,82 @@ def subtract(lhs, rhs):
 
 def multiply(lhs, rhs):
     return _ewise("multiply", lhs, rhs)
+
+
+# --------------------------------------------------------------------------
+# registry-level storage dispatch (the FInferStorageType analog, round-3
+# verdict ask #4): these handlers make the GENERIC op names — nd.dot,
+# nd.sparse arithmetic, nd.sgd_update(lazy_update=True) — take the sparse
+# path automatically instead of requiring the explicit nd.sparse.* calls.
+# A handler returns NotImplemented for storage combinations it does not
+# accelerate; invoke() then falls back to densify-with-warning.
+# --------------------------------------------------------------------------
+from ..registry import register_sparse as _register_sparse
+
+
+@_register_sparse("dot")
+def _dot_storage(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) \
+            and not isinstance(rhs, BaseSparseNDArray):
+        return dot(lhs, rhs, transpose_a=transpose_a, transpose_b=transpose_b)
+    return NotImplemented
+
+
+@_register_sparse("add")
+def _add_storage(lhs, rhs, **kw):
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        return add(lhs, rhs)
+    return NotImplemented
+
+
+@_register_sparse("sparse_retain")
+def _retain_storage(data, indices, **kw):
+    if isinstance(data, RowSparseNDArray):
+        return retain(data, indices)
+    return NotImplemented
+
+
+def _lazy_update_handler(op_name):
+    """Rows-only fused optimizer update for RowSparseNDArray gradients
+    (reference: SGDUpdateRspImpl / AdamUpdateRspImpl lazy_update in
+    src/operator/optimizer_op.cc): gather the touched rows of the weight and
+    every row-shaped state, run the dense update kernel on the compacted
+    block, scatter back. Untouched rows see neither weight decay nor state
+    decay — exactly the reference's lazy semantics."""
+    from ..registry import get as _get
+
+    def handler(weight, grad, *rest, **kw):
+        if not isinstance(grad, RowSparseNDArray):
+            return NotImplemented
+        if isinstance(weight, BaseSparseNDArray):
+            return NotImplemented
+        if not kw.get("lazy_update", False):
+            return NotImplemented
+        rows = grad._aux[0]
+        wraw = _raw(weight)
+        nrows = wraw.shape[0]
+        gathered, is_row_state = [], []
+        for a in rest:
+            raw = _raw(a) if isinstance(a, NDArray) else a
+            row_state = (hasattr(raw, "ndim") and getattr(raw, "ndim", 0) >= 1
+                         and raw.shape[0] == nrows)
+            is_row_state.append(row_state)
+            gathered.append(raw[rows] if row_state else raw)
+        outs = _get(op_name).fn(wraw[rows], grad._data, *gathered, **kw)
+        outs = outs if isinstance(outs, tuple) else (outs,)
+        results = [_wrap(wraw.at[rows].set(outs[0]))]
+        oi = 1
+        for a, row_state in zip(rest, is_row_state):
+            if not row_state:
+                continue
+            raw = _raw(a) if isinstance(a, NDArray) else a
+            results.append(_wrap(raw.at[rows].set(outs[oi])))
+            oi += 1
+        return results[0] if len(results) == 1 else tuple(results)
+
+    return handler
+
+
+for _op in ("sgd_update", "sgd_mom_update", "adam_update"):
+    _register_sparse(_op)(_lazy_update_handler(_op))
+del _op

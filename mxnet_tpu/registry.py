@@ -66,6 +66,32 @@ def alias(existing: str, *names: str) -> None:
         _REGISTRY[n] = op
 
 
+# --------------------------------------------------------------------------
+# storage-type dispatch (the FInferStorageType analog —
+# include/mxnet/op_attr_types.h): an op may declare a sparse-aware handler;
+# invoke() consults it when any input is sparse, falling back to the
+# densify-with-warning path when the handler is absent or returns
+# NotImplemented for the given storage combination.
+# --------------------------------------------------------------------------
+_SPARSE_FNS: Dict[str, Callable] = {}
+
+
+def register_sparse(name: str):
+    """Decorator: attach a sparse-storage handler to a registered op name.
+    Handler signature matches the op's NDArray-level call; it returns an
+    NDArray/sparse NDArray, or NotImplemented to fall back to densify."""
+
+    def deco(fn):
+        _SPARSE_FNS[name] = fn
+        return fn
+
+    return deco
+
+
+def get_sparse(name: str):
+    return _SPARSE_FNS.get(name)
+
+
 def get(name: str) -> OpDef:
     try:
         return _REGISTRY[name]
