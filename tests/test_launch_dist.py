@@ -81,3 +81,95 @@ def test_two_process_dist_sync(tmp_path):
     out = res.stdout + res.stderr
     assert res.returncode == 0, out[-2000:]
     assert "RANK0-OK" in out and "RANK1-OK" in out, out[-2000:]
+
+
+_CHILD4 = textwrap.dedent("""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from mxnet_tpu.parallel import dist_init
+    dist_init()
+    N = 4
+    assert jax.process_count() == N, jax.process_count()
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    rank = jax.process_index()
+
+    # --- 1. sync: push REPLACES with the per-step all-worker sum ----------
+    kv = mx.kv.create("dist_sync")
+    kv.init("w", nd.zeros((4,)))
+    for step in range(3):
+        kv.push("w", nd.full((4,), float(rank + 1)))   # 1+2+3+4 = 10
+        out = nd.zeros((4,))
+        kv.pull("w", out=out)
+        assert abs(float(out.asnumpy()[0]) - 10.0) < 1e-6, out.asnumpy()
+
+    # --- 2. async: pushes ACCUMULATE across steps (no replace barrier) ----
+    kva = mx.kv.create("dist_async")
+    kva.init("a", nd.zeros((2,)))
+    for step in range(3):
+        kva.push("a", nd.full((2,), float(rank + 1)))
+    out = nd.zeros((2,))
+    kva.pull("a", out=out)
+    # 3 steps x sum(1..4) accumulated, NOT replaced
+    assert abs(float(out.asnumpy()[0]) - 30.0) < 1e-6, out.asnumpy()
+
+    # --- 3. 2-bit compression with error feedback converges at n=4 --------
+    kvc = mx.kv.create("dist_sync")
+    kvc.set_gradient_compression({"type": "2bit", "threshold": 0.1})
+    target = 2.0
+    w = 0.0
+    kvc.init("g", nd.zeros((1,)))
+    lr = 0.2
+    for step in range(80):
+        grad = (w - target) / N  # same grad on all workers, tiny magnitude
+        kvc.push("g", nd.full((1,), grad))
+        out = nd.zeros((1,))
+        kvc.pull("g", out=out)
+        w = w - lr * float(out.asnumpy()[0])
+    # quantized to +-threshold with residual carry: must still converge near
+    assert abs(w - target) < 0.05, w
+
+    # --- 4. row_sparse pull at n=4 ----------------------------------------
+    from mxnet_tpu.ndarray import sparse as sp
+    kvr = mx.kv.create("dist_sync")
+    table = np.arange(12, dtype=np.float32).reshape(6, 2)
+    kvr.init("emb", nd.array(table))
+    rows = nd.array(np.array([1, 4]), dtype="int32")
+    out_r = sp.zeros("row_sparse", (6, 2))
+    got = kvr.row_sparse_pull("emb", out=out_r, row_ids=rows)
+    vals = np.asarray(jax.device_get(got._data if hasattr(got, "_data") else out_r._data))
+    np.testing.assert_allclose(vals, table[[1, 4]], rtol=1e-6)
+
+    print(f"RANK{rank}-OK4", flush=True)
+""")
+
+
+@pytest.mark.timeout(300)
+@pytest.mark.slow
+def test_four_process_dist_matrix(tmp_path):
+    """Round-3 verdict ask #6 (reference: tests/nightly/dist_sync_kvstore.py
+    / dist_async_kvstore.py run as 4 localhost processes): sync replace vs
+    async accumulate, 2-bit compression error-feedback convergence, and
+    row_sparse pull — all at n=4."""
+    child = tmp_path / "child4.py"
+    child.write_text(_CHILD4)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo_root
+    res = subprocess.run(
+        [sys.executable, "tools/launch.py", "-n", "4", sys.executable, str(child)],
+        capture_output=True, text=True, timeout=290, env=env, cwd=repo_root)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out[-3000:]
+    for r in range(4):
+        assert f"RANK{r}-OK4" in out, out[-3000:]
