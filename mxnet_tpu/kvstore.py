@@ -176,9 +176,14 @@ class KVStore:
                 got = _sp.retain(val, rid)
             else:
                 # dense table: gather the requested rows directly (no
-                # densify/compaction pass) — the per-step embedding hot path
-                rid_raw = jnp.unique(jnp.asarray(
-                    rid._data if isinstance(rid, NDArray) else rid, jnp.int32))
+                # densify/compaction pass) — the per-step embedding hot path.
+                # as_index_array guards the int64->int32 narrowing: a >2^31
+                # row id must hard-error, never wrap to a valid-looking row
+                from .base import as_index_array
+
+                rid_raw = jnp.unique(jnp.asarray(as_index_array(
+                    rid._data if isinstance(rid, NDArray) else rid,
+                    "row_sparse_pull row_ids"), jnp.int32))
                 got = _sp.RowSparseNDArray(val._data[rid_raw], (rid_raw,), val.shape)
             for x in (o if isinstance(o, (list, tuple)) else [o]):
                 x._data, x._aux, x._shape = got._data, got._aux, got._shape

@@ -267,7 +267,11 @@ def retain(rsp, indices):
     src/operator/tensor/sparse_retain-inl.h)."""
     if not isinstance(rsp, RowSparseNDArray):
         raise MXNetError("retain expects a RowSparseNDArray")
-    want = jnp.asarray(_raw(indices) if isinstance(indices, NDArray) else indices, jnp.int32)
+    from ..base import as_index_array
+
+    want = jnp.asarray(as_index_array(
+        _raw(indices) if isinstance(indices, NDArray) else indices,
+        "sparse_retain indices"), jnp.int32)
     # membership of stored rows in `want` (both small host-side typically)
     stored = rsp._aux[0]
     keep = jnp.isin(stored, want)

@@ -3,9 +3,14 @@
 Covers the reference's ``src/operator/nn/`` family — FullyConnected,
 Convolution (cuDNN autotuned in the reference), BatchNorm, LayerNorm,
 Pooling, Activation, softmax, Dropout, RNN — as lax/jnp compositions that XLA
-maps onto the MXU. Layout: the reference is NCHW (cuDNN's native layout); TPU
-convs prefer NHWC, so convs transpose at the boundary and keep the public
-NCHW contract — XLA folds the transposes into the conv's dimension_numbers.
+maps onto the MXU. Layout: the public contract is NCHW (the reference's
+cuDNN-native layout) and ``convolution`` passes NCHW/OIHW
+``dimension_numbers`` AS WRITTEN — no Python-level transposes. XLA's layout
+assignment picks the physical tiling for TPU itself (logical dims !=
+physical layout on TPU; hand-transposing to NHWC in the graph would just
+add ops the compiler has to cancel). Measured on hardware, round 4: see
+KERNELBENCH conv_layout rows — NCHW-as-written vs explicit-NHWC
+``conv_general_dilated`` on a ResNet-50 stage-3 shape.
 
 RNN replaces the cuDNN fused descriptor machinery (``src/operator/rnn.cc``,
 ``cudnn_rnn-inl.h``) with a ``lax.scan`` over fused-gate cells — the
