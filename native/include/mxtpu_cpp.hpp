@@ -128,6 +128,127 @@ inline NDArray relu(const NDArray& x) {
   return std::move(invoke("relu", {&x})[0]);
 }
 
+// ---- training surface (reference: cpp-package Symbol/Executor/KVStore) ----
+
+// Non-owning view of an executor-owned or autograd-owned array.
+inline std::vector<float> view_values(MXTPUNDHandle h) {
+  const void* raw = nullptr;
+  check(MXTPUNDArrayGetData(h, &raw), "GetData");
+  int64_t n = 0;
+  check(MXTPUNDArraySize(h, &n), "Size");
+  const float* f = static_cast<const float*>(raw);
+  return std::vector<float>(f, f + n);
+}
+
+class Symbol {
+ public:
+  static Symbol Variable(const std::string& name) {
+    MXTPUSymHandle h = nullptr;
+    check(MXTPUSymbolCreateVariable(name.c_str(), &h), "SymbolCreateVariable");
+    return Symbol(h);
+  }
+
+  static Symbol Op(const std::string& op, const std::vector<Symbol*>& inputs,
+                   const std::string& param_json = "",
+                   const std::string& name = "") {
+    MXTPUSymHandle h = nullptr;
+    check(MXTPUSymbolCreateAtomicSymbol(op.c_str(), param_json.c_str(),
+                                        name.empty() ? op.c_str()
+                                                     : name.c_str(),
+                                        &h),
+          "SymbolCreateAtomicSymbol");
+    std::vector<MXTPUSymHandle> ins;
+    for (Symbol* s : inputs) ins.push_back(s->handle());
+    check(MXTPUSymbolCompose(h, ins.data(), static_cast<int>(ins.size())),
+          "SymbolCompose");
+    return Symbol(h);
+  }
+
+  explicit Symbol(MXTPUSymHandle h) : h_(h) {}
+  Symbol(Symbol&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Symbol(const Symbol&) = delete;
+  Symbol& operator=(const Symbol&) = delete;
+  ~Symbol() {
+    if (h_) MXTPUSymbolFree(h_);
+  }
+  MXTPUSymHandle handle() const { return h_; }
+
+ private:
+  MXTPUSymHandle h_ = nullptr;
+};
+
+class Executor {
+ public:
+  // args pair variable names with client-owned NDArrays (which must outlive
+  // the executor; content updates are seen by the next Forward)
+  Executor(const Symbol& sym,
+           const std::vector<std::pair<std::string, const NDArray*>>& args) {
+    std::vector<const char*> names;
+    std::vector<MXTPUNDHandle> arrs;
+    for (auto& kv : args) {
+      names.push_back(kv.first.c_str());
+      arrs.push_back(kv.second->handle());
+    }
+    check(MXTPUExecutorBind(sym.handle(), names.data(), arrs.data(),
+                            static_cast<int>(arrs.size()), &h_),
+          "ExecutorBind");
+  }
+  Executor(const Executor&) = delete;
+  Executor& operator=(const Executor&) = delete;
+  ~Executor() {
+    if (h_) MXTPUExecutorFree(h_);
+  }
+
+  // returns the output VALUES (the handle stays executor-owned)
+  std::vector<float> forward() {
+    MXTPUNDHandle out = nullptr;
+    check(MXTPUExecutorForward(h_, &out), "ExecutorForward");
+    return view_values(out);
+  }
+
+  void backward() { check(MXTPUExecutorBackward(h_), "ExecutorBackward"); }
+
+  // executor-owned grad handle for an argument (valid until next forward)
+  MXTPUNDHandle grad(const std::string& arg) const {
+    MXTPUNDHandle g = nullptr;
+    check(MXTPUExecutorGetGrad(h_, arg.c_str(), &g), "ExecutorGetGrad");
+    return g;
+  }
+
+ private:
+  MXTPUExecHandle h_ = nullptr;
+};
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string& type = "local") {
+    check(MXTPUKVStoreCreate(type.c_str(), &h_), "KVStoreCreate");
+  }
+  KVStore(const KVStore&) = delete;
+  KVStore& operator=(const KVStore&) = delete;
+  ~KVStore() {
+    if (h_) MXTPUKVStoreFree(h_);
+  }
+
+  void set_optimizer(double lr) {
+    std::string js = "{\"optimizer\": \"sgd\", \"learning_rate\": " +
+                     std::to_string(lr) + "}";
+    check(MXTPUKVStoreSetOptimizer(h_, js.c_str()), "KVStoreSetOptimizer");
+  }
+  void init(int key, const NDArray& v) {
+    check(MXTPUKVStoreInit(h_, key, v.handle()), "KVStoreInit");
+  }
+  void push(int key, MXTPUNDHandle grad) {
+    check(MXTPUKVStorePush(h_, key, grad), "KVStorePush");
+  }
+  void pull(int key, const NDArray& out) {
+    check(MXTPUKVStorePull(h_, key, out.handle()), "KVStorePull");
+  }
+
+ private:
+  MXTPUKVHandle h_ = nullptr;
+};
+
 }  // namespace mxtpu
 
 #endif  // MXTPU_CPP_HPP_

@@ -46,6 +46,53 @@ int main() {
       std::fprintf(stderr, "error path failed\n");
       return 1;
     }
+
+    // ---- training surface: linear regression via Symbol/Executor/KVStore
+    // (reference cpp-package MLP example shape) ----
+    const int B = 8, IN = 4;
+    std::vector<float> xv(B * IN), yv(B);
+    unsigned seed = 3;
+    for (auto& f : xv) {
+      seed = seed * 1103515245u + 12345u;
+      f = ((seed >> 16) % 1000) / 500.0f - 1.0f;
+    }
+    for (int i = 0; i < B; ++i) {
+      float acc = 0.0f;
+      for (int j = 0; j < IN; ++j) acc += 0.5f * xv[i * IN + j];
+      yv[i] = acc;
+    }
+    mxtpu::NDArray x(xv, {B, IN});
+    mxtpu::NDArray y(yv, {B, 1});
+    mxtpu::NDArray w(std::vector<float>(IN, 0.0f), {IN, 1});
+
+    auto vx = mxtpu::Symbol::Variable("x");
+    auto vw = mxtpu::Symbol::Variable("w");
+    auto vy = mxtpu::Symbol::Variable("y");
+    auto pred = mxtpu::Symbol::Op("dot", {&vx, &vw});
+    auto diff = mxtpu::Symbol::Op("subtract", {&pred, &vy});
+    auto sq = mxtpu::Symbol::Op("multiply", {&diff, &diff});
+    auto loss = mxtpu::Symbol::Op("sum", {&sq});
+
+    mxtpu::Executor ex(loss, {{"x", &x}, {"w", &w}, {"y", &y}});
+    mxtpu::KVStore kv("local");
+    kv.set_optimizer(0.02);
+    kv.init(0, w);
+
+    float first = -1.0f, last = -1.0f;
+    for (int step = 0; step < 100; ++step) {
+      auto lv = ex.forward();
+      last = lv[0];
+      if (step == 0) first = lv[0];
+      ex.backward();
+      kv.push(0, ex.grad("w"));
+      kv.pull(0, w);
+    }
+    if (!(last < first / 10.0f)) {
+      std::fprintf(stderr, "cpp training failed to converge: %f -> %f\n",
+                   first, last);
+      return 1;
+    }
+    std::printf("cpp training loss %.4f -> %.4f\n", first, last);
   } catch (const std::exception& e) {
     std::fprintf(stderr, "unexpected: %s\n", e.what());
     return 1;
