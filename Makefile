@@ -1,0 +1,37 @@
+# One-command CI (reference: ci/build.py + ci/docker/runtime_functions.sh —
+# the function registry every CI stage called). Stages:
+#   sanity  - syntax/compile sweep over the package + tools (no linters in
+#             the image, so compileall is the lint floor)
+#   native  - build libmxtpu.so (C++ runtime: recordio/jpeg/runtime/c_api)
+#   fast    - pytest without @slow (target < 10 min on 8 virtual CPU devs)
+#   slow    - the @slow remainder (model compiles, 4-process launches)
+#   ci      - sanity + native + fast (the pre-merge gate)
+#   test    - full suite (ci + slow), what the driver effectively runs
+
+PY ?= python
+
+.PHONY: ci sanity native fast slow test bench clean
+
+ci: sanity native fast
+
+sanity:
+	$(PY) -m compileall -q mxnet_tpu tools tests examples bench.py __graft_entry__.py
+
+native:
+	$(MAKE) -C native
+
+fast: native
+	$(PY) -m pytest tests/ -q -m "not slow"
+
+slow: native
+	$(PY) -m pytest tests/ -q -m "slow"
+
+test: sanity native
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +
