@@ -238,15 +238,20 @@ class Embedding(HybridBlock):
 
     def _record_rows(self, x):
         """Stash the rows this batch touches so the Trainer can compact the
-        dense cotangent into a RowSparseNDArray. Eager/recorded mode only —
-        under a jit trace the ids are tracers (and the staged TrainStep path
-        does its own sharding-aware update)."""
+        dense cotangent into a RowSparseNDArray. Recorded training forwards
+        only — under a jit/symbolic trace the ids aren't concrete (and the
+        staged TrainStep path does its own sharding-aware update), and rows
+        seen only by inference batches must not enter the next lazy update
+        (reference lazy_update semantics: only rows present in the gradient)."""
         import jax
         import jax.numpy as jnp
         import numpy as np
 
+        from ... import autograd
+        if not autograd.is_recording():
+            return
         raw = getattr(x, "_data", x)
-        if isinstance(raw, jax.core.Tracer):
+        if not isinstance(raw, (jax.Array, np.ndarray)) or isinstance(raw, jax.core.Tracer):
             return
         rows = np.unique(np.asarray(jax.device_get(raw)).reshape(-1)).astype(np.int32)
         prev = self.weight._sparse_rows

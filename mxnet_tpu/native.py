@@ -130,6 +130,36 @@ def lib() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(vp), ctypes.POINTER(ctypes.c_int)]
     L.MXTPUSetInvokeBridge.argtypes = [ctypes.c_void_p]
     L.MXTPUSetLastError.argtypes = [ctypes.c_char_p]
+    # c_api_graph.cc: autograd/symbol/executor/kvstore ABI (without argtypes
+    # ctypes would truncate 64-bit handles passed as raw Python ints)
+    if hasattr(L, "MXTPUAutogradBackward"):
+        L.MXTPUAutogradSetRecording.argtypes = [ctypes.c_int,
+                                                ctypes.POINTER(ctypes.c_int)]
+        L.MXTPUAutogradMarkVariables.argtypes = [ctypes.c_int,
+                                                 ctypes.POINTER(vp)]
+        L.MXTPUAutogradBackward.argtypes = [vp]
+        L.MXTPUAutogradGetGrad.argtypes = [vp, ctypes.POINTER(vp)]
+        L.MXTPUSymbolCreateVariable.argtypes = [ctypes.c_char_p,
+                                                ctypes.POINTER(vp)]
+        L.MXTPUSymbolCreateAtomicSymbol.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(vp)]
+        L.MXTPUSymbolCompose.argtypes = [vp, ctypes.POINTER(vp), ctypes.c_int]
+        L.MXTPUSymbolFree.argtypes = [vp]
+        L.MXTPUExecutorBind.argtypes = [vp, ctypes.POINTER(ctypes.c_char_p),
+                                        ctypes.POINTER(vp), ctypes.c_int,
+                                        ctypes.POINTER(vp)]
+        L.MXTPUExecutorForward.argtypes = [vp, ctypes.POINTER(vp)]
+        L.MXTPUExecutorBackward.argtypes = [vp]
+        L.MXTPUExecutorGetGrad.argtypes = [vp, ctypes.c_char_p,
+                                           ctypes.POINTER(vp)]
+        L.MXTPUExecutorFree.argtypes = [vp]
+        L.MXTPUKVStoreCreate.argtypes = [ctypes.c_char_p, ctypes.POINTER(vp)]
+        L.MXTPUKVStoreSetOptimizer.argtypes = [vp, ctypes.c_char_p]
+        L.MXTPUKVStoreInit.argtypes = [vp, ctypes.c_int, vp]
+        L.MXTPUKVStorePush.argtypes = [vp, ctypes.c_int, vp]
+        L.MXTPUKVStorePull.argtypes = [vp, ctypes.c_int, vp]
+        L.MXTPUKVStoreFree.argtypes = [vp]
     _LIB = L
     _install_invoke_bridge(L)
     return _LIB

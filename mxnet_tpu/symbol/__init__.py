@@ -266,7 +266,9 @@ class Symbol:
         inferred = _infer_shapes_partial(self, dict(known)) or {}
         args = {}
         for name in self.list_arguments():
-            shp = known.get(name) or inferred.get(name)
+            # membership, not truthiness: an explicit scalar shape () must
+            # win over (or instead of) the inferred shape
+            shp = known[name] if name in known else inferred.get(name)
             if shp is None:
                 raise MXNetError(f"simple_bind: missing shape for {name}")
             args[name] = NDArray(jnp.zeros(tuple(shp), jnp.float32))

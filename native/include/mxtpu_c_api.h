@@ -77,8 +77,10 @@ void MXTPUSetLastError(const char* msg);
  * over Imperative::Backward). Recording captures every successful
  * MXTPUImperativeInvoke on a thread-local tape; Backward sweeps it with
  * VJPs composed from public ops. Input/output handles referenced by the
- * tape must stay alive until Backward/Reset. Bridge-served ops are NOT
- * recorded (their VJPs live in the jax runtime). ---- */
+ * tape must stay alive until Backward/Reset — this includes bridge-served
+ * ops, which ARE recorded like native ones; if a recorded bridge op lies
+ * on the backward path, Backward fails loudly (its VJP lives in the jax
+ * runtime, not here) rather than silently skipping it. ---- */
 int MXTPUAutogradSetRecording(int recording, int* prev);
 int MXTPUAutogradMarkVariables(int n, MXTPUNDHandle* vars);
 int MXTPUAutogradBackward(MXTPUNDHandle head);

@@ -29,7 +29,7 @@ inline void check(int rc, const char* ctx) {
     throw Error(std::string(ctx) + ": " + MXTPUGetLastError());
 }
 
-// RAII NDArray handle (float32 host tensor).
+// RAII NDArray handle (f32/f64 host tensor — the native tier's dtypes).
 class NDArray {
  public:
   NDArray() = default;
@@ -39,6 +39,18 @@ class NDArray {
                                       static_cast<int>(shape.size()),
                                       kMXTPUFloat32, &h_),
           "NDArray create");
+  }
+
+  // f64 via a named factory, not a constructor overload — an overload would
+  // make existing braced-int-list calls (NDArray({1,2,3},{3})) ambiguous
+  static NDArray F64(const std::vector<double>& data,
+                     const std::vector<int64_t>& shape) {
+    MXTPUNDHandle h = nullptr;
+    check(MXTPUNDArrayCreateFromBytes(data.data(), shape.data(),
+                                      static_cast<int>(shape.size()),
+                                      kMXTPUFloat64, &h),
+          "NDArray create");
+    return NDArray(h);
   }
 
   // adopt an existing handle (takes ownership)
@@ -72,11 +84,28 @@ class NDArray {
     return n;
   }
 
+  int dtype() const {
+    int dt = 0;
+    check(MXTPUNDArrayGetDType(h_, &dt), "GetDType");
+    return dt;
+  }
+
   std::vector<float> to_vector() const {
+    if (dtype() != kMXTPUFloat32)
+      throw Error("to_vector: array is not float32 (use to_vector_f64)");
     const void* raw = nullptr;
     check(MXTPUNDArrayGetData(h_, &raw), "GetData");
     const float* f = static_cast<const float*>(raw);
     return std::vector<float>(f, f + size());
+  }
+
+  std::vector<double> to_vector_f64() const {
+    if (dtype() != kMXTPUFloat64)
+      throw Error("to_vector_f64: array is not float64 (use to_vector)");
+    const void* raw = nullptr;
+    check(MXTPUNDArrayGetData(h_, &raw), "GetData");
+    const double* f = static_cast<const double*>(raw);
+    return std::vector<double>(f, f + size());
   }
 
  private:

@@ -123,20 +123,44 @@ bool parse_params(const char* json, Params* out, std::string* err) {
 }
 
 // ---------------------------------------------------------------------------
-// Native op registry (host reference kernels, f32).
+// Native op registry (host reference kernels, f32 + f64 — the two-dtype
+// breadth of the reference's MSHADOW_REAL_TYPE_SWITCH; everything else goes
+// through the jax bridge).
 // ---------------------------------------------------------------------------
 using NativeOp = std::function<int(std::vector<NDArrayRec*>&, const Params&,
                                    std::vector<NDArrayRec*>*)>;
 
-int require_f32(std::vector<NDArrayRec*>& ins, const char* op) {
+// All inputs must share one dtype from {f32, f64}; writes it to *dtype.
+int common_dtype(std::vector<NDArrayRec*>& ins, const char* op, int* dtype) {
+  int dt = ins.empty() ? kMXTPUFloat32 : ins[0]->dtype;
   for (auto* a : ins) {
-    if (a->dtype != kMXTPUFloat32) {
-      g_last_error = std::string(op) + ": native tier supports float32 only "
-                     "(use the jax bridge for other dtypes)";
+    if (a->dtype != dt) {
+      g_last_error = std::string(op) + ": mixed input dtypes";
       return -1;
     }
   }
+  if (dt != kMXTPUFloat32 && dt != kMXTPUFloat64) {
+    g_last_error = std::string(op) + ": native tier supports float32/float64 "
+                   "(use the jax bridge for other dtypes)";
+    return -1;
+  }
+  *dtype = dt;
   return 0;
+}
+
+template <typename T> T* tdata(NDArrayRec* r) {
+  return reinterpret_cast<T*>(r->data.data());
+}
+template <typename T> const T* tdata(const NDArrayRec* r) {
+  return reinterpret_cast<const T*>(r->data.data());
+}
+
+// run fn with a zero-value of the resolved element type (f32 or f64);
+// callers must have validated dtype via common_dtype first
+template <typename F>
+int dtype_dispatch(int dtype, F&& fn) {
+  if (dtype == kMXTPUFloat64) return fn(double{});
+  return fn(float{});
 }
 
 NDArrayRec* make_out(const std::vector<int64_t>& shape, int dtype) {
@@ -150,7 +174,8 @@ NDArrayRec* make_out(const std::vector<int64_t>& shape, int dtype) {
 int op_dot(std::vector<NDArrayRec*>& ins, const Params& ps,
            std::vector<NDArrayRec*>* outs) {
   if (ins.size() != 2) { g_last_error = "dot: expects 2 inputs"; return -1; }
-  if (require_f32(ins, "dot")) return -1;
+  int dt;
+  if (common_dtype(ins, "dot", &dt)) return -1;
   NDArrayRec *a = ins[0], *b = ins[1];
   if (a->shape.size() != 2 || b->shape.size() != 2) {
     g_last_error = "dot: native tier handles 2-D only";
@@ -162,30 +187,34 @@ int op_dot(std::vector<NDArrayRec*>& ins, const Params& ps,
   int64_t k2 = tb ? b->shape[1] : b->shape[0];
   int64_t n = tb ? b->shape[0] : b->shape[1];
   if (k != k2) { g_last_error = "dot: inner dimensions mismatch"; return -1; }
-  NDArrayRec* o = make_out({m, n}, kMXTPUFloat32);
-  const float* A = a->f32();
-  const float* B = b->f32();
-  float* C = o->f32();
+  NDArrayRec* o = make_out({m, n}, dt);
   int64_t lda = a->shape[1], ldb = b->shape[1];
-  for (int64_t i = 0; i < m; ++i) {
-    for (int64_t j = 0; j < n; ++j) {
-      double acc = 0.0;
-      for (int64_t t = 0; t < k; ++t) {
-        float av = ta ? A[t * lda + i] : A[i * lda + t];
-        float bv = tb ? B[j * ldb + t] : B[t * ldb + j];
-        acc += static_cast<double>(av) * bv;
+  return dtype_dispatch(dt, [&](auto zero) {
+    using T = decltype(zero);
+    const T* A = tdata<T>(a);
+    const T* B = tdata<T>(b);
+    T* C = tdata<T>(o);
+    for (int64_t i = 0; i < m; ++i) {
+      for (int64_t j = 0; j < n; ++j) {
+        double acc = 0.0;
+        for (int64_t t = 0; t < k; ++t) {
+          T av = ta ? A[t * lda + i] : A[i * lda + t];
+          T bv = tb ? B[j * ldb + t] : B[t * ldb + j];
+          acc += static_cast<double>(av) * bv;
+        }
+        C[i * n + j] = static_cast<T>(acc);
       }
-      C[i * n + j] = static_cast<float>(acc);
     }
-  }
-  outs->push_back(o);
-  return 0;
+    outs->push_back(o);
+    return 0;
+  });
 }
 
 int op_softmax(std::vector<NDArrayRec*>& ins, const Params& ps,
                std::vector<NDArrayRec*>* outs) {
   if (ins.size() != 1) { g_last_error = "softmax: expects 1 input"; return -1; }
-  if (require_f32(ins, "softmax")) return -1;
+  int dt;
+  if (common_dtype(ins, "softmax", &dt)) return -1;
   NDArrayRec* a = ins[0];
   int ndim = static_cast<int>(a->shape.size());
   int axis = static_cast<int>(ps.num("axis", -1));
@@ -196,49 +225,66 @@ int op_softmax(std::vector<NDArrayRec*>& ins, const Params& ps,
   }
   int64_t inner = a->shape[ndim - 1];
   int64_t outer = a->size() / inner;
-  NDArrayRec* o = make_out(a->shape, kMXTPUFloat32);
-  const float* X = a->f32();
-  float* Y = o->f32();
-  for (int64_t r = 0; r < outer; ++r) {
-    const float* x = X + r * inner;
-    float* y = Y + r * inner;
-    float mx = x[0];
-    for (int64_t i = 1; i < inner; ++i) mx = std::max(mx, x[i]);
-    double sum = 0.0;
-    for (int64_t i = 0; i < inner; ++i) { y[i] = std::exp(x[i] - mx); sum += y[i]; }
-    for (int64_t i = 0; i < inner; ++i) y[i] = static_cast<float>(y[i] / sum);
-  }
-  outs->push_back(o);
-  return 0;
+  NDArrayRec* o = make_out(a->shape, dt);
+  return dtype_dispatch(dt, [&](auto zero) {
+    using T = decltype(zero);
+    const T* X = tdata<T>(a);
+    T* Y = tdata<T>(o);
+    for (int64_t r = 0; r < outer; ++r) {
+      const T* x = X + r * inner;
+      T* y = Y + r * inner;
+      T mx = x[0];
+      for (int64_t i = 1; i < inner; ++i) mx = std::max(mx, x[i]);
+      double sum = 0.0;
+      for (int64_t i = 0; i < inner; ++i) {
+        y[i] = std::exp(x[i] - mx);
+        sum += y[i];
+      }
+      for (int64_t i = 0; i < inner; ++i)
+        y[i] = static_cast<T>(y[i] / sum);
+    }
+    outs->push_back(o);
+    return 0;
+  });
 }
 
+template <typename F>
 int binary_ew(std::vector<NDArrayRec*>& ins, std::vector<NDArrayRec*>* outs,
-              const char* name, float (*fn)(float, float)) {
+              const char* name, F fn) {
   if (ins.size() != 2) { g_last_error = std::string(name) + ": expects 2 inputs"; return -1; }
-  if (require_f32(ins, name)) return -1;
+  int dt;
+  if (common_dtype(ins, name, &dt)) return -1;
   if (ins[0]->shape != ins[1]->shape) {
     g_last_error = std::string(name) + ": native tier requires equal shapes";
     return -1;
   }
-  NDArrayRec* o = make_out(ins[0]->shape, kMXTPUFloat32);
-  const float* A = ins[0]->f32();
-  const float* B = ins[1]->f32();
-  float* C = o->f32();
-  for (int64_t i = 0, n = o->size(); i < n; ++i) C[i] = fn(A[i], B[i]);
-  outs->push_back(o);
-  return 0;
+  NDArrayRec* o = make_out(ins[0]->shape, dt);
+  return dtype_dispatch(dt, [&](auto zero) {
+    using T = decltype(zero);
+    const T* A = tdata<T>(ins[0]);
+    const T* B = tdata<T>(ins[1]);
+    T* C = tdata<T>(o);
+    for (int64_t i = 0, n = o->size(); i < n; ++i) C[i] = fn(A[i], B[i]);
+    outs->push_back(o);
+    return 0;
+  });
 }
 
+template <typename F>
 int unary_ew(std::vector<NDArrayRec*>& ins, std::vector<NDArrayRec*>* outs,
-             const char* name, float (*fn)(float)) {
+             const char* name, F fn) {
   if (ins.size() != 1) { g_last_error = std::string(name) + ": expects 1 input"; return -1; }
-  if (require_f32(ins, name)) return -1;
-  NDArrayRec* o = make_out(ins[0]->shape, kMXTPUFloat32);
-  const float* A = ins[0]->f32();
-  float* C = o->f32();
-  for (int64_t i = 0, n = o->size(); i < n; ++i) C[i] = fn(A[i]);
-  outs->push_back(o);
-  return 0;
+  int dt;
+  if (common_dtype(ins, name, &dt)) return -1;
+  NDArrayRec* o = make_out(ins[0]->shape, dt);
+  return dtype_dispatch(dt, [&](auto zero) {
+    using T = decltype(zero);
+    const T* A = tdata<T>(ins[0]);
+    T* C = tdata<T>(o);
+    for (int64_t i = 0, n = o->size(); i < n; ++i) C[i] = fn(A[i]);
+    outs->push_back(o);
+    return 0;
+  });
 }
 
 int op_sum(std::vector<NDArrayRec*>& ins, const Params& ps,
@@ -246,17 +292,21 @@ int op_sum(std::vector<NDArrayRec*>& ins, const Params& ps,
   // axis absent -> reduce all to a scalar; axis=0 on 2-D -> column sums
   // (the two reductions the graph tier's VJPs need)
   if (ins.size() != 1) { g_last_error = "sum: expects 1 input"; return -1; }
-  if (require_f32(ins, "sum")) return -1;
+  int dt;
+  if (common_dtype(ins, "sum", &dt)) return -1;
   NDArrayRec* a = ins[0];
-  const float* A = a->f32();
   bool has_axis = ps.nums.count("axis") > 0;
   if (!has_axis) {
-    NDArrayRec* o = make_out({1}, kMXTPUFloat32);
-    double acc = 0.0;
-    for (int64_t i = 0, n = a->size(); i < n; ++i) acc += A[i];
-    o->f32()[0] = static_cast<float>(acc);
-    outs->push_back(o);
-    return 0;
+    NDArrayRec* o = make_out({1}, dt);
+    return dtype_dispatch(dt, [&](auto zero) {
+      using T = decltype(zero);
+      const T* A = tdata<T>(a);
+      double acc = 0.0;
+      for (int64_t i = 0, n = a->size(); i < n; ++i) acc += A[i];
+      tdata<T>(o)[0] = static_cast<T>(acc);
+      outs->push_back(o);
+      return 0;
+    });
   }
   int axis = static_cast<int>(ps.num("axis", 0));
   if (a->shape.size() != 2 || axis != 0) {
@@ -264,58 +314,69 @@ int op_sum(std::vector<NDArrayRec*>& ins, const Params& ps,
     return -1;
   }
   int64_t rows = a->shape[0], cols = a->shape[1];
-  NDArrayRec* o = make_out({cols}, kMXTPUFloat32);
-  float* C = o->f32();
-  for (int64_t j = 0; j < cols; ++j) {
-    double acc = 0.0;
-    for (int64_t i = 0; i < rows; ++i) acc += A[i * cols + j];
-    C[j] = static_cast<float>(acc);
-  }
-  outs->push_back(o);
-  return 0;
+  NDArrayRec* o = make_out({cols}, dt);
+  return dtype_dispatch(dt, [&](auto zero) {
+    using T = decltype(zero);
+    const T* A = tdata<T>(a);
+    T* C = tdata<T>(o);
+    for (int64_t j = 0; j < cols; ++j) {
+      double acc = 0.0;
+      for (int64_t i = 0; i < rows; ++i) acc += A[i * cols + j];
+      C[j] = static_cast<T>(acc);
+    }
+    outs->push_back(o);
+    return 0;
+  });
 }
 
 int op_mul_scalar(std::vector<NDArrayRec*>& ins, const Params& ps,
                   std::vector<NDArrayRec*>* outs) {
   if (ins.size() != 1) { g_last_error = "_mul_scalar: expects 1 input"; return -1; }
-  if (require_f32(ins, "_mul_scalar")) return -1;
-  float s = static_cast<float>(ps.num("scalar", 1.0));
-  NDArrayRec* o = make_out(ins[0]->shape, kMXTPUFloat32);
-  const float* A = ins[0]->f32();
-  float* C = o->f32();
-  for (int64_t i = 0, n = o->size(); i < n; ++i) C[i] = A[i] * s;
-  outs->push_back(o);
-  return 0;
+  int dt;
+  if (common_dtype(ins, "_mul_scalar", &dt)) return -1;
+  double s = ps.num("scalar", 1.0);
+  NDArrayRec* o = make_out(ins[0]->shape, dt);
+  return dtype_dispatch(dt, [&](auto zero) {
+    using T = decltype(zero);
+    const T* A = tdata<T>(ins[0]);
+    T* C = tdata<T>(o);
+    for (int64_t i = 0, n = o->size(); i < n; ++i)
+      C[i] = static_cast<T>(A[i] * s);
+    outs->push_back(o);
+    return 0;
+  });
 }
 
 int op_broadcast_add(std::vector<NDArrayRec*>& ins, const Params&,
                      std::vector<NDArrayRec*>* outs) {
   // (M, N) + (N,): the bias-add shape every dense layer needs
   if (ins.size() != 2) { g_last_error = "broadcast_add: expects 2 inputs"; return -1; }
-  if (require_f32(ins, "broadcast_add")) return -1;
+  int dt;
+  if (common_dtype(ins, "broadcast_add", &dt)) return -1;
   NDArrayRec *a = ins[0], *b = ins[1];
-  if (a->shape == b->shape) {
-    NDArrayRec* o = make_out(a->shape, kMXTPUFloat32);
-    const float *A = a->f32(), *B = b->f32();
-    float* C = o->f32();
-    for (int64_t i = 0, n = o->size(); i < n; ++i) C[i] = A[i] + B[i];
-    outs->push_back(o);
-    return 0;
-  }
-  if (a->shape.size() != 2 || b->shape.size() != 1 ||
-      a->shape[1] != b->shape[0]) {
+  if (a->shape != b->shape &&
+      (a->shape.size() != 2 || b->shape.size() != 1 ||
+       a->shape[1] != b->shape[0])) {
     g_last_error = "broadcast_add: native tier handles (M,N)+(N,) only";
     return -1;
   }
-  NDArrayRec* o = make_out(a->shape, kMXTPUFloat32);
-  const float *A = a->f32(), *B = b->f32();
-  float* C = o->f32();
-  int64_t rows = a->shape[0], cols = a->shape[1];
-  for (int64_t i = 0; i < rows; ++i)
-    for (int64_t j = 0; j < cols; ++j)
-      C[i * cols + j] = A[i * cols + j] + B[j];
-  outs->push_back(o);
-  return 0;
+  NDArrayRec* o = make_out(a->shape, dt);
+  return dtype_dispatch(dt, [&](auto zero) {
+    using T = decltype(zero);
+    const T* A = tdata<T>(a);
+    const T* B = tdata<T>(b);
+    T* C = tdata<T>(o);
+    if (a->shape == b->shape) {
+      for (int64_t i = 0, n = o->size(); i < n; ++i) C[i] = A[i] + B[i];
+    } else {
+      int64_t rows = a->shape[0], cols = a->shape[1];
+      for (int64_t i = 0; i < rows; ++i)
+        for (int64_t j = 0; j < cols; ++j)
+          C[i * cols + j] = A[i * cols + j] + B[j];
+    }
+    outs->push_back(o);
+    return 0;
+  });
 }
 
 const std::map<std::string, NativeOp>& native_registry() {
@@ -326,23 +387,23 @@ const std::map<std::string, NativeOp>& native_registry() {
       {"_mul_scalar", op_mul_scalar},
       {"broadcast_add", op_broadcast_add},
       {"greater", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
-         return binary_ew(i, o, "greater", [](float a, float b) { return a > b ? 1.0f : 0.0f; }); }},
+         return binary_ew(i, o, "greater", [](auto a, decltype(a) b) { return a > b ? decltype(a)(1) : decltype(a)(0); }); }},
       {"add", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
-         return binary_ew(i, o, "add", [](float a, float b) { return a + b; }); }},
+         return binary_ew(i, o, "add", [](auto a, decltype(a) b) { return a + b; }); }},
       {"subtract", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
-         return binary_ew(i, o, "subtract", [](float a, float b) { return a - b; }); }},
+         return binary_ew(i, o, "subtract", [](auto a, decltype(a) b) { return a - b; }); }},
       {"multiply", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
-         return binary_ew(i, o, "multiply", [](float a, float b) { return a * b; }); }},
+         return binary_ew(i, o, "multiply", [](auto a, decltype(a) b) { return a * b; }); }},
       {"divide", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
-         return binary_ew(i, o, "divide", [](float a, float b) { return a / b; }); }},
+         return binary_ew(i, o, "divide", [](auto a, decltype(a) b) { return a / b; }); }},
       {"relu", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
-         return unary_ew(i, o, "relu", [](float a) { return a > 0 ? a : 0.0f; }); }},
+         return unary_ew(i, o, "relu", [](auto a) { return a > 0 ? a : decltype(a)(0); }); }},
       {"exp", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
-         return unary_ew(i, o, "exp", [](float a) { return std::exp(a); }); }},
+         return unary_ew(i, o, "exp", [](auto a) { return std::exp(a); }); }},
       {"log", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
-         return unary_ew(i, o, "log", [](float a) { return std::log(a); }); }},
+         return unary_ew(i, o, "log", [](auto a) { return std::log(a); }); }},
       {"negative", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
-         return unary_ew(i, o, "negative", [](float a) { return -a; }); }},
+         return unary_ew(i, o, "negative", [](auto a) { return -a; }); }},
   };
   return reg;
 }
@@ -420,8 +481,16 @@ int MXTPUImperativeInvoke(const char* op_name, MXTPUNDHandle* inputs,
   const auto& reg = native_registry();
   auto it = reg.find(op_name);
   if (it == reg.end()) {
-    if (g_bridge != nullptr) return g_bridge(op_name, inputs, n_in,
-                                             param_json, outputs, n_out);
+    if (g_bridge != nullptr) {
+      int rc = g_bridge(op_name, inputs, n_in, param_json, outputs, n_out);
+      // bridge-dispatched ops join the same tape as native ones — a
+      // recording scope must see every invoke, or backward silently skips
+      // the op; ops without a registered VJP then fail loudly in backward
+      if (rc == 0 && mxtpu::autograd_is_recording())
+        mxtpu::autograd_record(op_name, inputs, n_in, param_json, outputs,
+                               *n_out);
+      return rc;
+    }
     g_last_error = std::string("Invoke: op '") + op_name +
                    "' not in the native tier and no jax bridge installed";
     return -1;

@@ -10,7 +10,7 @@
 // and the executor (Forward = record-replay of the symbol graph, Backward =
 // tape sweep). VJPs are *compositions of public ABI ops* (dot backward is
 // two transposed dots, etc.), mirroring how the reference's backward passes
-// are themselves registered operators. The native tier is a host f32
+// are themselves registered operators. The native tier is a host f32/f64
 // reference implementation; the jax/XLA path remains the performance tier.
 #include "../include/mxtpu_c_api.h"
 #include "internal.h"
@@ -45,31 +45,55 @@ int64_t nd_size(MXTPUNDHandle h) {
   return n;
 }
 
-const float* nd_f32(MXTPUNDHandle h) {
-  const void* p = nullptr;
-  MXTPUNDArrayGetData(h, &p);
-  return static_cast<const float*>(p);
+int nd_dtype(MXTPUNDHandle h) {
+  int dt = kMXTPUFloat32;
+  MXTPUNDArrayGetDType(h, &dt);
+  return dt;
 }
 
-MXTPUNDHandle nd_full_like(MXTPUNDHandle h, float value) {
+size_t nd_esize(MXTPUNDHandle h) {
+  return nd_dtype(h) == kMXTPUFloat64 ? 8 : 4;
+}
+
+// element 0 as double (f32/f64 — the graph tier's dtypes)
+double nd_scalar(MXTPUNDHandle h) {
+  const void* p = nullptr;
+  MXTPUNDArrayGetData(h, &p);
+  if (nd_dtype(h) == kMXTPUFloat64) return *static_cast<const double*>(p);
+  return *static_cast<const float*>(p);
+}
+
+MXTPUNDHandle nd_full_like(MXTPUNDHandle h, double value) {
   std::vector<int64_t> shape;
   if (nd_shape(h, &shape) != 0) return nullptr;
-  std::vector<float> buf(static_cast<size_t>(nd_size(h)), value);
+  size_t n = static_cast<size_t>(nd_size(h));
+  int dt = nd_dtype(h);
   MXTPUNDHandle out = nullptr;
-  if (MXTPUNDArrayCreateFromBytes(buf.data(), shape.data(),
-                                  static_cast<int>(shape.size()),
-                                  kMXTPUFloat32, &out) != 0)
-    return nullptr;
+  if (dt == kMXTPUFloat64) {
+    std::vector<double> buf(n, value);
+    if (MXTPUNDArrayCreateFromBytes(buf.data(), shape.data(),
+                                    static_cast<int>(shape.size()),
+                                    kMXTPUFloat64, &out) != 0)
+      return nullptr;
+  } else {
+    std::vector<float> buf(n, static_cast<float>(value));
+    if (MXTPUNDArrayCreateFromBytes(buf.data(), shape.data(),
+                                    static_cast<int>(shape.size()),
+                                    kMXTPUFloat32, &out) != 0)
+      return nullptr;
+  }
   return out;
 }
 
 MXTPUNDHandle nd_copy(MXTPUNDHandle h) {
   std::vector<int64_t> shape;
   if (nd_shape(h, &shape) != 0) return nullptr;
+  const void* p = nullptr;
+  MXTPUNDArrayGetData(h, &p);
   MXTPUNDHandle out = nullptr;
-  if (MXTPUNDArrayCreateFromBytes(nd_f32(h), shape.data(),
+  if (MXTPUNDArrayCreateFromBytes(p, shape.data(),
                                   static_cast<int>(shape.size()),
-                                  kMXTPUFloat32, &out) != 0)
+                                  nd_dtype(h), &out) != 0)
     return nullptr;
   return out;
 }
@@ -155,13 +179,29 @@ int vjp_node(const TapeNode& n, MXTPUNDHandle g,
   const std::string& op = n.op;
   auto in = [&](size_t i) { return n.inputs[i]; };
   if (op == "dot") {
-    if (param_flag(n.params, "transpose_a") ||
-        param_flag(n.params, "transpose_b")) {
-      MXTPUSetLastError("autograd: dot vjp supports untransposed dot only");
-      return -1;
+    // all four transpose layouts; derivation from C[i,j] index algebra:
+    //   C = A·B    : dA = g·Bᵀ        dB = Aᵀ·g
+    //   C = Aᵀ·B   : dA = B·gᵀ        dB = A·g
+    //   C = A·Bᵀ   : dA = g·B         dB = gᵀ·A
+    //   C = Aᵀ·Bᵀ  : dA = Bᵀ·gᵀ       dB = gᵀ·Aᵀ
+    bool ta = param_flag(n.params, "transpose_a");
+    bool tb = param_flag(n.params, "transpose_b");
+    MXTPUNDHandle da, db;
+    if (!ta && !tb) {
+      da = inv1("dot", {g, in(1)}, "{\"transpose_b\": true}");
+      db = inv1("dot", {in(0), g}, "{\"transpose_a\": true}");
+    } else if (ta && !tb) {
+      da = inv1("dot", {in(1), g}, "{\"transpose_b\": true}");
+      db = inv1("dot", {in(0), g});
+    } else if (!ta && tb) {
+      da = inv1("dot", {g, in(1)});
+      db = inv1("dot", {g, in(0)}, "{\"transpose_a\": true}");
+    } else {
+      da = inv1("dot", {in(1), g},
+                "{\"transpose_a\": true, \"transpose_b\": true}");
+      db = inv1("dot", {g, in(0)},
+                "{\"transpose_a\": true, \"transpose_b\": true}");
     }
-    MXTPUNDHandle da = inv1("dot", {g, in(1)}, "{\"transpose_b\": true}");
-    MXTPUNDHandle db = inv1("dot", {in(0), g}, "{\"transpose_a\": true}");
     if (da == nullptr || db == nullptr) return -1;
     if (accumulate(cot, in(0), da)) return -1;
     return accumulate(cot, in(1), db);
@@ -231,13 +271,23 @@ int vjp_node(const TapeNode& n, MXTPUNDHandle g,
     return accumulate(cot, in(0), da);
   }
   if (op == "sum") {
-    if (param_num(n.params, "axis", -999.0) != -999.0) {
-      MXTPUSetLastError("autograd: sum vjp supports full reduce only");
-      return -1;
+    double axis = param_num(n.params, "axis", -999.0);
+    if (axis == -999.0) {  // full reduce: grad = broadcast of the scalar
+      MXTPUNDHandle da = nd_full_like(in(0), nd_scalar(g));
+      if (da == nullptr) return -1;
+      return accumulate(cot, in(0), da);
     }
-    MXTPUNDHandle da = nd_full_like(in(0), nd_f32(g)[0]);
-    if (da == nullptr) return -1;
-    return accumulate(cot, in(0), da);
+    if (axis == 0.0) {  // (M,N) -axis0-> (N,): grad = row-broadcast of g,
+                        // composed as zeros_like(in) (M,N) + g (N,)
+      MXTPUNDHandle zeros = nd_full_like(in(0), 0.0);
+      if (zeros == nullptr) return -1;
+      MXTPUNDHandle da = inv1("broadcast_add", {zeros, g});
+      MXTPUNDArrayFree(zeros);
+      if (da == nullptr) return -1;
+      return accumulate(cot, in(0), da);
+    }
+    MXTPUSetLastError("autograd: sum vjp supports full reduce or axis=0");
+    return -1;
   }
   MXTPUSetLastError(
       (std::string("autograd: no vjp registered for op '") + op + "'")
@@ -255,6 +305,20 @@ int backward_from(MXTPUNDHandle head) {
   g_ag.recording = false;  // vjp-composition invokes must not re-record
   int rc = 0;
   for (auto it = g_ag.tape.rbegin(); it != g_ag.tape.rend(); ++it) {
+    // every registered VJP is for a single-output op; a cotangent arriving
+    // on a secondary output (multi-output bridge op) must fail loudly, not
+    // be skipped — that would silently zero upstream grads
+    for (size_t oi = 1; oi < it->outputs.size(); ++oi) {
+      if (cot.count(it->outputs[oi])) {
+        MXTPUSetLastError(
+            (std::string("autograd: no multi-output vjp for op '") + it->op +
+             "' (gradient reached output " + std::to_string(oi) + ")")
+                .c_str());
+        rc = -1;
+        break;
+      }
+    }
+    if (rc != 0) break;
     auto git = cot.find(it->outputs[0]);
     if (git == cot.end()) continue;  // node not on the path to head
     MXTPUNDHandle g = git->second;
@@ -494,7 +558,10 @@ int MXTPUExecutorForward(MXTPUExecHandle exec, MXTPUNDHandle* out) {
   }
   auto* ex = static_cast<ExecRec*>(exec);
   ex->clear_run();
-  // record through the shared autograd tape, then stash it per-executor
+  // record through the shared autograd tape, then stash it per-executor;
+  // the user's imperative tape is saved across this (SetRecording(1)
+  // clears it), so Forward between record() and AutogradBackward is safe
+  std::vector<TapeNode> saved_tape = std::move(g_ag.tape);
   int prev = 0;
   MXTPUAutogradSetRecording(1, &prev);
   MXTPUNDHandle o = nullptr;
@@ -502,6 +569,7 @@ int MXTPUExecutorForward(MXTPUExecHandle exec, MXTPUNDHandle* out) {
   ex->tape = std::move(g_ag.tape);
   g_ag.clear_tape();
   MXTPUAutogradSetRecording(prev, nullptr);
+  g_ag.tape = std::move(saved_tape);
   if (rc != 0) return -1;
   *out = o;
   return 0;
@@ -631,17 +699,22 @@ int MXTPUKVStorePush(MXTPUKVHandle kv, int key, MXTPUNDHandle grad) {
     MXTPUSetLastError("KVStorePush: key not initialized");
     return -1;
   }
+  // kvstore-internal invokes must not land on the user's tape: the temps
+  // are freed below, and dangling tape entries could misattribute grads
+  // after allocator address reuse (same discipline as backward_from)
+  bool was_recording = g_ag.recording;
+  g_ag.recording = false;
   MXTPUNDHandle next;
   if (k->sgd) {  // w <- w - lr * grad
     char buf[64];
     std::snprintf(buf, sizeof(buf), "{\"scalar\": %.17g}", -k->lr);
     MXTPUNDHandle step = inv1("_mul_scalar", {grad}, buf);
-    if (step == nullptr) return -1;
-    next = inv1("add", {it->second, step});
-    MXTPUNDArrayFree(step);
+    next = step == nullptr ? nullptr : inv1("add", {it->second, step});
+    if (step != nullptr) MXTPUNDArrayFree(step);
   } else {  // plain aggregation (reference local kvstore reduce)
     next = inv1("add", {it->second, grad});
   }
+  g_ag.recording = was_recording;
   if (next == nullptr) return -1;
   MXTPUNDArrayFree(it->second);
   it->second = next;
@@ -661,8 +734,9 @@ int MXTPUKVStorePull(MXTPUKVHandle kv, int key, MXTPUNDHandle out) {
     MXTPUSetLastError("KVStorePull: key not initialized");
     return -1;
   }
-  if (nd_size(out) != nd_size(it->second)) {
-    MXTPUSetLastError("KVStorePull: destination size mismatch");
+  if (nd_size(out) != nd_size(it->second) ||
+      nd_dtype(out) != nd_dtype(it->second)) {
+    MXTPUSetLastError("KVStorePull: destination size/dtype mismatch");
     return -1;
   }
   const void* src = nullptr;
@@ -670,7 +744,7 @@ int MXTPUKVStorePull(MXTPUKVHandle kv, int key, MXTPUNDHandle out) {
   const void* dst_c = nullptr;
   MXTPUNDArrayGetData(out, &dst_c);
   std::memcpy(const_cast<void*>(dst_c), src,
-              static_cast<size_t>(nd_size(out)) * sizeof(float));
+              static_cast<size_t>(nd_size(out)) * nd_esize(out));
   return 0;
 }
 

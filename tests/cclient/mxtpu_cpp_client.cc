@@ -1,16 +1,38 @@
 // C++ user-API smoke client (header-only mxtpu_cpp.hpp over the C ABI).
-// Reference analog: cpp-package examples — proves a C++ program can train-
-// adjacent compute through the binding surface without Python.
-// Linked against libmxtpu.so (like the reference cpp-package links
+// Reference analog: cpp-package examples (cpp-package/example/mlp.cpp) —
+// proves a C++ program can TRAIN through the binding surface without
+// Python. Linked against libmxtpu.so (like the reference cpp-package links
 // libmxnet.so). Exit 0 iff all checks pass.
 #include <cmath>
 #include <cstdio>
+#include <string>
+#include <vector>
 
 #include "../../native/include/mxtpu_cpp.hpp"
 
+namespace {
+
+// deterministic LCG so the run is reproducible without <random>
+float lcg_uniform(unsigned* seed) {
+  *seed = *seed * 1103515245u + 12345u;
+  return ((*seed >> 16) % 1000) / 500.0f - 1.0f;  // [-1, 1)
+}
+
+int check_eps(float got, float want, float eps, const char* what) {
+  if (std::fabs(got - want) > eps) {
+    std::fprintf(stderr, "%s: got %f want %f\n", what, got, want);
+    return 1;
+  }
+  return 0;
+}
+
+}  // namespace
+
 int main() {
   try {
-    // y = softmax(relu(A) @ B + C-ish chain)
+    // ---- op smoke: y = softmax(relu(A) @ B) ----
+    // braced-int-list construction must stay unambiguous (f64 is a named
+    // factory precisely so this keeps compiling)
     mxtpu::NDArray a({1, -2, 3, -4, 5, -6}, {2, 3});
     mxtpu::NDArray b({1, 0, 0, 1, 1, 1}, {3, 2});
     auto r = mxtpu::relu(a);                         // [[1,0,3],[0,5,0]]
@@ -23,10 +45,7 @@ int main() {
     auto v = c.to_vector();
     const float expect[4] = {4, 3, 0, 5};
     for (int i = 0; i < 4; ++i)
-      if (std::fabs(v[i] - expect[i]) > 1e-5f) {
-        std::fprintf(stderr, "dot value mismatch at %d: %f\n", i, v[i]);
-        return 1;
-      }
+      if (check_eps(v[i], expect[i], 1e-5f, "dot value")) return 1;
     auto s = mxtpu::softmax(c);
     auto sv = s.to_vector();
     if (std::fabs(sv[0] + sv[1] - 1.0f) > 1e-5f ||
@@ -34,7 +53,34 @@ int main() {
       std::fprintf(stderr, "softmax rows don't sum to 1\n");
       return 1;
     }
-    // error path: exception carries the C-side message
+
+    // ---- second dtype: the same compute in f64 stays f64 ----
+    auto ad = mxtpu::NDArray::F64({1, -2, 3, -4, 5, -6}, {2, 3});
+    auto bd = mxtpu::NDArray::F64({1, 0, 0, 1, 1, 1}, {3, 2});
+    auto cd = mxtpu::dot(mxtpu::relu(ad), bd);
+    if (cd.dtype() != kMXTPUFloat64) {
+      std::fprintf(stderr, "f64 dot did not stay f64\n");
+      return 1;
+    }
+    auto cdv = cd.to_vector_f64();
+    for (int i = 0; i < 4; ++i)
+      if (std::fabs(cdv[i] - expect[i]) > 1e-12) {
+        std::fprintf(stderr, "f64 dot mismatch at %d: %f\n", i, cdv[i]);
+        return 1;
+      }
+    // mixed-dtype invoke fails loudly
+    bool dt_threw = false;
+    try {
+      mxtpu::add(a, ad);
+    } catch (const mxtpu::Error& e) {
+      dt_threw = std::string(e.what()).find("mixed") != std::string::npos;
+    }
+    if (!dt_threw) {
+      std::fprintf(stderr, "mixed-dtype add did not error\n");
+      return 1;
+    }
+
+    // ---- error path: exception carries the C-side message ----
     bool threw = false;
     try {
       mxtpu::invoke("not_a_real_op_zzz", {&a});
@@ -47,52 +93,102 @@ int main() {
       return 1;
     }
 
-    // ---- training surface: linear regression via Symbol/Executor/KVStore
-    // (reference cpp-package MLP example shape) ----
-    const int B = 8, IN = 4;
-    std::vector<float> xv(B * IN), yv(B);
+    // ---- transposed-dot VJP: d/dA sum(dot(A, B, transpose_b)) = ones @ B
+    // via the imperative autograd tape (reference MXAutogradBackwardEx) ----
+    {
+      int prev = 0;
+      mxtpu::check(MXTPUAutogradSetRecording(1, &prev), "SetRecording");
+      MXTPUNDHandle vars[1] = {a.handle()};
+      mxtpu::check(MXTPUAutogradMarkVariables(1, vars), "MarkVariables");
+      // A (2,3) @ Bt (2,3)ᵀ -> (2,2); sum -> scalar
+      mxtpu::NDArray bt({1, 0, 1, 0, 1, 1}, {2, 3});
+      auto prod = mxtpu::dot(a, bt, false, true);
+      auto total = mxtpu::invoke("sum", {&prod});
+      mxtpu::check(MXTPUAutogradBackward(total[0].handle()),
+                   "AutogradBackward");
+      MXTPUNDHandle ga = nullptr;
+      mxtpu::check(MXTPUAutogradGetGrad(a.handle(), &ga), "GetGrad");
+      auto gav = mxtpu::view_values(ga);
+      // dA = g @ B with g = ones(2,2): each row = column sums of Bt = [1,1,2]
+      const float gexp[6] = {1, 1, 2, 1, 1, 2};
+      for (int i = 0; i < 6; ++i)
+        if (check_eps(gav[i], gexp[i], 1e-5f, "transposed-dot grad")) return 1;
+      mxtpu::check(MXTPUAutogradReset(), "AutogradReset");
+      mxtpu::check(MXTPUAutogradSetRecording(prev, nullptr), "SetRecording");
+    }
+
+    // ---- training surface: 2-layer relu MLP via Symbol/Executor/KVStore
+    // (the reference cpp-package/example/mlp.cpp shape) ----
+    const int B = 16, IN = 4, H = 8;
     unsigned seed = 3;
-    for (auto& f : xv) {
-      seed = seed * 1103515245u + 12345u;
-      f = ((seed >> 16) % 1000) / 500.0f - 1.0f;
-    }
+    std::vector<float> xv(B * IN), yv(B);
+    for (auto& f : xv) f = lcg_uniform(&seed);
     for (int i = 0; i < B; ++i) {
+      // nonlinear target so the hidden layer has to earn its keep
       float acc = 0.0f;
-      for (int j = 0; j < IN; ++j) acc += 0.5f * xv[i * IN + j];
-      yv[i] = acc;
+      for (int j = 0; j < IN; ++j) acc += xv[i * IN + j];
+      yv[i] = std::fabs(acc);
     }
+    std::vector<float> w1v(IN * H), b1v(H, 0.1f), w2v(H, 0.0f), b2v(1, 0.0f);
+    for (auto& f : w1v) f = 0.5f * lcg_uniform(&seed);
+    for (auto& f : w2v) f = 0.5f * lcg_uniform(&seed);
+
     mxtpu::NDArray x(xv, {B, IN});
     mxtpu::NDArray y(yv, {B, 1});
-    mxtpu::NDArray w(std::vector<float>(IN, 0.0f), {IN, 1});
+    mxtpu::NDArray w1(w1v, {IN, H});
+    mxtpu::NDArray b1(b1v, {H});
+    mxtpu::NDArray w2(w2v, {H, 1});
+    mxtpu::NDArray b2(b2v, {1});
 
     auto vx = mxtpu::Symbol::Variable("x");
-    auto vw = mxtpu::Symbol::Variable("w");
     auto vy = mxtpu::Symbol::Variable("y");
-    auto pred = mxtpu::Symbol::Op("dot", {&vx, &vw});
+    auto vw1 = mxtpu::Symbol::Variable("w1");
+    auto vb1 = mxtpu::Symbol::Variable("b1");
+    auto vw2 = mxtpu::Symbol::Variable("w2");
+    auto vb2 = mxtpu::Symbol::Variable("b2");
+    auto z1 = mxtpu::Symbol::Op("dot", {&vx, &vw1});
+    auto z1b = mxtpu::Symbol::Op("broadcast_add", {&z1, &vb1});
+    auto h1 = mxtpu::Symbol::Op("relu", {&z1b});
+    auto z2 = mxtpu::Symbol::Op("dot", {&h1, &vw2});
+    auto pred = mxtpu::Symbol::Op("broadcast_add", {&z2, &vb2});
     auto diff = mxtpu::Symbol::Op("subtract", {&pred, &vy});
     auto sq = mxtpu::Symbol::Op("multiply", {&diff, &diff});
     auto loss = mxtpu::Symbol::Op("sum", {&sq});
 
-    mxtpu::Executor ex(loss, {{"x", &x}, {"w", &w}, {"y", &y}});
+    mxtpu::Executor ex(loss, {{"x", &x},
+                              {"y", &y},
+                              {"w1", &w1},
+                              {"b1", &b1},
+                              {"w2", &w2},
+                              {"b2", &b2}});
     mxtpu::KVStore kv("local");
-    kv.set_optimizer(0.02);
-    kv.init(0, w);
+    kv.set_optimizer(0.005);
+    kv.init(0, w1);
+    kv.init(1, b1);
+    kv.init(2, w2);
+    kv.init(3, b2);
 
     float first = -1.0f, last = -1.0f;
-    for (int step = 0; step < 100; ++step) {
+    for (int step = 0; step < 400; ++step) {
       auto lv = ex.forward();
       last = lv[0];
       if (step == 0) first = lv[0];
       ex.backward();
-      kv.push(0, ex.grad("w"));
-      kv.pull(0, w);
+      kv.push(0, ex.grad("w1"));
+      kv.push(1, ex.grad("b1"));
+      kv.push(2, ex.grad("w2"));
+      kv.push(3, ex.grad("b2"));
+      kv.pull(0, w1);
+      kv.pull(1, b1);
+      kv.pull(2, w2);
+      kv.pull(3, b2);
     }
     if (!(last < first / 10.0f)) {
-      std::fprintf(stderr, "cpp training failed to converge: %f -> %f\n",
+      std::fprintf(stderr, "cpp MLP failed to converge: %f -> %f\n",
                    first, last);
       return 1;
     }
-    std::printf("cpp training loss %.4f -> %.4f\n", first, last);
+    std::printf("cpp 2-layer relu MLP loss %.4f -> %.4f\n", first, last);
   } catch (const std::exception& e) {
     std::fprintf(stderr, "unexpected: %s\n", e.what());
     return 1;

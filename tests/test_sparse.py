@@ -389,3 +389,36 @@ def test_embedding_sparse_grad_end_to_end_no_densify():
     np.testing.assert_array_equal(w_after[untouched], w_before[untouched])
     assert not np.allclose(w_after[touched], w_before[touched])
     assert losses[-1] < losses[0]
+
+
+def test_embedding_sparse_grad_symbolic_export(tmp_path):
+    """Round-4 advisor: HybridBlock.export of a sparse_grad Embedding must
+    not crash in _record_rows (symbolic forward passes a Symbol, which is
+    neither a Tracer nor a concrete array)."""
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+
+    emb = nn.Embedding(20, 4, sparse_grad=True)
+    emb.initialize()
+    emb.hybridize()
+    ids = nd.array(np.array([[1, 2]]), dtype="int32")
+    emb(ids)
+    emb.export(str(tmp_path / "emb"))
+    assert (tmp_path / "emb-symbol.json").exists()
+
+
+def test_embedding_sparse_rows_skip_inference_forwards():
+    """Round-4 advisor: rows touched only by inference batches must NOT
+    enter the next lazy update (reference lazy_update semantics: only rows
+    present in the gradient are updated)."""
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon import nn
+
+    emb = nn.Embedding(20, 4, sparse_grad=True)
+    emb.initialize()
+    emb(nd.array(np.array([[5, 6]]), dtype="int32"))  # eval-only forward
+    assert emb.weight._sparse_rows is None
+    with autograd.record():
+        emb(nd.array(np.array([[1, 2]]), dtype="int32"))
+    rows = set(np.asarray(emb.weight._sparse_rows).tolist())
+    assert rows == {1, 2}  # 5/6 from the eval batch are absent

@@ -43,6 +43,20 @@ def test_simple_bind_forward_backward():
     assert np.isfinite(ex.grad_dict["w"].asnumpy()).all()
 
 
+def test_simple_bind_honors_explicit_scalar_shape():
+    """Round-4 advisor: an explicit shape () is falsy and must still win
+    (membership test, not truthiness)."""
+    a = sym.var("a")
+    b = sym.var("b")
+    out = sym.add(a, b)
+    ex = out.simple_bind(a=(), b=())
+    assert ex.arg_dict["a"].shape == ()
+    ex.arg_dict["a"][:] = 2.0
+    ex.arg_dict["b"][:] = 3.0
+    (o,) = ex.forward()
+    np.testing.assert_allclose(o.asnumpy(), 5.0)
+
+
 def test_json_roundtrip():
     a = sym.var("a")
     b = sym.var("b")
