@@ -463,8 +463,10 @@ def measure(mode, kind):
 
         jax.config.update("jax_platforms", "cpu")
     # bench config: BERT-large, seq 128 (phase-1 pretraining shape); batch 64
-    # is the measured MFU knee on one v5e chip (16->0.31, 32->0.35, 64->0.42,
-    # 128->0.39) — the OOM fallback halves it if a smaller chip balks
+    # was the MFU knee in an interactive round-3 sweep on one v5e chip
+    # (16->0.31, 32->0.35, 64->0.42, 128->0.39; only the batch-64 row is in
+    # a committed artifact, BENCH_TPU_MEASURED.json) — the OOM fallback
+    # halves it if a smaller chip balks
     name, batch, seq, masked = ("bert_large", 64, 128, 20) if on_tpu else (
         "bert_mini", 4, 64, 8)
     t_start = time.time()

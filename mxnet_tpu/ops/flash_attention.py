@@ -15,12 +15,12 @@ scratch) and ``dq`` grids over q blocks with k innermost — 5 block matmuls
 per (q,k) tile total, O(L) memory, vs the O(L^2) scores buffer of the einsum
 VJP. A ``lax.scan`` chunked recompute backward (`_chunked_attention`) is kept
 as the escape hatch (`config flash_pallas_bwd=False`) and as the long-seq
-correctness oracle; hardware timing (round 3, v5e, tools/kernelbench.py)
-showed that scan backward is latency-bound and ~2.5x slower than einsum.
+correctness oracle; hardware timing (KERNELBENCH_r03.jsonl, v5e) shows the
+chunked path 1.3-4.7x slower than the flash kernels across seq 1024-8192.
 With the Pallas backward and 512x512 blocks the flash path is a measured
-net training win: 1.13-1.33x vs the einsum VJP at seq 2048 rising to
-1.33-1.93x at seq 8192 (b*h=32..8, d 64/128, causal and not), at O(L)
-memory.
+net training win (same artifact): 1.13-1.33x vs the einsum VJP at seq 2048
+rising to 1.33-1.93x at seq 8192 (b*h=32..8, d 64/128, causal and not), at
+O(L) memory.
 
 On non-TPU backends the kernels run in interpret mode (tests) or callers fall
 back to the einsum path via ``flash_supported``.
@@ -40,7 +40,7 @@ from .pallas_common import on_tpu as _on_tpu
 from .pallas_common import pltpu
 
 
-_FLASH_MIN_SEQ = 2048  # measured crossover, v5e round 3 (kernelbench,
+_FLASH_MIN_SEQ = 2048  # measured crossover, v5e (KERNELBENCH_r03.jsonl,
 # fwd+bwd with the Pallas backward, 512x512 blocks): seq 1024 parity
 # (0.99-1.05x vs XLA einsum), seq 2048 1.13-1.33x faster, seq 4096 1.25-1.6x,
 # seq 8192 1.33-1.93x — and O(L) memory where einsum's [b,h,t,t] scores
@@ -147,9 +147,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal, bq, bk, scale,
 
 def _pick_block(t, prefer=512):
     """Largest MXU-friendly block (<= prefer) that divides the seq length.
-    512x512 measured ~20-30% faster than 128x128 on v5e (round 3 sweep:
-    dispatch-amortized fwd+bwd at seq 4096; bigger tiles keep the MXU
-    pipeline full and cut grid-iteration overhead)."""
+    Bigger tiles keep the MXU pipeline full and cut grid-iteration
+    overhead; an interactive round-3 sweep saw 512x512 ~20-30% faster than
+    128x128 on v5e, but no committed artifact holds those rows — the
+    committed KERNELBENCH_r03 timings were all taken at this 512
+    default."""
     for cand in (prefer, 256, 128):
         if cand <= t and t % cand == 0:
             return cand
@@ -440,7 +442,8 @@ def _flash_vjp_bwd(causal, interpret, res, g):
         return _flash_bwd_pallas(q, k, v, o, lse, g, causal,
                                  interpret=interpret)
     # escape hatch: XLA chunked-recompute backward (latency-bound on TPU —
-    # measured ~2.5x slower than the kernels on v5e — but kernel-free)
+    # 1.3-4.7x slower than the kernels on v5e, KERNELBENCH_r03.jsonl —
+    # but kernel-free)
     _, vjp = jax.vjp(lambda q, k, v: _chunked_attention(q, k, v, causal),
                      q, k, v)
     return vjp(g)

@@ -8,9 +8,11 @@ cuDNN-native layout) and ``convolution`` passes NCHW/OIHW
 ``dimension_numbers`` AS WRITTEN — no Python-level transposes. XLA's layout
 assignment picks the physical tiling for TPU itself (logical dims !=
 physical layout on TPU; hand-transposing to NHWC in the graph would just
-add ops the compiler has to cancel). Measured on hardware, round 4: see
-KERNELBENCH conv_layout rows — NCHW-as-written vs explicit-NHWC
-``conv_general_dilated`` on a ResNet-50 stage-3 shape.
+add ops the compiler has to cancel). Hardware A/B pending: the
+NCHW-as-written vs explicit-NHWC comparison on a ResNet-50 stage-3 shape
+is implemented (tools/kernelbench.py conv_layout rows) but no committed
+KERNELBENCH artifact contains those rows yet — the claim above rests on
+the XLA layout-assignment design, not a measurement.
 
 RNN replaces the cuDNN fused descriptor machinery (``src/operator/rnn.cc``,
 ``cudnn_rnn-inl.h``) with a ``lax.scan`` over fused-gate cells — the

@@ -6,10 +6,11 @@ its own subprocess so an OOM (the einsum path's O(L^2) scores buffer at long
 seq — exactly the failure mode flash exists to remove) can't poison the HBM
 of later cases. Prints one JSON line per case plus a summary to stderr.
 
-The axon tunnel adds a large fixed cost (~65ms measured, round 3) to every
-host readback, so each timing runs ``reps`` dependent iterations per dispatch
-chain and syncs ONCE at the end; reported times are per-iteration with that
-fixed cost amortized.
+The axon tunnel adds a large fixed cost (~65ms observed interactively in
+round 3; no committed artifact row — treat the figure as order-of-magnitude)
+to every host readback, so each timing runs ``reps`` dependent iterations per
+dispatch chain and syncs ONCE at the end; reported times are per-iteration
+with that fixed cost amortized.
 
 Usage:  python tools/kernelbench.py [--reps 15] [--fwd-only]
 """
