@@ -32,6 +32,15 @@ test: sanity native
 bench:
 	$(PY) bench.py
 
+# harvest a hardware-lease window completely: bench + modelbench +
+# kernelbench in one pass (records a diagnosed attempt if the tunnel is
+# down). `make benchall-dryrun` exercises the same code paths on CPU.
+benchall:
+	$(PY) tools/benchall.py --wait $${BENCHALL_WAIT:-900} --round $${BENCHALL_ROUND:-5}
+
+benchall-dryrun:
+	$(PY) tools/benchall.py --dryrun-cpu
+
 clean:
 	$(MAKE) -C native clean
 	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -625,3 +625,5 @@ linalg.__getattr__ = lambda name: _make_op_func("linalg_" + name)
 sys.modules[linalg.__name__] = linalg
 
 from . import sparse  # noqa: E402  (row_sparse/csr storage — needs NDArray defined)
+# reference exposes cast_storage at the nd top level too (tensor/cast_storage.cc)
+cast_storage = sparse.cast_storage

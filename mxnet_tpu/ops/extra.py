@@ -387,3 +387,130 @@ def sharding_constraint(data, spec=()):
 
     return jax.lax.with_sharding_constraint(
         data, NamedSharding(mesh, P(*resolved)))
+
+
+# --------------------------------------------------------------------------
+# canonical-surface completion (round-4 verdict ask #7: freeze mx.nd the way
+# mx.np is frozen; these are the reference-generated names that were absent)
+# --------------------------------------------------------------------------
+
+@register("add_n", aliases=("ElementWiseSum",))
+def add_n(*args, num_args=None):
+    """Sum of N arrays in one op (reference elemwise_sum.cc)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    """argmax over axis 1, returned as float (reference broadcast_reduce_op:
+    the old SoftmaxOutput-era label extractor)."""
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+def _index_dtype():
+    # base.py's x64 stance: int64 out when x64 is on, else int32 (no warning)
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
+@register("shape_array")
+def shape_array(data):
+    """Shape as a 1-D tensor (reference shape_array: int64 out; narrows to
+    int32 when x64 is disabled, consistent with base.py's int64 policy)."""
+    return jnp.asarray(data.shape, dtype=_index_dtype())
+
+
+@register("size_array")
+def size_array(data):
+    """Total element count as a 1-element tensor (reference size_array)."""
+    return jnp.asarray([data.size], dtype=_index_dtype())
+
+
+@register("im2col")
+def im2col(data, kernel, stride=None, dilate=None, pad=None):
+    """Sliding-window patch extraction, (N,C,H,W) -> (N, C*prod(kernel), L)
+    in the reference's channel-major (c, kh, kw) patch layout
+    (src/operator/nn/im2col.h). Lowers to one
+    ``lax.conv_general_dilated_patches`` — XLA's native patch op — whose
+    layout matches the reference's directly (asserted in tests)."""
+    from jax import lax
+
+    kernel = tuple(kernel)
+    nspatial = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nspatial
+    dilate = tuple(dilate) if dilate else (1,) * nspatial
+    pad = tuple(pad) if pad else (0,) * nspatial
+    dn = ("NCHW", "OIHW", "NCHW") if nspatial == 2 else ("NCW", "OIW", "NCW")
+    patches = lax.conv_general_dilated_patches(
+        data, filter_shape=kernel, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn)
+    return patches.reshape(data.shape[0], patches.shape[1], -1)
+
+
+@register("col2im")
+def col2im(data, output_size, kernel, stride=None, dilate=None, pad=None):
+    """Adjoint of im2col: scatter-add patches back into (N, C, *output_size)
+    (reference col2im in im2col.h). im2col is linear, so its vjp IS col2im —
+    one jax.vjp instead of a hand scatter kernel."""
+    import math
+
+    kernel = tuple(kernel)
+    output_size = tuple(output_size)
+    n = data.shape[0]
+    c = data.shape[1] // math.prod(kernel)
+    zeros = jnp.zeros((n, c) + output_size, data.dtype)
+    _, vjp = jax.vjp(
+        lambda x: im2col(x, kernel, stride=stride, dilate=dilate, pad=pad),
+        zeros)
+    return vjp(data)[0]
+
+
+# -- quantization trio (reference: quantize.cc / quantize_v2.cc /
+# dequantize.cc — the graph-pass ops; the contrib.quantization module owns
+# calibration and the int8 layers) --
+
+@register("quantize", nout=3)
+def quantize(data, min_range, max_range, out_type="uint8"):
+    """Affine quantization with explicit range inputs (reference quantize.cc:
+    uint8 affine over [min,max]; int8 symmetric over max(|min|,|max|))."""
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx_ = jnp.asarray(max_range, jnp.float32).reshape(())
+    xf = data.astype(jnp.float32)
+    if out_type == "uint8":
+        scale = 255.0 / jnp.maximum(mx_ - mn, 1e-12)
+        q = jnp.clip(jnp.round((xf - mn) * scale), 0, 255).astype(jnp.uint8)
+    else:
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+        scale = 127.0 / jnp.maximum(amax, 1e-12)
+        q = jnp.clip(jnp.round(xf * scale), -127, 127).astype(jnp.int8)
+    return q, mn.reshape((1,)), mx_.reshape((1,))
+
+
+@register("quantize_v2", nout=3)
+def quantize_v2(data, out_type="int8", min_calib_range=None,
+                max_calib_range=None):
+    """Self-calibrating quantization (reference quantize_v2.cc): ranges from
+    calibration when given, else from the data itself."""
+    xf = data.astype(jnp.float32)
+    mn = jnp.asarray(min_calib_range if min_calib_range is not None
+                     else jnp.min(xf), jnp.float32).reshape(())
+    mx_ = jnp.asarray(max_calib_range if max_calib_range is not None
+                      else jnp.max(xf), jnp.float32).reshape(())
+    return quantize(data, mn, mx_, out_type=out_type)
+
+
+@register("dequantize")
+def dequantize(data, min_range, max_range, out_type="float32"):
+    """Inverse of quantize, dispatching on the stored integer dtype."""
+    mn = jnp.asarray(min_range, jnp.float32).reshape(())
+    mx_ = jnp.asarray(max_range, jnp.float32).reshape(())
+    if data.dtype == jnp.uint8:
+        scale = jnp.maximum(mx_ - mn, 1e-12) / 255.0
+        out = data.astype(jnp.float32) * scale + mn
+    else:
+        amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
+        out = data.astype(jnp.float32) * (jnp.maximum(amax, 1e-12) / 127.0)
+    return out.astype(jnp.dtype(out_type))

@@ -167,3 +167,12 @@ def linalg_maketrian(A, offset=0, lower=True):
     rows, cols = _np.nonzero(mask)
     out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
     return out.at[..., rows, cols].set(A)
+
+
+@register("linalg_syevd", aliases=("_linalg_syevd",), nout=2)
+def linalg_syevd(A):
+    """Symmetric eigendecomposition, reference layout: A = U^T diag(L) U
+    with eigenvectors in the ROWS of U (linalg_syevd in la_op.cc); jnp's
+    eigh returns them in columns, hence the transpose."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w

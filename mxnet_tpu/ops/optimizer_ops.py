@@ -139,3 +139,131 @@ def lamb_update_phase2(weight, g_update, r1, r2, lr, lower_bound=-1.0, upper_bou
         r1 = jnp.minimum(r1, upper_bound)
     trust = r1 / r2
     return (weight.astype(jnp.float32) - lr * trust * g_update).astype(weight.dtype)
+
+
+# -- canonical mp_* / sign / rmspropalex variants ---------------------------
+# (reference optimizer_op.cc registers these as distinct operators; here the
+# mp_* math IS the base op run on the f32 master copy, then cast back)
+
+@register("mp_sgd_update", nout=2)
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=False):
+    """SGD on the f32 master copy; low-precision weight re-derived from it."""
+    new_w32 = sgd_update(weight32, grad, lr, wd, rescale_grad, clip_gradient)
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", nout=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, lazy_update=False):
+    new_w32, new_mom = sgd_mom_update(weight32, grad, mom, lr, momentum, wd,
+                                      rescale_grad, clip_gradient)
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("mp_nag_mom_update", nout=3)
+def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0):
+    new_w32, new_mom = nag_mom_update(weight32, grad, mom, lr, momentum, wd,
+                                      rescale_grad, clip_gradient)
+    return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register("signum_update", nout=2)
+def signum_update(weight, grad, mom, lr, momentum=0.9, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    """Signum: momentum-smoothed sign step (reference signum_update; wd_lh is
+    the decoupled 'local' decay applied to the weight directly)."""
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - (1.0 - momentum) * g
+    w = (1.0 - lr * wd_lh) * weight.astype(jnp.float32) \
+        + lr * jnp.sign(new_mom)
+    return w.astype(weight.dtype), new_mom
+
+
+@register("rmspropalex_update", nout=4)
+def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    """Alex Graves' RMSProp (reference rmspropalex_update): centered second
+    moment + momentum on the update itself."""
+    gr = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_n = (1.0 - gamma1) * gr * gr + gamma1 * n
+    new_g = (1.0 - gamma1) * gr + gamma1 * g
+    new_delta = gamma2 * delta - lr * gr / jnp.sqrt(
+        new_n - new_g * new_g + epsilon)
+    w = weight.astype(jnp.float32) + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w.astype(weight.dtype), new_n, new_g, new_delta
+
+
+# -- canonical multi-tensor fused updates -----------------------------------
+# (reference multi_sgd_update.cc: one kernel over N params. Under jit the
+# whole loop fuses into one XLA program, which is the same thing the hand
+# kernel bought — the registry keeps the names for surface parity.)
+
+def _split_multi(arrays, num_weights, per):
+    groups = []
+    for i in range(num_weights):
+        groups.append(arrays[i * per:(i + 1) * per])
+    return groups
+
+
+def _as_list(v, n):
+    try:
+        vals = list(v)
+    except TypeError:
+        vals = [v] * n
+    return vals
+
+
+@register("multi_sgd_update")
+def multi_sgd_update(*arrays, lrs, wds, num_weights=None, rescale_grad=1.0,
+                     clip_gradient=-1.0):
+    """N x (weight, grad) -> N updated weights."""
+    n = num_weights if num_weights is not None else len(arrays) // 2
+    lrs, wds = _as_list(lrs, n), _as_list(wds, n)
+    out = tuple(
+        sgd_update(w, g, lrs[i], wds[i], rescale_grad, clip_gradient)
+        for i, (w, g) in enumerate(_split_multi(arrays, n, 2)))
+    return out if n != 1 else out[0]
+
+
+@register("multi_sgd_mom_update")
+def multi_sgd_mom_update(*arrays, lrs, wds, num_weights=None, momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0):
+    """N x (weight, grad, mom) -> N x (weight, mom) flattened."""
+    n = num_weights if num_weights is not None else len(arrays) // 3
+    lrs, wds = _as_list(lrs, n), _as_list(wds, n)
+    outs = []
+    for i, (w, g, m) in enumerate(_split_multi(arrays, n, 3)):
+        outs.extend(sgd_mom_update(w, g, m, lrs[i], momentum, wds[i],
+                                   rescale_grad, clip_gradient))
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_update")
+def multi_mp_sgd_update(*arrays, lrs, wds, num_weights=None, rescale_grad=1.0,
+                        clip_gradient=-1.0):
+    """N x (weight, grad, weight32) -> N x (weight, weight32) flattened."""
+    n = num_weights if num_weights is not None else len(arrays) // 3
+    lrs, wds = _as_list(lrs, n), _as_list(wds, n)
+    outs = []
+    for i, (w, g, w32) in enumerate(_split_multi(arrays, n, 3)):
+        outs.extend(mp_sgd_update(w, g, w32, lrs[i], wds[i], rescale_grad,
+                                  clip_gradient))  # (weight, weight32)
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_mom_update")
+def multi_mp_sgd_mom_update(*arrays, lrs, wds, num_weights=None, momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0):
+    """N x (weight, grad, mom, weight32) -> N x (weight, mom, weight32)."""
+    n = num_weights if num_weights is not None else len(arrays) // 4
+    lrs, wds = _as_list(lrs, n), _as_list(wds, n)
+    outs = []
+    for i, (w, g, m, w32) in enumerate(_split_multi(arrays, n, 4)):
+        outs.extend(mp_sgd_mom_update(w, g, m, w32, lrs[i], momentum, wds[i],
+                                      rescale_grad, clip_gradient))
+    return tuple(outs)

@@ -148,3 +148,37 @@ def sample_negative_binomial(k, p, shape=(), dtype="float32", key=None):
     rate = jax.random.gamma(k1, jnp.broadcast_to(kk, out_shape)) \
         * (1.0 - jnp.broadcast_to(pp, out_shape)) / jnp.broadcast_to(pp, out_shape)
     return jax.random.poisson(k2, rate).astype(dtype_np(dtype))
+
+
+@register("_random_negative_binomial", aliases=("random_negative_binomial",),
+          stochastic=True)
+def random_negative_binomial(k=1, p=1.0, shape=(), dtype="float32", key=None):
+    k1, k2 = jax.random.split(_key(key))
+    rate = jax.random.gamma(k1, float(k), tuple(shape)) * (1.0 - p) / p
+    return jax.random.poisson(k2, rate).astype(dtype_np(dtype))
+
+
+@register("_random_generalized_negative_binomial",
+          aliases=("random_generalized_negative_binomial",), stochastic=True)
+def random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(),
+                                         dtype="float32", key=None):
+    # GNB(mu, alpha) = Poisson(Gamma(1/alpha, mu*alpha)) — the reference's
+    # gamma-Poisson mixture (alpha -> 0 degenerates to Poisson(mu))
+    k1, k2 = jax.random.split(_key(key))
+    rate = jax.random.gamma(k1, 1.0 / alpha, tuple(shape)) * (mu * alpha)
+    return jax.random.poisson(k2, rate).astype(dtype_np(dtype))
+
+
+@register("_sample_generalized_negative_binomial",
+          aliases=("sample_generalized_negative_binomial",), stochastic=True)
+def sample_generalized_negative_binomial(mu, alpha, shape=(), dtype="float32",
+                                         key=None):
+    mu = jnp.asarray(mu, jnp.float32)
+    out_shape, extra = _per_elem_shape(mu, shape)
+    mm = jnp.reshape(mu, mu.shape + (1,) * len(extra))
+    aa = jnp.reshape(jnp.asarray(alpha, jnp.float32),
+                     mu.shape + (1,) * len(extra))
+    k1, k2 = jax.random.split(_key(key))
+    rate = jax.random.gamma(k1, jnp.broadcast_to(1.0 / aa, out_shape)) \
+        * jnp.broadcast_to(mm * aa, out_shape)
+    return jax.random.poisson(k2, rate).astype(dtype_np(dtype))

