@@ -2,6 +2,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # model compiles dominate `make test`; excluded from `make fast`
+
 from mxnet_tpu import gluon, nd
 
 

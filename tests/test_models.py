@@ -3,6 +3,8 @@ bench.py). Covers driver configs #3/#4/#5 shapes."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # model compiles dominate `make test`; excluded from `make fast`
+
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon, nd
 from mxnet_tpu.models import bert, gpt2, transformer

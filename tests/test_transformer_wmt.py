@@ -4,6 +4,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
 
@@ -40,6 +41,7 @@ def test_invsqrt_warmup_schedule():
     np.testing.assert_allclose(s(100), 512 ** -0.5 * 100 ** -0.5, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_wmt_toy_training_loss_falls():
     from train_transformer_wmt import build_parser, train
 
