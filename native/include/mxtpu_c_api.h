@@ -124,6 +124,21 @@ int MXTPUKVStorePush(MXTPUKVHandle kv, int key, MXTPUNDHandle grad);
 int MXTPUKVStorePull(MXTPUKVHandle kv, int key, MXTPUNDHandle out);
 int MXTPUKVStoreFree(MXTPUKVHandle kv);
 
+/* ---- .params serialization (reference: MXNDArraySave / MXNDArrayLoad over
+ * NDArray::Save/Load — the dmlc 0x112 list wire format, so files
+ * interoperate byte-for-byte with the Python tier and reference-era zoos).
+ * Dense V2 blocks only (sparse .params stay a Python-tier concern).
+ * Save: names may be NULL for an unnamed list.
+ * Load: returned handles are CALLER-OWNED (free each with MXTPUNDArrayFree);
+ * the out_arrays POINTER ARRAY and the names array live in a thread-local
+ * store valid until the next Load on the same thread (the reference's
+ * MXAPIThreadLocalEntry pattern) — copy the handle pointers out before
+ * calling Load again. ---- */
+int MXTPUNDArraySave(const char* fname, int n, MXTPUNDHandle* arrays,
+                     const char** names);
+int MXTPUNDArrayLoad(const char* fname, int* out_n, MXTPUNDHandle** out_arrays,
+                     int* out_n_names, const char*** out_names);
+
 #ifdef __cplusplus
 }
 #endif

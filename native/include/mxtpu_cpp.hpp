@@ -169,6 +169,36 @@ inline std::vector<float> view_values(MXTPUNDHandle h) {
   return std::vector<float>(f, f + n);
 }
 
+// ---- .params save/load (reference: NDArray::Save/Load via mxnet-cpp) ----
+
+inline void save_params(const std::string& fname,
+                        const std::vector<std::pair<std::string,
+                                                    const NDArray*>>& named) {
+  std::vector<MXTPUNDHandle> hs;
+  std::vector<const char*> ns;
+  for (auto& kv : named) {
+    ns.push_back(kv.first.c_str());
+    hs.push_back(kv.second->handle());
+  }
+  check(MXTPUNDArraySave(fname.c_str(), static_cast<int>(hs.size()),
+                         hs.data(), ns.data()),
+        "NDArraySave");
+}
+
+inline std::vector<std::pair<std::string, NDArray>> load_params(
+    const std::string& fname) {
+  int n = 0, n_names = 0;
+  MXTPUNDHandle* hs = nullptr;
+  const char** names = nullptr;
+  check(MXTPUNDArrayLoad(fname.c_str(), &n, &hs, &n_names, &names),
+        "NDArrayLoad");
+  std::vector<std::pair<std::string, NDArray>> out;
+  out.reserve(n);
+  for (int i = 0; i < n; ++i)
+    out.emplace_back(i < n_names ? names[i] : "", NDArray(hs[i]));
+  return out;
+}
+
 class Symbol {
  public:
   static Symbol Variable(const std::string& name) {

@@ -1,0 +1,217 @@
+// .params (dmlc 0x112 NDArray-list) serialization for the C ABI.
+//
+// Reference analog: MXNDArraySave / MXNDArrayLoad (src/c_api/c_api.cc) over
+// NDArray::Save/Load (src/ndarray/ndarray.cc). The wire format here matches
+// mxnet_tpu/serialization.py byte-for-byte for dense V2 blocks:
+//   u64 magic 0x112 | u64 reserved | u64 count
+//   per array: u32 0xF993FAC9 | u32 ndim | i64*ndim | i32 devtype=1
+//              | i32 devid=0 | i32 dtype_flag | raw C-order bytes
+//   u64 n_names | per name: u64 len | bytes
+// The MXTPU dtype enum (mxtpu_c_api.h) IS the MXNet type flag for 0..6, so
+// no translation table is needed.
+#include "../include/mxtpu_c_api.h"
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kListMagic = 0x112;
+constexpr uint32_t kV2Magic = 0xF993FAC9;
+
+size_t esize(int dtype) {
+  switch (dtype) {
+    case kMXTPUFloat32: return 4;
+    case kMXTPUFloat64: return 8;
+    case kMXTPUFloat16: return 2;
+    case kMXTPUUint8: return 1;
+    case kMXTPUInt32: return 4;
+    case kMXTPUInt8: return 1;
+    case kMXTPUInt64: return 8;
+    default: return 0;
+  }
+}
+
+// Load's returned name pointers stay valid until the next Load on this
+// thread (reference MXAPIThreadLocalEntry ownership).
+struct LoadTLS {
+  std::vector<std::string> names;
+  std::vector<const char*> name_ptrs;
+};
+thread_local LoadTLS g_load;
+
+bool wr(std::FILE* f, const void* p, size_t n) {
+  return std::fwrite(p, 1, n, f) == n;
+}
+
+bool rd(std::FILE* f, void* p, size_t n) {
+  return std::fread(p, 1, n, f) == n;
+}
+
+template <typename T>
+bool wr1(std::FILE* f, T v) { return wr(f, &v, sizeof(T)); }
+
+template <typename T>
+bool rd1(std::FILE* f, T* v) { return rd(f, v, sizeof(T)); }
+
+}  // namespace
+
+extern "C" {
+
+int MXTPUNDArraySave(const char* fname, int n, MXTPUNDHandle* arrays,
+                     const char** names) {
+  if (fname == nullptr || (n > 0 && arrays == nullptr)) {
+    MXTPUSetLastError("NDArraySave: null arg");
+    return -1;
+  }
+  std::FILE* f = std::fopen(fname, "wb");
+  if (f == nullptr) {
+    MXTPUSetLastError("NDArraySave: cannot open file for writing");
+    return -1;
+  }
+  bool ok = wr1<uint64_t>(f, kListMagic) && wr1<uint64_t>(f, 0) &&
+            wr1<uint64_t>(f, static_cast<uint64_t>(n));
+  for (int i = 0; ok && i < n; ++i) {
+    int ndim = 0;
+    const int64_t* shape = nullptr;
+    int dtype = 0;
+    int64_t size = 0;
+    const void* data = nullptr;
+    if (MXTPUNDArrayGetShape(arrays[i], &ndim, &shape) != 0 ||
+        MXTPUNDArrayGetDType(arrays[i], &dtype) != 0 ||
+        MXTPUNDArraySize(arrays[i], &size) != 0 ||
+        MXTPUNDArrayGetData(arrays[i], &data) != 0) {
+      std::fclose(f);
+      return -1;  // error already set
+    }
+    size_t es = esize(dtype);
+    if (es == 0) {
+      std::fclose(f);
+      MXTPUSetLastError("NDArraySave: unsupported dtype");
+      return -1;
+    }
+    ok = ok && wr1<uint32_t>(f, kV2Magic) &&
+         wr1<uint32_t>(f, static_cast<uint32_t>(ndim));
+    for (int d = 0; ok && d < ndim; ++d) ok = wr1<int64_t>(f, shape[d]);
+    ok = ok && wr1<int32_t>(f, 1) && wr1<int32_t>(f, 0) &&  // ctx: cpu(0)
+         wr1<int32_t>(f, dtype) &&
+         wr(f, data, static_cast<size_t>(size) * es);
+  }
+  int n_names = (names != nullptr) ? n : 0;
+  ok = ok && wr1<uint64_t>(f, static_cast<uint64_t>(n_names));
+  for (int i = 0; ok && i < n_names; ++i) {
+    size_t len = names[i] ? std::strlen(names[i]) : 0;
+    ok = wr1<uint64_t>(f, static_cast<uint64_t>(len)) &&
+         (len == 0 || wr(f, names[i], len));
+  }
+  std::fclose(f);
+  if (!ok) {
+    MXTPUSetLastError("NDArraySave: short write");
+    return -1;
+  }
+  return 0;
+}
+
+int MXTPUNDArrayLoad(const char* fname, int* out_n,
+                     MXTPUNDHandle** out_arrays, int* out_n_names,
+                     const char*** out_names) {
+  if (fname == nullptr || out_n == nullptr || out_arrays == nullptr) {
+    MXTPUSetLastError("NDArrayLoad: null arg");
+    return -1;
+  }
+  std::FILE* f = std::fopen(fname, "rb");
+  if (f == nullptr) {
+    MXTPUSetLastError("NDArrayLoad: cannot open file");
+    return -1;
+  }
+  static thread_local std::vector<MXTPUNDHandle> handles;
+  std::vector<MXTPUNDHandle> created;
+  auto fail = [&](const char* msg) {
+    for (auto h : created) MXTPUNDArrayFree(h);
+    std::fclose(f);
+    MXTPUSetLastError(msg);
+    return -1;
+  };
+  // file size bounds every later allocation: a corrupt shape can at most
+  // claim the bytes the file actually has, so no exception ever crosses
+  // the extern "C" boundary from a giant vector resize
+  std::fseek(f, 0, SEEK_END);
+  long fsize_l = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  if (fsize_l < 0) return fail("NDArrayLoad: cannot stat file");
+  uint64_t fsize = static_cast<uint64_t>(fsize_l);
+  uint64_t magic = 0, reserved = 0, count = 0;
+  if (!rd1(f, &magic) || magic != kListMagic)
+    return fail("NDArrayLoad: not a .params file (bad list magic)");
+  if (!rd1(f, &reserved) || !rd1(f, &count) || count > (1u << 24))
+    return fail("NDArrayLoad: corrupt header");
+  for (uint64_t i = 0; i < count; ++i) {
+    uint32_t m = 0, ndim = 0;
+    if (!rd1(f, &m)) return fail("NDArrayLoad: truncated block");
+    if (m != kV2Magic)
+      return fail("NDArrayLoad: non-dense or unknown array block (the "
+                  "native tier reads dense V2 blocks only)");
+    if (!rd1(f, &ndim) || ndim > 32) return fail("NDArrayLoad: bad ndim");
+    std::vector<int64_t> shape(ndim);
+    uint64_t nelem = 1;
+    for (uint32_t d = 0; d < ndim; ++d) {
+      if (!rd1(f, &shape[d]) || shape[d] < 0 ||
+          static_cast<uint64_t>(shape[d]) > fsize)
+        return fail("NDArrayLoad: bad shape");
+      nelem *= static_cast<uint64_t>(shape[d]);
+      if (nelem > fsize)  // more elements than file bytes: corrupt
+        return fail("NDArrayLoad: shape exceeds file size");
+    }
+    int32_t devtype = 0, devid = 0, dtype = 0;
+    if (!rd1(f, &devtype) || !rd1(f, &devid) || !rd1(f, &dtype))
+      return fail("NDArrayLoad: truncated context/dtype");
+    size_t es = esize(dtype);
+    if (es == 0) return fail("NDArrayLoad: unsupported dtype flag");
+    if (nelem * es > fsize)
+      return fail("NDArrayLoad: tensor bytes exceed file size");
+    std::vector<uint8_t> buf(static_cast<size_t>(nelem) * es);
+    if (!buf.empty() && !rd(f, buf.data(), buf.size()))
+      return fail("NDArrayLoad: truncated tensor data");
+    MXTPUNDHandle h = nullptr;
+    if (MXTPUNDArrayCreateFromBytes(buf.data(), shape.data(),
+                                    static_cast<int>(ndim), dtype, &h) != 0) {
+      for (auto hh : created) MXTPUNDArrayFree(hh);
+      std::fclose(f);
+      return -1;
+    }
+    created.push_back(h);
+  }
+  // the name-count field is unconditional in the wire format (both save
+  // paths always write it) — a missing or oversized count is corruption,
+  // not an unnamed list; silently dropping names would make a name-keyed
+  // consumer restore the wrong weights
+  uint64_t n_names = 0;
+  g_load.names.clear();
+  g_load.name_ptrs.clear();
+  if (!rd1(f, &n_names))
+    return fail("NDArrayLoad: truncated name section");
+  if (n_names > count)
+    return fail("NDArrayLoad: corrupt name count");
+  for (uint64_t i = 0; i < n_names; ++i) {
+    uint64_t len = 0;
+    if (!rd1(f, &len) || len > (1u << 20))
+      return fail("NDArrayLoad: bad name length");
+    std::string s(len, '\0');
+    if (len && !rd(f, &s[0], len))
+      return fail("NDArrayLoad: truncated name");
+    g_load.names.push_back(std::move(s));
+  }
+  std::fclose(f);
+  for (auto& s : g_load.names) g_load.name_ptrs.push_back(s.c_str());
+  handles = std::move(created);
+  *out_n = static_cast<int>(handles.size());
+  *out_arrays = handles.data();
+  if (out_n_names) *out_n_names = static_cast<int>(g_load.name_ptrs.size());
+  if (out_names) *out_names = g_load.name_ptrs.data();
+  return 0;
+}
+
+}  // extern "C"

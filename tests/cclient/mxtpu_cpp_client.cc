@@ -5,6 +5,7 @@
 // libmxnet.so). Exit 0 iff all checks pass.
 #include <cmath>
 #include <cstdio>
+#include <unistd.h>
 #include <string>
 #include <vector>
 
@@ -189,6 +190,39 @@ int main() {
       return 1;
     }
     std::printf("cpp 2-layer relu MLP loss %.4f -> %.4f\n", first, last);
+
+    // ---- checkpoint/restore through the .params C ABI (reference:
+    // MXNDArraySave/Load — same 0x112 wire format as the Python tier) ----
+    std::string ckpt = "/tmp/mxtpu_cpp_mlp_" +
+                       std::to_string(static_cast<long>(getpid())) +
+                       ".params";
+    mxtpu::save_params(ckpt, {{"w1", &w1}, {"b1", &b1},
+                              {"w2", &w2}, {"b2", &b2}});
+    auto loaded = mxtpu::load_params(ckpt);
+    std::remove(ckpt.c_str());
+    if (loaded.size() != 4 || loaded[0].first != "w1") {
+      std::fprintf(stderr, "load_params wrong names/count\n");
+      return 1;
+    }
+    auto w1v_now = w1.to_vector();
+    auto w1v_loaded = loaded[0].second.to_vector();
+    for (size_t i = 0; i < w1v_now.size(); ++i)
+      if (w1v_now[i] != w1v_loaded[i]) {
+        std::fprintf(stderr, ".params roundtrip altered w1[%zu]\n", i);
+        return 1;
+      }
+    // the reloaded weights reproduce the final-weight loss exactly
+    // (`last` predates the loop's final update, so recompute the target)
+    float final_loss = ex.forward()[0];
+    mxtpu::Executor ex2(loss, {{"x", &x},
+                               {"y", &y},
+                               {"w1", &loaded[0].second},
+                               {"b1", &loaded[1].second},
+                               {"w2", &loaded[2].second},
+                               {"b2", &loaded[3].second}});
+    auto lv2 = ex2.forward();
+    if (check_eps(lv2[0], final_loss, 1e-6f, "reloaded-ckpt loss")) return 1;
+    std::printf("cpp .params checkpoint roundtrip ok\n");
   } catch (const std::exception& e) {
     std::fprintf(stderr, "unexpected: %s\n", e.what());
     return 1;

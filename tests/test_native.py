@@ -353,6 +353,76 @@ def test_c_abi_mixed_dtype_errors():
         native.imperative_invoke("relu", [np.zeros((2, 2), np.int32)])
 
 
+def test_c_abi_params_interop_with_python_tier(tmp_path):
+    """MXTPUNDArraySave/Load write the dmlc 0x112 wire format byte-for-byte
+    compatibly with mxnet_tpu.serialization (reference: MXNDArraySave/Load
+    over NDArray::Save/Load) — C-saved files load in Python and vice versa."""
+    import ctypes
+
+    from mxnet_tpu.serialization import load_ndarrays, save_ndarrays
+
+    L = native.lib()
+    rs = np.random.RandomState(0)
+    w = rs.randn(3, 4).astype(np.float32)
+    b = rs.randn(4).astype(np.float64)
+
+    # C save -> Python load
+    f1 = str(tmp_path / "c_saved.params")
+    h_w = native._numpy_to_handle(L, w)
+    h_b = native._numpy_to_handle(L, b)
+    try:
+        arrs = (ctypes.c_void_p * 2)(h_w, h_b)
+        names = (ctypes.c_char_p * 2)(b"w", b"b")
+        L.MXTPUNDArraySave.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_char_p)]
+        assert L.MXTPUNDArraySave(f1.encode(), 2, arrs, names) == 0, \
+            L.MXTPUGetLastError().decode()
+    finally:
+        L.MXTPUNDArrayFree(h_w)
+        L.MXTPUNDArrayFree(h_b)
+    back = load_ndarrays(f1)
+    np.testing.assert_array_equal(back["w"].asnumpy(), w)
+    # the Python tier runs with jax x64 OFF (base.py stance), so the f64
+    # block narrows to f32 at NDArray construction — values survive to f32
+    # precision; the C tier below preserves f64 exactly
+    np.testing.assert_allclose(back["b"].asnumpy(), b, rtol=1e-7)
+
+    # Python save -> C load
+    f2 = str(tmp_path / "py_saved.params")
+    save_ndarrays(f2, {"w": w, "b": b})
+    L.MXTPUNDArrayLoad.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_void_p)),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+    n = ctypes.c_int()
+    hs = ctypes.POINTER(ctypes.c_void_p)()
+    n_names = ctypes.c_int()
+    nm = ctypes.POINTER(ctypes.c_char_p)()
+    assert L.MXTPUNDArrayLoad(f2.encode(), ctypes.byref(n), ctypes.byref(hs),
+                              ctypes.byref(n_names), ctypes.byref(nm)) == 0, \
+        L.MXTPUGetLastError().decode()
+    try:
+        assert n.value == 2 and n_names.value == 2
+        assert [nm[i].decode() for i in range(2)] == ["w", "b"]
+        got_w = native._handle_to_numpy(L, hs[0])
+        got_b = native._handle_to_numpy(L, hs[1])
+        np.testing.assert_array_equal(got_w, w)
+        np.testing.assert_array_equal(got_b, b)
+        assert got_b.dtype == np.float64
+    finally:
+        for i in range(n.value):
+            L.MXTPUNDArrayFree(hs[i])
+    # loud failure on a truncated file
+    f3 = str(tmp_path / "trunc.params")
+    with open(f2, "rb") as src, open(f3, "wb") as dst:
+        dst.write(src.read()[:40])
+    assert L.MXTPUNDArrayLoad(f3.encode(), ctypes.byref(n), ctypes.byref(hs),
+                              ctypes.byref(n_names), ctypes.byref(nm)) != 0
+    assert "ndarrayload" in L.MXTPUGetLastError().decode().lower()
+
+
 def test_c_abi_bridge_ops_join_the_tape():
     """Round-4 verdict weak #4: bridge-dispatched ops must not silently
     bypass the C autograd tape. Recording through a bridge op now records

@@ -119,7 +119,10 @@ def harvest(round_no, dryrun=False):
     mb_path = os.path.join(REPO, f"MODELBENCH{tag}.json")
     mb_cmd = [sys.executable, "tools/modelbench.py", "--json", mb_path]
     if dryrun:
-        mb_cmd += ["--platform", "cpu", "--steps", "2"]
+        # gpt2_tiny: the dryrun validates the code path, not the timing —
+        # a 345M-param CPU step would burn an hour of single-core time
+        mb_cmd += ["--platform", "cpu", "--steps", "2",
+                   "--models", "resnet50,gpt2_tiny"]
     rc, out, err = _run(mb_cmd, timeout=2400)
     summary["modelbench"] = {"rc": rc,
                              "rows": _json_lines(out) if rc == 0 else err}
