@@ -21,7 +21,12 @@ native:
 	$(MAKE) -C native
 
 fast: native
-	$(PY) -m pytest tests/ -q -m "not slow"
+	@start=$$(date +%s); \
+	$(PY) -m pytest tests/ -q -m "not slow"; rc=$$?; \
+	el=$$(( $$(date +%s) - start )); \
+	echo "make fast: $${el}s (budget 600s)"; \
+	if [ $$rc -ne 0 ]; then exit $$rc; fi; \
+	if [ $$el -gt 600 ]; then echo "make fast: OVER BUDGET (>600s)"; exit 1; fi
 
 slow: native
 	$(PY) -m pytest tests/ -q -m "slow"

@@ -5,6 +5,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
 
@@ -27,6 +28,7 @@ def test_generator_discriminator_shapes():
     assert int(np.prod(logit.shape)) == 2
 
 
+@pytest.mark.slow
 def test_dcgan_trains_without_nans_and_g_improves():
     from train_dcgan import train
 

@@ -317,3 +317,21 @@ def test_image_folder_dataset_grayscale_and_case(tmp_path):
     ds0 = ImageFolderDataset(str(tmp_path), flag=0)
     gray, _ = ds0[0]
     assert gray.shape == (6, 6, 1)
+
+
+def test_iobench_artifact_gate():
+    """SURVEY M2 gate evidence (round-4 verdict ask #6): the committed
+    IOBENCH.json artifact must exist, carry real numbers, and show the
+    input pipeline outrunning the CPU-step consumer. Regenerate with
+    `python tools/iobench.py --json IOBENCH.json` after pipeline changes."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "IOBENCH.json")
+    assert os.path.exists(path), "IOBENCH.json missing — run tools/iobench.py"
+    art = json.load(open(path))
+    assert art["value"] > 50, art  # imgs/s through decode+aug+batchify
+    assert art["pipeline_covers_cpu_step"] is True
+    assert art["resnet50_cpu_step_imgs_per_sec"] > 0
+    assert "imgs_per_sec_by_threads" in art

@@ -5,10 +5,12 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
 
 
+@pytest.mark.slow
 def test_ring_lm_step_over_sp_mesh():
     from long_context_lm import build_sp_mesh, ring_lm_step
 
@@ -18,6 +20,7 @@ def test_ring_lm_step_over_sp_mesh():
     assert shapes == [(1, 2, 1024, 16)] * 3
 
 
+@pytest.mark.slow
 def test_single_chip_long_seq_lm_trains():
     from long_context_lm import single_chip_flash_lm
 

@@ -75,6 +75,7 @@ def test_train_step_dp_matches_single_device():
                                    rtol=1e-4, atol=1e-6, err_msg=f"{k1} vs {k2}")
 
 
+@pytest.mark.slow
 def test_train_step_tp_bert_tiny():
     """TP-sharded BERT step must run and produce finite loss with params
     actually sharded across tp."""
@@ -108,6 +109,7 @@ def test_train_step_tp_bert_tiny():
     ts.sync()  # write back to gluon params without error
 
 
+@pytest.mark.slow
 def test_ring_attention_matches_dense():
     mesh = make_mesh(MeshConfig(sp=8))
     B, H, T, D = 2, 2, 64, 16
@@ -135,6 +137,7 @@ def test_ring_attention_matches_dense():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_grad_finite():
     mesh = make_mesh(MeshConfig(sp=4))
     B, H, T, D = 1, 2, 32, 8
@@ -184,6 +187,7 @@ def test_distributed_trainer_single_process():
     tr.step(4)  # must not raise
 
 
+@pytest.mark.slow
 def test_pipeline_parallel_parity():
     """GPipe over a pp=8 mesh == sequential stage application, fwd and grad."""
     import jax
@@ -218,6 +222,7 @@ def test_pipeline_parallel_parity():
                                rtol=5e-4, atol=5e-5)
 
 
+@pytest.mark.slow
 def test_moe_expert_parallel_parity():
     """ep=8 all_to_all MoE == dense top-1 routing reference (no drops)."""
     import jax
@@ -247,6 +252,7 @@ def test_moe_expert_parallel_parity():
         assert np.isfinite(arr).all() and np.abs(arr).sum() > 0, k
 
 
+@pytest.mark.slow
 def test_moe_capacity_drops_tokens_gracefully():
     """Tight capacity drops overflow tokens to zero output, no crash/nan."""
     import jax

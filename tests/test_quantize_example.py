@@ -1,11 +1,13 @@
 """Quantization example smoke (reference: example/quantization flow):
 PTQ conversion preserves accuracy within a small delta on the toy task."""
 import os
+import pytest
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
 
 
+@pytest.mark.slow
 def test_quantize_model_accuracy_delta():
     import quantize_model
 

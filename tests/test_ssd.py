@@ -22,6 +22,7 @@ def _toy_batch(n=8, size=32, seed=0):
     return nd.array(imgs), nd.array(labels)
 
 
+@pytest.mark.slow
 def test_ssd_forward_shapes():
     mx.random.seed(0)
     net = get_ssd(num_classes=2)
@@ -35,6 +36,7 @@ def test_ssd_forward_shapes():
     assert box_preds.shape == (2, A * 4)
 
 
+@pytest.mark.slow
 def test_multibox_target_matching():
     anchors = nd.array(np.array(
         [[[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0],
@@ -53,6 +55,7 @@ def test_multibox_target_matching():
                                np.log(0.4 / 0.5) / 0.2, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_multibox_target_hard_negative_mining():
     a = np.random.RandomState(0).rand(1, 16, 4).astype(np.float32).copy()
     a[..., 2:] = a[..., :2] + 0.3  # valid corner boxes
@@ -72,6 +75,7 @@ def test_multibox_target_hard_negative_mining():
     assert n_pos + n_neg + n_ign == 16
 
 
+@pytest.mark.slow
 def test_ssd_trains_and_detects():
     """End-to-end: loss falls on the toy box task; detect() emits rows in
     the reference's (cls, score, box) layout."""
@@ -118,6 +122,7 @@ def test_multibox_target_pad_rows_cannot_clobber_anchor0():
     np.testing.assert_allclose(ct.asnumpy()[0, 0], 3.0)
 
 
+@pytest.mark.slow
 def test_multibox_target_mining_thresh():
     """negative_mining_thresh gates which negatives are mined."""
     a = np.random.RandomState(0).rand(1, 8, 4).astype(np.float32).copy()

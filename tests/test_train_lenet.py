@@ -2,6 +2,7 @@
 matching eager loss curves — driver config #1 shape.
 (reference analog: tests/python/train/test_conv.py)"""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon, nd
@@ -22,6 +23,7 @@ def _lenet():
     return net
 
 
+@pytest.mark.slow
 def test_lenet_mnist_end_to_end():
     mx.random.seed(0)
     train = MNIST(train=True)  # synthetic fallback, weakly learnable
@@ -59,6 +61,7 @@ def test_lenet_mnist_end_to_end():
     assert acc > 0.15, f"accuracy {acc} no better than chance"
 
 
+@pytest.mark.slow
 def test_lenet_hybrid_eager_loss_parity():
     """First training losses must match between eager and hybridized nets
     when params and data are identical."""

@@ -4,6 +4,7 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
 
@@ -18,6 +19,7 @@ def test_synthetic_corpus_and_batchify():
     assert data.shape == (1000 // 8, 8)
 
 
+@pytest.mark.slow
 def test_word_lm_trains_to_falling_loss():
     import mxnet_tpu as mx
     from mxnet_tpu import autograd, gluon, nd
