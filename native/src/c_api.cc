@@ -57,6 +57,7 @@ struct Params {
   std::map<std::string, double> nums;
   std::map<std::string, bool> bools;
   std::map<std::string, std::string> strs;
+  std::map<std::string, std::vector<double>> arrs;
 
   bool flag(const std::string& k, bool dflt) const {
     auto it = bools.find(k);
@@ -68,6 +69,26 @@ struct Params {
   double num(const std::string& k, double dflt) const {
     auto it = nums.find(k);
     return it == nums.end() ? dflt : it->second;
+  }
+  std::string str(const std::string& k, const std::string& dflt) const {
+    auto it = strs.find(k);
+    return it == strs.end() ? dflt : it->second;
+  }
+  // 2-element int pair (kernel/stride/pad); a scalar number broadcasts
+  std::pair<int64_t, int64_t> pair2(const std::string& k, int64_t d0,
+                                    int64_t d1) const {
+    auto it = arrs.find(k);
+    if (it != arrs.end() && it->second.size() >= 2)
+      return {static_cast<int64_t>(it->second[0]),
+              static_cast<int64_t>(it->second[1])};
+    if (it != arrs.end() && it->second.size() == 1)
+      return {static_cast<int64_t>(it->second[0]),
+              static_cast<int64_t>(it->second[0])};
+    auto n = nums.find(k);
+    if (n != nums.end())
+      return {static_cast<int64_t>(n->second),
+              static_cast<int64_t>(n->second)};
+    return {d0, d1};
   }
 };
 
@@ -100,6 +121,24 @@ bool parse_params(const char* json, Params* out, std::string* err) {
       if (*p != '"') { *err = "param_json: unterminated string"; return false; }
       ++p;
       out->strs[key] = val;
+    } else if (*p == '[') {
+      ++p;
+      std::vector<double> vals;
+      while (true) {
+        skip_ws();
+        if (*p == ']') { ++p; break; }
+        char* end = nullptr;
+        double v = std::strtod(p, &end);
+        if (end == p) { *err = "param_json: bad array element for " + key; return false; }
+        vals.push_back(v);
+        p = end;
+        skip_ws();
+        if (*p == ',') { ++p; continue; }
+        if (*p == ']') { ++p; break; }
+        *err = "param_json: expected ',' or ']' in array";
+        return false;
+      }
+      out->arrs[key] = std::move(vals);
     } else if (std::strncmp(p, "true", 4) == 0) {
       out->bools[key] = true; p += 4;
     } else if (std::strncmp(p, "false", 5) == 0) {
@@ -130,6 +169,13 @@ bool parse_params(const char* json, Params* out, std::string* err) {
 using NativeOp = std::function<int(std::vector<NDArrayRec*>&, const Params&,
                                    std::vector<NDArrayRec*>*)>;
 
+// Return code for "this config is outside the native kernel's envelope":
+// the dispatcher retries through the jax bridge when one is installed, so
+// registering a native op never REMOVES capability the bridge had (the
+// bridge covers every dtype/layout/feature of the full registry). Without
+// a bridge the stashed error message surfaces as a plain -1.
+constexpr int kTryBridge = -2;
+
 // All inputs must share one dtype from {f32, f64}; writes it to *dtype.
 int common_dtype(std::vector<NDArrayRec*>& ins, const char* op, int* dtype) {
   int dt = ins.empty() ? kMXTPUFloat32 : ins[0]->dtype;
@@ -142,7 +188,7 @@ int common_dtype(std::vector<NDArrayRec*>& ins, const char* op, int* dtype) {
   if (dt != kMXTPUFloat32 && dt != kMXTPUFloat64) {
     g_last_error = std::string(op) + ": native tier supports float32/float64 "
                    "(use the jax bridge for other dtypes)";
-    return -1;
+    return kTryBridge;
   }
   *dtype = dt;
   return 0;
@@ -175,11 +221,11 @@ int op_dot(std::vector<NDArrayRec*>& ins, const Params& ps,
            std::vector<NDArrayRec*>* outs) {
   if (ins.size() != 2) { g_last_error = "dot: expects 2 inputs"; return -1; }
   int dt;
-  if (common_dtype(ins, "dot", &dt)) return -1;
+  if (int rc = common_dtype(ins, "dot", &dt)) return rc;
   NDArrayRec *a = ins[0], *b = ins[1];
   if (a->shape.size() != 2 || b->shape.size() != 2) {
     g_last_error = "dot: native tier handles 2-D only";
-    return -1;
+    return kTryBridge;
   }
   bool ta = ps.flag("transpose_a", false), tb = ps.flag("transpose_b", false);
   int64_t m = ta ? a->shape[1] : a->shape[0];
@@ -214,14 +260,14 @@ int op_softmax(std::vector<NDArrayRec*>& ins, const Params& ps,
                std::vector<NDArrayRec*>* outs) {
   if (ins.size() != 1) { g_last_error = "softmax: expects 1 input"; return -1; }
   int dt;
-  if (common_dtype(ins, "softmax", &dt)) return -1;
+  if (int rc = common_dtype(ins, "softmax", &dt)) return rc;
   NDArrayRec* a = ins[0];
   int ndim = static_cast<int>(a->shape.size());
   int axis = static_cast<int>(ps.num("axis", -1));
   if (axis < 0) axis += ndim;
   if (axis != ndim - 1) {
     g_last_error = "softmax: native tier handles last-axis only";
-    return -1;
+    return kTryBridge;
   }
   int64_t inner = a->shape[ndim - 1];
   int64_t outer = a->size() / inner;
@@ -253,10 +299,10 @@ int binary_ew(std::vector<NDArrayRec*>& ins, std::vector<NDArrayRec*>* outs,
               const char* name, F fn) {
   if (ins.size() != 2) { g_last_error = std::string(name) + ": expects 2 inputs"; return -1; }
   int dt;
-  if (common_dtype(ins, name, &dt)) return -1;
+  if (int rc = common_dtype(ins, name, &dt)) return rc;
   if (ins[0]->shape != ins[1]->shape) {
     g_last_error = std::string(name) + ": native tier requires equal shapes";
-    return -1;
+    return kTryBridge;  // the bridge broadcasts
   }
   NDArrayRec* o = make_out(ins[0]->shape, dt);
   return dtype_dispatch(dt, [&](auto zero) {
@@ -275,7 +321,7 @@ int unary_ew(std::vector<NDArrayRec*>& ins, std::vector<NDArrayRec*>* outs,
              const char* name, F fn) {
   if (ins.size() != 1) { g_last_error = std::string(name) + ": expects 1 input"; return -1; }
   int dt;
-  if (common_dtype(ins, name, &dt)) return -1;
+  if (int rc = common_dtype(ins, name, &dt)) return rc;
   NDArrayRec* o = make_out(ins[0]->shape, dt);
   return dtype_dispatch(dt, [&](auto zero) {
     using T = decltype(zero);
@@ -293,7 +339,7 @@ int op_sum(std::vector<NDArrayRec*>& ins, const Params& ps,
   // (the two reductions the graph tier's VJPs need)
   if (ins.size() != 1) { g_last_error = "sum: expects 1 input"; return -1; }
   int dt;
-  if (common_dtype(ins, "sum", &dt)) return -1;
+  if (int rc = common_dtype(ins, "sum", &dt)) return rc;
   NDArrayRec* a = ins[0];
   bool has_axis = ps.nums.count("axis") > 0;
   if (!has_axis) {
@@ -311,7 +357,7 @@ int op_sum(std::vector<NDArrayRec*>& ins, const Params& ps,
   int axis = static_cast<int>(ps.num("axis", 0));
   if (a->shape.size() != 2 || axis != 0) {
     g_last_error = "sum: native tier handles axis=0 on 2-D (or full reduce)";
-    return -1;
+    return kTryBridge;
   }
   int64_t rows = a->shape[0], cols = a->shape[1];
   NDArrayRec* o = make_out({cols}, dt);
@@ -333,7 +379,7 @@ int op_mul_scalar(std::vector<NDArrayRec*>& ins, const Params& ps,
                   std::vector<NDArrayRec*>* outs) {
   if (ins.size() != 1) { g_last_error = "_mul_scalar: expects 1 input"; return -1; }
   int dt;
-  if (common_dtype(ins, "_mul_scalar", &dt)) return -1;
+  if (int rc = common_dtype(ins, "_mul_scalar", &dt)) return rc;
   double s = ps.num("scalar", 1.0);
   NDArrayRec* o = make_out(ins[0]->shape, dt);
   return dtype_dispatch(dt, [&](auto zero) {
@@ -352,13 +398,13 @@ int op_broadcast_add(std::vector<NDArrayRec*>& ins, const Params&,
   // (M, N) + (N,): the bias-add shape every dense layer needs
   if (ins.size() != 2) { g_last_error = "broadcast_add: expects 2 inputs"; return -1; }
   int dt;
-  if (common_dtype(ins, "broadcast_add", &dt)) return -1;
+  if (int rc = common_dtype(ins, "broadcast_add", &dt)) return rc;
   NDArrayRec *a = ins[0], *b = ins[1];
   if (a->shape != b->shape &&
       (a->shape.size() != 2 || b->shape.size() != 1 ||
        a->shape[1] != b->shape[0])) {
     g_last_error = "broadcast_add: native tier handles (M,N)+(N,) only";
-    return -1;
+    return kTryBridge;
   }
   NDArrayRec* o = make_out(a->shape, dt);
   return dtype_dispatch(dt, [&](auto zero) {
@@ -374,6 +420,208 @@ int op_broadcast_add(std::vector<NDArrayRec*>& ins, const Params&,
         for (int64_t j = 0; j < cols; ++j)
           C[i * cols + j] = A[i * cols + j] + B[j];
     }
+    outs->push_back(o);
+    return 0;
+  });
+}
+
+// -- NN inference ops (reference: src/operator/nn/convolution.cc,
+// pooling.cc, fully_connected.cc). Forward-only host kernels so an exported
+// Python-trained conv net runs from pure C (no VJPs: backward through these
+// fails loudly, training conv nets stays the jax tier's job). --------------
+
+int op_convolution(std::vector<NDArrayRec*>& ins, const Params& ps,
+                   std::vector<NDArrayRec*>* outs) {
+  if (ins.size() != 2 && ins.size() != 3) {
+    g_last_error = "Convolution: expects (data, weight[, bias])";
+    return -1;
+  }
+  int dt;
+  if (int rc = common_dtype(ins, "Convolution", &dt)) return rc;
+  NDArrayRec *x = ins[0], *w = ins[1];
+  NDArrayRec* b = ins.size() == 3 && !ps.flag("no_bias", false) ? ins[2]
+                                                                : nullptr;
+  if (x->shape.size() != 4 || w->shape.size() != 4) {
+    g_last_error = "Convolution: native tier handles NCHW 2-D conv only";
+    return kTryBridge;
+  }
+  int64_t N = x->shape[0], C = x->shape[1], H = x->shape[2], W = x->shape[3];
+  int64_t O = w->shape[0], kh = w->shape[2], kw = w->shape[3];
+  if (w->shape[1] != C) {
+    g_last_error = "Convolution: weight channel mismatch (grouped conv is "
+                   "not in the native tier)";
+    return kTryBridge;
+  }
+  auto dil = ps.pair2("dilate", 1, 1);
+  if (dil.first != 1 || dil.second != 1) {
+    g_last_error = "Convolution: dilation is not in the native tier";
+    return kTryBridge;
+  }
+  auto st = ps.pair2("stride", 1, 1);
+  auto pd = ps.pair2("pad", 0, 0);
+  if (st.first <= 0 || st.second <= 0) {
+    g_last_error = "Convolution: stride must be positive";
+    return -1;
+  }
+  int64_t oh = (H + 2 * pd.first - kh) / st.first + 1;
+  int64_t ow = (W + 2 * pd.second - kw) / st.second + 1;
+  if (oh <= 0 || ow <= 0) {
+    g_last_error = "Convolution: output size would be empty";
+    return -1;
+  }
+  NDArrayRec* o = make_out({N, O, oh, ow}, dt);
+  return dtype_dispatch(dt, [&](auto zero) {
+    using T = decltype(zero);
+    const T* X = tdata<T>(x);
+    const T* K = tdata<T>(w);
+    const T* B = b ? tdata<T>(b) : nullptr;
+    T* Y = tdata<T>(o);
+    for (int64_t n = 0; n < N; ++n)
+      for (int64_t oc = 0; oc < O; ++oc)
+        for (int64_t y = 0; y < oh; ++y)
+          for (int64_t xw = 0; xw < ow; ++xw) {
+            double acc = B ? static_cast<double>(B[oc]) : 0.0;
+            for (int64_t ic = 0; ic < C; ++ic)
+              for (int64_t r = 0; r < kh; ++r) {
+                int64_t iy = y * st.first - pd.first + r;
+                if (iy < 0 || iy >= H) continue;
+                const T* xrow = X + ((n * C + ic) * H + iy) * W;
+                const T* krow = K + ((oc * C + ic) * kh + r) * kw;
+                for (int64_t s = 0; s < kw; ++s) {
+                  int64_t ix = xw * st.second - pd.second + s;
+                  if (ix < 0 || ix >= W) continue;
+                  acc += static_cast<double>(xrow[ix]) * krow[s];
+                }
+              }
+            Y[((n * O + oc) * oh + y) * ow + xw] = static_cast<T>(acc);
+          }
+    outs->push_back(o);
+    return 0;
+  });
+}
+
+int op_pooling(std::vector<NDArrayRec*>& ins, const Params& ps,
+               std::vector<NDArrayRec*>* outs) {
+  if (ins.size() != 1) { g_last_error = "Pooling: expects 1 input"; return -1; }
+  int dt;
+  if (int rc = common_dtype(ins, "Pooling", &dt)) return rc;
+  NDArrayRec* x = ins[0];
+  if (x->shape.size() != 4) {
+    g_last_error = "Pooling: native tier handles NCHW only";
+    return kTryBridge;
+  }
+  std::string type = ps.str("pool_type", "max");
+  if (type != "max" && type != "avg") {
+    g_last_error = "Pooling: native tier handles pool_type max/avg only";
+    return kTryBridge;
+  }
+  int64_t N = x->shape[0], C = x->shape[1], H = x->shape[2], W = x->shape[3];
+  auto kn = ps.pair2("kernel", 2, 2);
+  auto st = ps.pair2("stride", kn.first, kn.second);
+  auto pd = ps.pair2("pad", 0, 0);
+  if (st.first <= 0 || st.second <= 0) {
+    g_last_error = "Pooling: stride must be positive";
+    return -1;
+  }
+  if (pd.first >= kn.first || pd.second >= kn.second) {
+    // reference PoolingParam validation: pad < kernel, so no window is
+    // ever entirely padding (avoids a max over zero elements)
+    g_last_error = "Pooling: pad must be smaller than kernel";
+    return -1;
+  }
+  int64_t oh = (H + 2 * pd.first - kn.first) / st.first + 1;
+  int64_t ow = (W + 2 * pd.second - kn.second) / st.second + 1;
+  if (ps.flag("global_pool", false)) {
+    kn = {H, W}; st = {1, 1}; pd = {0, 0}; oh = ow = 1;
+  }
+  if (oh <= 0 || ow <= 0) {
+    g_last_error = "Pooling: output size would be empty";
+    return -1;
+  }
+  NDArrayRec* o = make_out({N, C, oh, ow}, dt);
+  bool is_max = type == "max";
+  // avg semantics match the Python tier: count_include_pad=True (divide by
+  // kernel area) is the reference default; =false divides by valid cells
+  bool include_pad = ps.flag("count_include_pad", true);
+  int64_t area = kn.first * kn.second;
+  return dtype_dispatch(dt, [&](auto zero) {
+    using T = decltype(zero);
+    const T* X = tdata<T>(x);
+    T* Y = tdata<T>(o);
+    for (int64_t n = 0; n < N; ++n)
+      for (int64_t c = 0; c < C; ++c)
+        for (int64_t y = 0; y < oh; ++y)
+          for (int64_t xw = 0; xw < ow; ++xw) {
+            double acc = is_max ? -1e300 : 0.0;
+            int64_t cnt = 0;
+            for (int64_t r = 0; r < kn.first; ++r) {
+              int64_t iy = y * st.first - pd.first + r;
+              if (iy < 0 || iy >= H) continue;
+              for (int64_t s = 0; s < kn.second; ++s) {
+                int64_t ix = xw * st.second - pd.second + s;
+                if (ix < 0 || ix >= W) continue;
+                double v = X[((n * C + c) * H + iy) * W + ix];
+                if (is_max) acc = std::max(acc, v);
+                else acc += v;
+                ++cnt;
+              }
+            }
+            if (!is_max) acc /= include_pad ? area : std::max<int64_t>(cnt, 1);
+            Y[((n * C + c) * oh + y) * ow + xw] = static_cast<T>(acc);
+          }
+    outs->push_back(o);
+    return 0;
+  });
+}
+
+int op_flatten(std::vector<NDArrayRec*>& ins, const Params&,
+               std::vector<NDArrayRec*>* outs) {
+  if (ins.size() != 1) { g_last_error = "Flatten: expects 1 input"; return -1; }
+  NDArrayRec* x = ins[0];
+  if (x->shape.empty()) { g_last_error = "Flatten: scalar input"; return -1; }
+  int64_t rest = 1;
+  for (size_t i = 1; i < x->shape.size(); ++i) rest *= x->shape[i];
+  NDArrayRec* o = make_out({x->shape[0], rest}, x->dtype);
+  std::memcpy(o->data.data(), x->data.data(), x->data.size());
+  outs->push_back(o);
+  return 0;
+}
+
+int op_fully_connected(std::vector<NDArrayRec*>& ins, const Params& ps,
+                       std::vector<NDArrayRec*>* outs) {
+  // y = x . w^T + b, weight stored (num_hidden, in) — the reference layout
+  if (ins.size() != 2 && ins.size() != 3) {
+    g_last_error = "FullyConnected: expects (data, weight[, bias])";
+    return -1;
+  }
+  int dt;
+  if (int rc = common_dtype(ins, "FullyConnected", &dt)) return rc;
+  NDArrayRec *x = ins[0], *w = ins[1];
+  NDArrayRec* b = ins.size() == 3 && !ps.flag("no_bias", false) ? ins[2]
+                                                                : nullptr;
+  if (x->shape.size() != 2 || w->shape.size() != 2 ||
+      x->shape[1] != w->shape[1]) {
+    g_last_error = "FullyConnected: native tier handles 2-D data with "
+                   "matching in-features";
+    return kTryBridge;
+  }
+  int64_t N = x->shape[0], In = x->shape[1], Out = w->shape[0];
+  NDArrayRec* o = make_out({N, Out}, dt);
+  return dtype_dispatch(dt, [&](auto zero) {
+    using T = decltype(zero);
+    const T* X = tdata<T>(x);
+    const T* Wt = tdata<T>(w);
+    const T* B = b ? tdata<T>(b) : nullptr;
+    T* Y = tdata<T>(o);
+    for (int64_t n = 0; n < N; ++n)
+      for (int64_t j = 0; j < Out; ++j) {
+        double acc = B ? static_cast<double>(B[j]) : 0.0;
+        const T* xr = X + n * In;
+        const T* wr = Wt + j * In;
+        for (int64_t k = 0; k < In; ++k)
+          acc += static_cast<double>(xr[k]) * wr[k];
+        Y[n * Out + j] = static_cast<T>(acc);
+      }
     outs->push_back(o);
     return 0;
   });
@@ -404,6 +652,15 @@ const std::map<std::string, NativeOp>& native_registry() {
          return unary_ew(i, o, "log", [](auto a) { return std::log(a); }); }},
       {"negative", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
          return unary_ew(i, o, "negative", [](auto a) { return -a; }); }},
+      {"tanh", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
+         return unary_ew(i, o, "tanh", [](auto a) { return std::tanh(a); }); }},
+      {"sigmoid", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
+         return unary_ew(i, o, "sigmoid", [](auto a) { return decltype(a)(1) / (decltype(a)(1) + std::exp(-a)); }); }},
+      {"Convolution", op_convolution},
+      {"Pooling", op_pooling},
+      {"Flatten", op_flatten},
+      {"flatten", op_flatten},
+      {"FullyConnected", op_fully_connected},
   };
   return reg;
 }
@@ -504,7 +761,18 @@ int MXTPUImperativeInvoke(const char* op_name, MXTPUNDHandle* inputs,
     ins.push_back(static_cast<NDArrayRec*>(inputs[i]));
   }
   std::vector<NDArrayRec*> outs;
-  if (it->second(ins, ps, &outs) != 0) {
+  int rc = it->second(ins, ps, &outs);
+  if (rc == kTryBridge && g_bridge != nullptr) {
+    // config outside the native kernel's envelope: the full-registry
+    // bridge takes over, so native registration never shrinks the ABI
+    for (auto* o : outs) delete o;
+    rc = g_bridge(op_name, inputs, n_in, param_json, outputs, n_out);
+    if (rc == 0 && mxtpu::autograd_is_recording())
+      mxtpu::autograd_record(op_name, inputs, n_in, param_json, outputs,
+                             *n_out);
+    return rc;
+  }
+  if (rc != 0) {
     for (auto* o : outs) delete o;
     return -1;
   }

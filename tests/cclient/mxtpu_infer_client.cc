@@ -1,0 +1,107 @@
+// Train-in-Python / serve-from-C++ client (reference workflow:
+// cpp-package/example/inference — load a Python-trained checkpoint and run
+// a conv net natively, no Python anywhere in the process).
+//
+// Usage: mxtpu_infer_client <weights.params> <io.params>
+//   weights.params: c1w c1b c2w c2b d1w d1b d2w d2b d3w d3b (LeNet-5)
+//   io.params:      x (N,1,28,28 input), y (N,10 expected logits from the
+//                   Python/XLA forward of the SAME weights)
+// Exit 0 iff the native C++ forward reproduces the Python logits to 1e-3.
+#include <cmath>
+#include <cstdio>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "../../native/include/mxtpu_cpp.hpp"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s weights.params io.params\n", argv[0]);
+    return 2;
+  }
+  try {
+    auto weights = mxtpu::load_params(argv[1]);
+    std::map<std::string, mxtpu::NDArray> w;
+    for (auto& kv : weights) w[kv.first] = std::move(kv.second);
+    auto io = mxtpu::load_params(argv[2]);
+    std::map<std::string, mxtpu::NDArray> iov;
+    for (auto& kv : io) iov[kv.first] = std::move(kv.second);
+    const char* names[] = {"c1w", "c1b", "c2w", "c2b", "d1w",
+                           "d1b", "d2w", "d2b", "d3w", "d3b"};
+    for (const char* n : names)
+      if (!w.count(n)) {
+        std::fprintf(stderr, "missing weight %s\n", n);
+        return 1;
+      }
+    if (!iov.count("x") || !iov.count("y")) {
+      std::fprintf(stderr, "io.params must carry x and y\n");
+      return 1;
+    }
+
+    // LeNet-5 graph, exactly the zoo architecture
+    // (model_zoo/vision/lenet.py): conv6@5x5 pad2 tanh -> max2/2 ->
+    // conv16@5x5 tanh -> max2/2 -> flatten -> 120 tanh -> 84 tanh -> 10
+    using mxtpu::Symbol;
+    auto vx = Symbol::Variable("x");
+    auto vc1w = Symbol::Variable("c1w");
+    auto vc1b = Symbol::Variable("c1b");
+    auto vc2w = Symbol::Variable("c2w");
+    auto vc2b = Symbol::Variable("c2b");
+    auto vd1w = Symbol::Variable("d1w");
+    auto vd1b = Symbol::Variable("d1b");
+    auto vd2w = Symbol::Variable("d2w");
+    auto vd2b = Symbol::Variable("d2b");
+    auto vd3w = Symbol::Variable("d3w");
+    auto vd3b = Symbol::Variable("d3b");
+    auto c1 = Symbol::Op("Convolution", {&vx, &vc1w, &vc1b},
+                         "{\"kernel\": [5, 5], \"pad\": [2, 2], "
+                         "\"num_filter\": 6}");
+    auto t1 = Symbol::Op("tanh", {&c1});
+    auto p1 = Symbol::Op("Pooling", {&t1},
+                         "{\"pool_type\": \"max\", \"kernel\": [2, 2], "
+                         "\"stride\": [2, 2]}");
+    auto c2 = Symbol::Op("Convolution", {&p1, &vc2w, &vc2b},
+                         "{\"kernel\": [5, 5], \"num_filter\": 16}");
+    auto t2 = Symbol::Op("tanh", {&c2});
+    auto p2 = Symbol::Op("Pooling", {&t2},
+                         "{\"pool_type\": \"max\", \"kernel\": [2, 2], "
+                         "\"stride\": [2, 2]}");
+    auto fl = Symbol::Op("Flatten", {&p2});
+    auto d1 = Symbol::Op("FullyConnected", {&fl, &vd1w, &vd1b},
+                         "{\"num_hidden\": 120}");
+    auto t3 = Symbol::Op("tanh", {&d1});
+    auto d2 = Symbol::Op("FullyConnected", {&t3, &vd2w, &vd2b},
+                         "{\"num_hidden\": 84}");
+    auto t4 = Symbol::Op("tanh", {&d2});
+    auto out = Symbol::Op("FullyConnected", {&t4, &vd3w, &vd3b},
+                          "{\"num_hidden\": 10}");
+
+    mxtpu::Executor ex(out, {{"x", &iov.at("x")},
+                             {"c1w", &w.at("c1w")}, {"c1b", &w.at("c1b")},
+                             {"c2w", &w.at("c2w")}, {"c2b", &w.at("c2b")},
+                             {"d1w", &w.at("d1w")}, {"d1b", &w.at("d1b")},
+                             {"d2w", &w.at("d2w")}, {"d2b", &w.at("d2b")},
+                             {"d3w", &w.at("d3w")}, {"d3b", &w.at("d3b")}});
+    auto logits = ex.forward();
+    auto expect = iov.at("y").to_vector();
+    if (logits.size() != expect.size()) {
+      std::fprintf(stderr, "logit count %zu != expected %zu\n",
+                   logits.size(), expect.size());
+      return 1;
+    }
+    float max_err = 0.0f;
+    for (size_t i = 0; i < expect.size(); ++i)
+      max_err = std::max(max_err, std::fabs(logits[i] - expect[i]));
+    if (max_err > 1e-3f) {
+      std::fprintf(stderr, "logit mismatch: max_err=%g\n", max_err);
+      return 1;
+    }
+    std::printf("lenet inference parity vs python: max_err=%g\n", max_err);
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "unexpected: %s\n", e.what());
+    return 1;
+  }
+  std::printf("mxtpu_infer_client: all checks passed\n");
+  return 0;
+}

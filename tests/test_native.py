@@ -324,6 +324,51 @@ def test_c_train_client_binary(tmp_path):
     assert "autograd tape ok" in r.stdout
 
 
+def test_cpp_lenet_inference_from_python_weights(tmp_path):
+    """Train-in-Python / serve-from-C++ (reference: cpp-package inference
+    examples): the zoo LeNet's weights, saved as .params by the Python tier,
+    drive a pure-C++ native forward (Convolution/Pooling/Flatten/
+    FullyConnected host kernels) that must reproduce the XLA logits."""
+    import subprocess
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    from mxnet_tpu.serialization import save_ndarrays
+
+    mx.random.seed(0)
+    net = get_model("lenet", classes=10)
+    net.initialize()
+    rs = np.random.RandomState(0)
+    x = nd.array(rs.rand(2, 1, 28, 28).astype(np.float32))
+    y = net(x)
+
+    plist = [p for _, p in net.collect_params().items()]
+    names = ["c1w", "c1b", "c2w", "c2b", "d1w", "d1b", "d2w", "d2b",
+             "d3w", "d3b"]
+    assert len(plist) == len(names), [p.name for p in plist]
+    wfile = str(tmp_path / "weights.params")
+    save_ndarrays(wfile, {n: p.data().asnumpy()
+                          for n, p in zip(names, plist)})
+    iofile = str(tmp_path / "io.params")
+    save_ndarrays(iofile, {"x": x.asnumpy(), "y": y.asnumpy()})
+
+    src = os.path.join(os.path.dirname(__file__), "cclient",
+                       "mxtpu_infer_client.cc")
+    exe = str(tmp_path / "mxtpu_infer_client")
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    lib_dir = os.path.dirname(native._lib_path())
+    subprocess.run([cxx, "-O2", "-std=c++17", "-o", exe, src,
+                    "-L" + lib_dir, "-lmxtpu", "-Wl,-rpath," + lib_dir],
+                   check=True, capture_output=True)
+    r = subprocess.run([exe, wfile, iofile], capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, f"stdout={r.stdout} stderr={r.stderr}"
+    assert "all checks passed" in r.stdout
+
+
 def test_c_abi_native_float64():
     """Round-4 verdict ask #4: a second dtype in the native tier. f64 in ->
     f64 out, double-precision results (no silent f32 round-trip)."""
@@ -349,8 +394,54 @@ def test_c_abi_mixed_dtype_errors():
     with pytest.raises(RuntimeError, match="mixed"):
         native.imperative_invoke("add", [np.zeros((2, 2), np.float32),
                                          np.zeros((2, 2), np.float64)])
-    with pytest.raises(RuntimeError, match="float32/float64"):
-        native.imperative_invoke("relu", [np.zeros((2, 2), np.int32)])
+
+
+def test_c_abi_envelope_miss_falls_back_to_bridge():
+    """A config outside the native kernel's envelope must reach the jax
+    bridge instead of hard-failing — registering a native op never shrinks
+    the ABI surface (round-5 review finding)."""
+    _skip_without_lib()
+    # dtype outside {f32,f64}: int32 relu now served by the bridge
+    out = native.imperative_invoke("relu", [np.array([-1, 2], np.int32)])
+    np.testing.assert_array_equal(np.asarray(out), [0, 2])
+    # broadcasting add (native requires equal shapes; bridge broadcasts)
+    out = native.imperative_invoke("add", [np.ones((2, 3), np.float32),
+                                           np.ones((3,), np.float32)])
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    # dilated conv: native tier declines, bridge computes
+    x = np.random.RandomState(0).rand(1, 1, 6, 6).astype(np.float32)
+    w = np.random.RandomState(1).rand(1, 1, 2, 2).astype(np.float32)
+    out = native.imperative_invoke(
+        "Convolution", [x, w], {"kernel": [2, 2], "num_filter": 1,
+                                "dilate": [2, 2], "no_bias": True})
+    assert np.asarray(out).shape == (1, 1, 4, 4)
+
+
+def test_c_abi_nn_guards_error_not_crash():
+    _skip_without_lib()
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    w = np.zeros((1, 1, 2, 2), np.float32)
+    with pytest.raises(RuntimeError, match="stride must be positive"):
+        native.imperative_invoke("Convolution", [x, w],
+                                 {"kernel": [2, 2], "num_filter": 1,
+                                  "stride": [0, 2], "no_bias": True})
+    with pytest.raises(RuntimeError, match="pad must be smaller"):
+        native.imperative_invoke("Pooling", [x],
+                                 {"kernel": [2, 2], "pad": [2, 2]})
+
+
+def test_c_abi_avg_pool_matches_python_tier():
+    """count_include_pad=True default: padded avg windows divide by kernel
+    area, exactly like the Python/XLA tier (round-5 review finding)."""
+    _skip_without_lib()
+    import mxnet_tpu as mx
+
+    x = np.random.RandomState(3).rand(1, 2, 4, 4).astype(np.float32)
+    params = {"kernel": [2, 2], "stride": [2, 2], "pad": [1, 1],
+              "pool_type": "avg"}
+    got = np.asarray(native.imperative_invoke("Pooling", [x], params))
+    ref = mx.nd.Pooling(mx.nd.array(x), **params).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
 
 
 def test_c_abi_params_interop_with_python_tier(tmp_path):
