@@ -119,10 +119,11 @@ def harvest(round_no, dryrun=False):
     mb_path = os.path.join(REPO, f"MODELBENCH{tag}.json")
     mb_cmd = [sys.executable, "tools/modelbench.py", "--json", mb_path]
     if dryrun:
-        # gpt2_tiny: the dryrun validates the code path, not the timing —
-        # a 345M-param CPU step would burn an hour of single-core time
+        # gpt2_tiny + small resnet batch: the dryrun validates the code
+        # path, not the timing — a 345M-param or batch-128 CPU step would
+        # burn an hour of single-core time
         mb_cmd += ["--platform", "cpu", "--steps", "2",
-                   "--models", "resnet50,gpt2_tiny"]
+                   "--models", "resnet50,gpt2_tiny", "--resnet-batch", "4"]
     rc, out, err = _run(mb_cmd, timeout=2400)
     summary["modelbench"] = {"rc": rc,
                              "rows": _json_lines(out) if rc == 0 else err}
@@ -133,7 +134,8 @@ def harvest(round_no, dryrun=False):
     if dryrun:
         kb_cmd += ["--reps", "2", "--fwd-only"]
     rc, out, err = _run(kb_cmd, timeout=3600,
-                        env={"JAX_PLATFORMS": "cpu"} if dryrun else None)
+                        env={"JAX_PLATFORMS": "cpu",
+                             "KERNELBENCH_TINY": "1"} if dryrun else None)
     rows = [ln for ln in out.splitlines() if ln.startswith("{")]
     with open(kb_path, "w") as f:
         f.write("\n".join(rows) + ("\n" if rows else ""))

@@ -37,6 +37,14 @@ LN_CASES = [(8192, 1024), (32768, 1024), (8192, 4096)]
 # the Python-level layout immaterial on TPU. (B, C, H, W, O, k)
 CONV_CASES = [(32, 512, 28, 28, 512, 3), (64, 3, 224, 224, 64, 7)]
 
+if os.environ.get("KERNELBENCH_TINY") == "1":
+    # benchall --dryrun-cpu: same code paths, CPU-survivable shapes (the
+    # flash kernels run in interpret mode off-TPU, where seq 8192 would
+    # take hours on one core)
+    ATTN_CASES = [(1, 2, 256, 64)]
+    LN_CASES = [(512, 256)]
+    CONV_CASES = [(2, 8, 14, 14, 8, 3)]
+
 
 def _chain(fn, args, reps):
     import jax

@@ -60,7 +60,7 @@ def _is_oom(e):
     return ("RESOURCE_EXHAUSTED" in s or "ResourceExhausted" in s
             or "Out of memory" in s or "out of memory" in s)
 
-def bench_resnet50(steps, kind):
+def bench_resnet50(steps, kind, batch=128):
     import numpy as np
 
     import mxnet_tpu as mx
@@ -68,8 +68,7 @@ def bench_resnet50(steps, kind):
     from mxnet_tpu.gluon.model_zoo.vision import get_model
     from mxnet_tpu.parallel import TrainStep
 
-    batch = 128
-    while batch >= 16:
+    while batch >= 2:
         try:
             mx.random.seed(0)
             net = get_model("resnet50_v1", classes=1000)
@@ -162,6 +161,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--models", default="resnet50,gpt2_345m")
     ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--resnet-batch", type=int, default=128,
+                    help="starting batch for resnet50 (dryruns shrink it)")
     ap.add_argument("--json", default=None)
     ap.add_argument("--probe-timeout", type=int, default=90)
     ap.add_argument("--platform", default=None,
@@ -195,7 +196,7 @@ def main():
     for m in args.models.split(","):
         m = m.strip()
         if m == "resnet50":
-            r = bench_resnet50(args.steps, kind)
+            r = bench_resnet50(args.steps, kind, batch=args.resnet_batch)
         elif m.startswith("gpt2"):
             r = bench_gpt2(args.steps, kind, name=m)
         else:
