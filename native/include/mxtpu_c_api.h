@@ -139,6 +139,19 @@ int MXTPUNDArraySave(const char* fname, int n, MXTPUNDHandle* arrays,
 int MXTPUNDArrayLoad(const char* fname, int* out_n, MXTPUNDHandle** out_arrays,
                      int* out_n_names, const char*** out_names);
 
+/* ---- exported-graph loading (reference: MXSymbolCreateFromFile +
+ * MXSymbolListArguments — the SymbolBlock.imports deploy path). Loads a
+ * HybridBlock.export()-written <prefix>-symbol.json into a composed symbol
+ * graph. The graph OWNS every node symbol (and the returned head/argument
+ * pointers); free with MXTPUGraphFree after any executor bound to it. ---- */
+typedef void* MXTPUGraphHandle;
+int MXTPUGraphLoadJSON(const char* path, MXTPUGraphHandle* out);
+/* head output symbol (borrowed from the graph) */
+int MXTPUGraphGetSymbol(MXTPUGraphHandle g, MXTPUSymHandle* head);
+/* argument (variable) names in graph order (borrowed, graph-owned) */
+int MXTPUGraphListArguments(MXTPUGraphHandle g, int* n, const char*** names);
+int MXTPUGraphFree(MXTPUGraphHandle g);
+
 #ifdef __cplusplus
 }
 #endif

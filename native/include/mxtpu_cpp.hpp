@@ -236,11 +236,51 @@ class Symbol {
   MXTPUSymHandle h_ = nullptr;
 };
 
+// Exported-graph loading (reference: SymbolBlock.imports deploy path).
+// Owns every node symbol; keep it alive for the life of any bound executor.
+class Graph {
+ public:
+  static Graph Load(const std::string& json_path) {
+    MXTPUGraphHandle h = nullptr;
+    check(MXTPUGraphLoadJSON(json_path.c_str(), &h), "GraphLoadJSON");
+    return Graph(h);
+  }
+
+  explicit Graph(MXTPUGraphHandle h) : h_(h) {}
+  Graph(Graph&& o) noexcept : h_(o.h_) { o.h_ = nullptr; }
+  Graph(const Graph&) = delete;
+  Graph& operator=(const Graph&) = delete;
+  ~Graph() {
+    if (h_) MXTPUGraphFree(h_);
+  }
+
+  MXTPUSymHandle symbol() const {
+    MXTPUSymHandle s = nullptr;
+    check(MXTPUGraphGetSymbol(h_, &s), "GraphGetSymbol");
+    return s;
+  }
+
+  std::vector<std::string> arguments() const {
+    int n = 0;
+    const char** names = nullptr;
+    check(MXTPUGraphListArguments(h_, &n, &names), "GraphListArguments");
+    return std::vector<std::string>(names, names + n);
+  }
+
+ private:
+  MXTPUGraphHandle h_ = nullptr;
+};
+
 class Executor {
  public:
   // args pair variable names with client-owned NDArrays (which must outlive
   // the executor; content updates are seen by the next Forward)
   Executor(const Symbol& sym,
+           const std::vector<std::pair<std::string, const NDArray*>>& args)
+      : Executor(sym.handle(), args) {}
+
+  // raw-handle overload: bind a Graph::symbol() head (graph stays owner)
+  Executor(MXTPUSymHandle sym,
            const std::vector<std::pair<std::string, const NDArray*>>& args) {
     std::vector<const char*> names;
     std::vector<MXTPUNDHandle> arrs;
@@ -248,7 +288,7 @@ class Executor {
       names.push_back(kv.first.c_str());
       arrs.push_back(kv.second->handle());
     }
-    check(MXTPUExecutorBind(sym.handle(), names.data(), arrs.data(),
+    check(MXTPUExecutorBind(sym, names.data(), arrs.data(),
                             static_cast<int>(arrs.size()), &h_),
           "ExecutorBind");
   }

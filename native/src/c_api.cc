@@ -117,7 +117,10 @@ bool parse_params(const char* json, Params* out, std::string* err) {
     if (*p == '"') {
       ++p;
       std::string val;
-      while (*p && *p != '"') val += *p++;
+      while (*p && *p != '"') {
+        if (*p == '\\' && p[1]) ++p;  // \" and \\ from re-serialized attrs
+        val += *p++;
+      }
       if (*p != '"') { *err = "param_json: unterminated string"; return false; }
       ++p;
       out->strs[key] = val;
@@ -627,6 +630,13 @@ int op_fully_connected(std::vector<NDArrayRec*>& ins, const Params& ps,
   });
 }
 
+// single source of truth for activation math — referenced by both the
+// bare unary entries (relu/tanh/sigmoid) and the Activation op
+template <typename T> T act_relu(T a) { return a > 0 ? a : T(0); }
+template <typename T> T act_tanh(T a) { return std::tanh(a); }
+template <typename T> T act_sigmoid(T a) { return T(1) / (T(1) + std::exp(-a)); }
+template <typename T> T act_softsign(T a) { return a / (T(1) + std::fabs(a)); }
+
 const std::map<std::string, NativeOp>& native_registry() {
   static const std::map<std::string, NativeOp> reg = {
       {"dot", op_dot},
@@ -645,7 +655,7 @@ const std::map<std::string, NativeOp>& native_registry() {
       {"divide", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
          return binary_ew(i, o, "divide", [](auto a, decltype(a) b) { return a / b; }); }},
       {"relu", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
-         return unary_ew(i, o, "relu", [](auto a) { return a > 0 ? a : decltype(a)(0); }); }},
+         return unary_ew(i, o, "relu", [](auto a) { return act_relu(a); }); }},
       {"exp", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
          return unary_ew(i, o, "exp", [](auto a) { return std::exp(a); }); }},
       {"log", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
@@ -653,14 +663,28 @@ const std::map<std::string, NativeOp>& native_registry() {
       {"negative", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
          return unary_ew(i, o, "negative", [](auto a) { return -a; }); }},
       {"tanh", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
-         return unary_ew(i, o, "tanh", [](auto a) { return std::tanh(a); }); }},
+         return unary_ew(i, o, "tanh", [](auto a) { return act_tanh(a); }); }},
       {"sigmoid", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
-         return unary_ew(i, o, "sigmoid", [](auto a) { return decltype(a)(1) / (decltype(a)(1) + std::exp(-a)); }); }},
+         return unary_ew(i, o, "sigmoid", [](auto a) { return act_sigmoid(a); }); }},
       {"Convolution", op_convolution},
       {"Pooling", op_pooling},
       {"Flatten", op_flatten},
       {"flatten", op_flatten},
       {"FullyConnected", op_fully_connected},
+      {"Activation", [](std::vector<NDArrayRec*>& i, const Params& p, std::vector<NDArrayRec*>* o) {
+         // reference Activation op: dispatch on act_type (exported graphs
+         // route activations through this, not the bare unary names)
+         std::string t = p.str("act_type", "relu");
+         if (t == "relu")
+           return unary_ew(i, o, "Activation", [](auto a) { return act_relu(a); });
+         if (t == "tanh")
+           return unary_ew(i, o, "Activation", [](auto a) { return act_tanh(a); });
+         if (t == "sigmoid")
+           return unary_ew(i, o, "Activation", [](auto a) { return act_sigmoid(a); });
+         if (t == "softsign")
+           return unary_ew(i, o, "Activation", [](auto a) { return act_softsign(a); });
+         g_last_error = "Activation: act_type '" + t + "' not in the native tier";
+         return kTryBridge; }},
   };
   return reg;
 }

@@ -1,0 +1,337 @@
+// Exported-graph loading: <prefix>-symbol.json -> composed symbol graph.
+//
+// Reference analog: MXSymbolCreateFromFile (src/c_api/c_api_symbolic.cc over
+// nnvm LoadJSON) + MXSymbolListArguments — the deploy path SymbolBlock.
+// imports uses. Builds the graph purely through the public symbol ABI
+// (CreateVariable / CreateAtomicSymbol / Compose), so this TU needs no
+// access to the graph tier's internals.
+//
+// The exporter (gluon/block.py export -> symbol/__init__.py tojson) writes
+// each node's params twice: "attrs" (display strings, reference-style) and
+// "_raw_attrs" (true JSON types). This loader consumes "_raw_attrs" and
+// re-serializes it to the flat param JSON the invoke ABI takes.
+#include "../include/mxtpu_c_api.h"
+
+#include <cctype>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+// -- minimal recursive-descent JSON parser ----------------------------------
+
+struct JVal {
+  enum Kind { Null, Bool, Num, Str, Arr, Obj } kind = Null;
+  bool b = false;
+  double num = 0.0;
+  std::string str;
+  std::vector<JVal> arr;
+  std::map<std::string, JVal> obj;
+
+  const JVal* get(const std::string& k) const {
+    auto it = obj.find(k);
+    return it == obj.end() ? nullptr : &it->second;
+  }
+};
+
+struct JParser {
+  const char* p;
+  const char* end;
+  std::string err;
+
+  explicit JParser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void ws() { while (p < end && std::isspace(static_cast<unsigned char>(*p))) ++p; }
+
+  bool fail(const char* msg) { if (err.empty()) err = msg; return false; }
+
+  bool parse_string(std::string* out) {
+    if (*p != '"') return fail("expected string");
+    ++p;
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'r': out->push_back('\r'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'u':  // \uXXXX: keep ASCII, replace the rest with '?'
+            if (p + 4 < end) {
+              unsigned code = 0;
+              std::sscanf(p + 1, "%4x", &code);
+              out->push_back(code < 128 ? static_cast<char>(code) : '?');
+              p += 4;
+            }
+            break;
+          default: out->push_back(*p);
+        }
+      } else {
+        out->push_back(*p);
+      }
+      ++p;
+    }
+    if (p >= end) return fail("unterminated string");
+    ++p;
+    return true;
+  }
+
+  bool parse(JVal* out) {
+    ws();
+    if (p >= end) return fail("unexpected end of input");
+    if (*p == '{') {
+      ++p;
+      out->kind = JVal::Obj;
+      ws();
+      if (p < end && *p == '}') { ++p; return true; }
+      while (true) {
+        ws();
+        std::string key;
+        if (!parse_string(&key)) return false;
+        ws();
+        if (p >= end || *p != ':') return fail("expected ':'");
+        ++p;
+        if (!parse(&out->obj[key])) return false;
+        ws();
+        if (p < end && *p == ',') { ++p; continue; }
+        if (p < end && *p == '}') { ++p; return true; }
+        return fail("expected ',' or '}'");
+      }
+    }
+    if (*p == '[') {
+      ++p;
+      out->kind = JVal::Arr;
+      ws();
+      if (p < end && *p == ']') { ++p; return true; }
+      while (true) {
+        out->arr.emplace_back();
+        if (!parse(&out->arr.back())) return false;
+        ws();
+        if (p < end && *p == ',') { ++p; continue; }
+        if (p < end && *p == ']') { ++p; return true; }
+        return fail("expected ',' or ']'");
+      }
+    }
+    if (*p == '"') { out->kind = JVal::Str; return parse_string(&out->str); }
+    if (std::strncmp(p, "true", 4) == 0 && end - p >= 4) {
+      out->kind = JVal::Bool; out->b = true; p += 4; return true;
+    }
+    if (std::strncmp(p, "false", 5) == 0 && end - p >= 5) {
+      out->kind = JVal::Bool; out->b = false; p += 5; return true;
+    }
+    if (std::strncmp(p, "null", 4) == 0 && end - p >= 4) {
+      out->kind = JVal::Null; p += 4; return true;
+    }
+    char* num_end = nullptr;
+    double v = std::strtod(p, &num_end);
+    if (num_end == p) return fail("bad value");
+    out->kind = JVal::Num; out->num = v; p = num_end;
+    return true;
+  }
+};
+
+// _raw_attrs JVal -> flat param JSON for MXTPUImperativeInvoke
+std::string attrs_to_param_json(const JVal& attrs) {
+  std::ostringstream os;
+  os << "{";
+  bool first = true;
+  for (auto& kv : attrs.obj) {
+    const JVal& v = kv.second;
+    std::string piece;
+    char buf[64];
+    switch (v.kind) {
+      case JVal::Num:
+        std::snprintf(buf, sizeof(buf), "%.17g", v.num);
+        piece = buf;
+        break;
+      case JVal::Bool:
+        piece = v.b ? "true" : "false";
+        break;
+      case JVal::Str: {
+        piece = "\"";
+        for (char c : v.str) {  // re-escape: embedded quotes/backslashes
+          if (c == '"' || c == '\\') piece.push_back('\\');
+          piece.push_back(c);
+        }
+        piece.push_back('"');
+        break;
+      }
+      case JVal::Arr: {
+        std::ostringstream as;
+        as << "[";
+        for (size_t i = 0; i < v.arr.size(); ++i) {
+          if (v.arr[i].kind != JVal::Num) { piece.clear(); break; }
+          if (i) as << ", ";
+          std::snprintf(buf, sizeof(buf), "%.17g", v.arr[i].num);
+          as << buf;
+        }
+        as << "]";
+        piece = as.str();
+        break;
+      }
+      default:
+        continue;  // null / nested obj attrs are not op params
+    }
+    if (piece.empty()) continue;
+    if (!first) os << ", ";
+    os << "\"" << kv.first << "\": " << piece;
+    first = false;
+  }
+  os << "}";
+  return os.str();
+}
+
+struct GraphRec {
+  std::vector<MXTPUSymHandle> nodes;  // owned, every node incl. variables
+  MXTPUSymHandle head = nullptr;      // borrowed (one of nodes)
+  std::vector<std::string> arg_names;
+  std::vector<const char*> arg_ptrs;
+
+  ~GraphRec() {
+    for (auto h : nodes)
+      if (h) MXTPUSymbolFree(h);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+int MXTPUGraphLoadJSON(const char* path, MXTPUGraphHandle* out) {
+  if (path == nullptr || out == nullptr) {
+    MXTPUSetLastError("GraphLoadJSON: null arg");
+    return -1;
+  }
+  std::ifstream f(path);
+  if (!f) {
+    MXTPUSetLastError("GraphLoadJSON: cannot open file");
+    return -1;
+  }
+  std::stringstream ss;
+  ss << f.rdbuf();
+  std::string text = ss.str();
+  JParser jp(text);
+  JVal root;
+  if (!jp.parse(&root) || root.kind != JVal::Obj) {
+    MXTPUSetLastError(("GraphLoadJSON: " +
+                       (jp.err.empty() ? "not a JSON object" : jp.err))
+                          .c_str());
+    return -1;
+  }
+  const JVal* nodes = root.get("nodes");
+  const JVal* heads = root.get("heads");
+  if (nodes == nullptr || nodes->kind != JVal::Arr || nodes->arr.empty()) {
+    MXTPUSetLastError("GraphLoadJSON: missing nodes array");
+    return -1;
+  }
+  auto* g = new GraphRec();
+  auto fail = [&](const std::string& msg) {
+    delete g;
+    MXTPUSetLastError(("GraphLoadJSON: " + msg).c_str());
+    return -1;
+  };
+  for (const JVal& n : nodes->arr) {
+    const JVal* op = n.get("op");
+    const JVal* name = n.get("name");
+    if (op == nullptr || op->kind != JVal::Str || name == nullptr ||
+        name->kind != JVal::Str)
+      return fail("node missing op/name");
+    MXTPUSymHandle h = nullptr;
+    if (op->str == "null") {
+      if (MXTPUSymbolCreateVariable(name->str.c_str(), &h) != 0) {
+        delete g;
+        return -1;
+      }
+      g->arg_names.push_back(name->str);
+    } else {
+      const JVal* raw = n.get("_raw_attrs");
+      std::string pj = raw && raw->kind == JVal::Obj ? attrs_to_param_json(*raw)
+                                                     : "{}";
+      if (MXTPUSymbolCreateAtomicSymbol(op->str.c_str(), pj.c_str(),
+                                        name->str.c_str(), &h) != 0) {
+        delete g;
+        return -1;
+      }
+      const JVal* ins = n.get("inputs");
+      std::vector<MXTPUSymHandle> in_handles;
+      if (ins != nullptr && ins->kind == JVal::Arr) {
+        for (const JVal& e : ins->arr) {
+          // entry [node_id, out_index, version]
+          if (e.kind != JVal::Arr || e.arr.empty() ||
+              e.arr[0].kind != JVal::Num)
+            { MXTPUSymbolFree(h); return fail("bad input entry"); }
+          // the native symbol ABI has no output selection — a graph that
+          // consumes a secondary output must be rejected, not rebuilt
+          // silently wrong
+          if (e.arr.size() >= 2 && e.arr[1].kind == JVal::Num &&
+              e.arr[1].num != 0)
+            { MXTPUSymbolFree(h);
+              return fail("input consumes a non-first output (multi-output "
+                          "nodes are not representable in the native "
+                          "symbol tier)"); }
+          size_t idx = static_cast<size_t>(e.arr[0].num);
+          if (idx >= g->nodes.size())
+            { MXTPUSymbolFree(h); return fail("input references later node"); }
+          in_handles.push_back(g->nodes[idx]);
+        }
+      }
+      if (MXTPUSymbolCompose(h, in_handles.data(),
+                             static_cast<int>(in_handles.size())) != 0) {
+        MXTPUSymbolFree(h);
+        delete g;
+        return -1;
+      }
+    }
+    g->nodes.push_back(h);
+  }
+  size_t head_idx = g->nodes.size() - 1;
+  if (heads != nullptr && heads->kind == JVal::Arr && !heads->arr.empty()) {
+    const JVal& h0 = heads->arr[0];
+    if (h0.kind == JVal::Arr && !h0.arr.empty() &&
+        h0.arr[0].kind == JVal::Num) {
+      head_idx = static_cast<size_t>(h0.arr[0].num);
+      if (h0.arr.size() >= 2 && h0.arr[1].kind == JVal::Num &&
+          h0.arr[1].num != 0)
+        return fail("head selects a non-first output (not representable)");
+    }
+    if (head_idx >= g->nodes.size())
+      return fail("head index out of range");
+  }
+  g->head = g->nodes[head_idx];
+  for (auto& s : g->arg_names) g->arg_ptrs.push_back(s.c_str());
+  *out = g;
+  return 0;
+}
+
+int MXTPUGraphGetSymbol(MXTPUGraphHandle gh, MXTPUSymHandle* head) {
+  if (gh == nullptr || head == nullptr) {
+    MXTPUSetLastError("GraphGetSymbol: null arg");
+    return -1;
+  }
+  *head = static_cast<GraphRec*>(gh)->head;
+  return 0;
+}
+
+int MXTPUGraphListArguments(MXTPUGraphHandle gh, int* n, const char*** names) {
+  if (gh == nullptr || n == nullptr) {
+    MXTPUSetLastError("GraphListArguments: null arg");
+    return -1;
+  }
+  auto* g = static_cast<GraphRec*>(gh);
+  *n = static_cast<int>(g->arg_ptrs.size());
+  if (names) *names = g->arg_ptrs.data();
+  return 0;
+}
+
+int MXTPUGraphFree(MXTPUGraphHandle gh) {
+  delete static_cast<GraphRec*>(gh);
+  return 0;
+}
+
+}  // extern "C"

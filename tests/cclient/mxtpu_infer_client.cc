@@ -2,11 +2,16 @@
 // cpp-package/example/inference — load a Python-trained checkpoint and run
 // a conv net natively, no Python anywhere in the process).
 //
-// Usage: mxtpu_infer_client <weights.params> <io.params>
-//   weights.params: c1w c1b c2w c2b d1w d1b d2w d2b d3w d3b (LeNet-5)
-//   io.params:      x (N,1,28,28 input), y (N,10 expected logits from the
-//                   Python/XLA forward of the SAME weights)
-// Exit 0 iff the native C++ forward reproduces the Python logits to 1e-3.
+// Usage (hand-built graph):
+//   mxtpu_infer_client <weights.params> <io.params>
+//     weights.params: c1w c1b c2w c2b d1w d1b d2w d2b d3w d3b (LeNet-5)
+// Usage (exported graph — the SymbolBlock.imports deploy path):
+//   mxtpu_infer_client --graph <prefix-symbol.json> <prefix-0000.params>
+//                      <io.params>
+//     the symbol JSON + arg:-prefixed weights come straight from
+//     HybridBlock.export(); the graph is rebuilt by MXTPUGraphLoadJSON.
+// io.params: x (input), y (expected logits from the Python/XLA forward of
+// the SAME weights). Exit 0 iff the native forward matches y to 1e-3.
 #include <cmath>
 #include <cstdio>
 #include <map>
@@ -15,7 +20,69 @@
 
 #include "../../native/include/mxtpu_cpp.hpp"
 
+static int run_graph_mode(const char* json_path, const char* params_path,
+                          const char* io_path) {
+  auto graph = mxtpu::Graph::Load(json_path);
+  std::map<std::string, mxtpu::NDArray> w;
+  for (auto& kv : mxtpu::load_params(params_path)) {
+    std::string name = kv.first;
+    if (name.rfind("arg:", 0) == 0 || name.rfind("aux:", 0) == 0)
+      name = name.substr(4);  // export() writes reference-style prefixes
+    w[name] = std::move(kv.second);
+  }
+  std::map<std::string, mxtpu::NDArray> iov;
+  for (auto& kv : mxtpu::load_params(io_path)) iov[kv.first] = std::move(kv.second);
+  if (!iov.count("x") || !iov.count("y")) {
+    std::fprintf(stderr, "io.params must carry x and y\n");
+    return 1;
+  }
+  std::vector<std::pair<std::string, const mxtpu::NDArray*>> binds;
+  for (const auto& arg : graph.arguments()) {
+    if (w.count(arg)) {
+      binds.emplace_back(arg, &w.at(arg));
+    } else if (arg == "data" || arg == "x") {
+      binds.emplace_back(arg, &iov.at("x"));
+    } else {
+      std::fprintf(stderr, "no value for graph argument '%s'\n", arg.c_str());
+      return 1;
+    }
+  }
+  mxtpu::Executor ex(graph.symbol(), binds);
+  auto logits = ex.forward();
+  auto expect = iov.at("y").to_vector();
+  if (logits.size() != expect.size()) {
+    std::fprintf(stderr, "logit count %zu != expected %zu\n", logits.size(),
+                 expect.size());
+    return 1;
+  }
+  float max_err = 0.0f;
+  for (size_t i = 0; i < expect.size(); ++i)
+    max_err = std::max(max_err, std::fabs(logits[i] - expect[i]));
+  if (max_err > 1e-3f) {
+    std::fprintf(stderr, "graph-mode logit mismatch: max_err=%g\n", max_err);
+    return 1;
+  }
+  std::printf("exported-graph inference parity vs python: max_err=%g\n",
+              max_err);
+  std::printf("mxtpu_infer_client: all checks passed\n");
+  return 0;
+}
+
 int main(int argc, char** argv) {
+  if (argc >= 2 && std::string(argv[1]) == "--graph") {
+    if (argc < 5) {
+      std::fprintf(stderr,
+                   "usage: %s --graph symbol.json weights.params io.params\n",
+                   argv[0]);
+      return 2;
+    }
+    try {
+      return run_graph_mode(argv[2], argv[3], argv[4]);
+    } catch (const std::exception& e) {
+      std::fprintf(stderr, "unexpected: %s\n", e.what());
+      return 1;
+    }
+  }
   if (argc < 3) {
     std::fprintf(stderr, "usage: %s weights.params io.params\n", argv[0]);
     return 2;

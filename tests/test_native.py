@@ -369,6 +369,47 @@ def test_cpp_lenet_inference_from_python_weights(tmp_path):
     assert "all checks passed" in r.stdout
 
 
+def test_cpp_exported_graph_inference(tmp_path):
+    """The full deploy loop (reference: HybridBlock.export ->
+    SymbolBlock.imports, served by cpp-package): export() writes
+    symbol.json + arg:-prefixed .params; a pure-C++ process rebuilds the
+    graph with MXTPUGraphLoadJSON, binds the exported weights, and
+    reproduces the XLA logits."""
+    import subprocess
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    from mxnet_tpu.serialization import save_ndarrays
+
+    mx.random.seed(0)
+    net = get_model("lenet", classes=10)
+    net.initialize()
+    net.hybridize()
+    rs = np.random.RandomState(1)
+    x = nd.array(rs.rand(2, 1, 28, 28).astype(np.float32))
+    y = net(x)
+    sym_file, params_file = net.export(str(tmp_path / "lenet"))
+
+    iofile = str(tmp_path / "io.params")
+    save_ndarrays(iofile, {"x": x.asnumpy(), "y": y.asnumpy()})
+
+    src = os.path.join(os.path.dirname(__file__), "cclient",
+                       "mxtpu_infer_client.cc")
+    exe = str(tmp_path / "mxtpu_infer_client")
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    lib_dir = os.path.dirname(native._lib_path())
+    subprocess.run([cxx, "-O2", "-std=c++17", "-o", exe, src,
+                    "-L" + lib_dir, "-lmxtpu", "-Wl,-rpath," + lib_dir],
+                   check=True, capture_output=True)
+    r = subprocess.run([exe, "--graph", sym_file, params_file, iofile],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, f"stdout={r.stdout} stderr={r.stderr}"
+    assert "all checks passed" in r.stdout
+
+
 def test_c_abi_native_float64():
     """Round-4 verdict ask #4: a second dtype in the native tier. f64 in ->
     f64 out, double-precision results (no silent f32 round-trip)."""

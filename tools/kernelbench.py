@@ -98,7 +98,7 @@ def run_attn_case(b, h, seq, d, causal, reps, fwd_only):
               else fa._chunked_attention)
     case["oracle"] = oracle.__name__
     ref = oracle(q, k, v, causal)
-    out = fa.flash_attention(q, k, v, causal=causal, interpret=False)
+    out = fa.flash_attention(q, k, v, causal=causal, interpret=_INTERP)
     err = float(jnp.max(jnp.abs(
         out.astype(jnp.float32) - ref.astype(jnp.float32))))
     case["max_err"] = round(err, 5)
@@ -106,7 +106,7 @@ def run_attn_case(b, h, seq, d, causal, reps, fwd_only):
     del ref, out
 
     def flash_f(q):
-        return fa.flash_attention(q, k, v, causal=causal, interpret=False)
+        return fa.flash_attention(q, k, v, causal=causal, interpret=_INTERP)
 
     def einsum_f(q):
         return fa._ref_attention(q, k, v, causal)
@@ -154,7 +154,7 @@ def run_ln_case(n, d, reps):
         return (y * g.astype(jnp.float32) + b.astype(jnp.float32)
                 ).astype(x.dtype)
 
-    out = pln.layer_norm_fused(x, g, b, interpret=False)
+    out = pln.layer_norm_fused(x, g, b, interpret=_INTERP)
     ref = composed(x)
     err = float(jnp.max(jnp.abs(
         out.astype(jnp.float32) - ref.astype(jnp.float32))))
@@ -163,7 +163,7 @@ def run_ln_case(n, d, reps):
     del out, ref
 
     def fused(x):
-        return pln.layer_norm_fused(x, g, b, interpret=False)
+        return pln.layer_norm_fused(x, g, b, interpret=_INTERP)
 
     for label, f in (("fused", fused), ("xla", composed)):
         try:
@@ -208,6 +208,10 @@ def run_conv_case(b, c, h, w, o, k, reps):
     case["nhwc_ms"] = round(_timeit(conv_nhwc, (x_nhwc,), reps) * 1e3, 3)
     case["nchw_vs_nhwc"] = round(case["nchw_ms"] / case["nhwc_ms"], 3)
     return case
+
+
+_INTERP = os.environ.get("KERNELBENCH_TINY") == "1"  # CPU dryrun: pallas
+# kernels only run in interpret mode off-TPU
 
 
 def run_one(argv):
