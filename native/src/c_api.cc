@@ -592,7 +592,9 @@ int op_flatten(std::vector<NDArrayRec*>& ins, const Params&,
 
 int op_fully_connected(std::vector<NDArrayRec*>& ins, const Params& ps,
                        std::vector<NDArrayRec*>* outs) {
-  // y = x . w^T + b, weight stored (num_hidden, in) — the reference layout
+  // y = x . w^T + b, weight stored (num_hidden, in) — the reference layout.
+  // N-D data flattens to (N, prod(rest)) like the reference FC (flatten=True
+  // default), so global-pool outputs (N,C,1,1) feed straight in.
   if (ins.size() != 2 && ins.size() != 3) {
     g_last_error = "FullyConnected: expects (data, weight[, bias])";
     return -1;
@@ -602,13 +604,14 @@ int op_fully_connected(std::vector<NDArrayRec*>& ins, const Params& ps,
   NDArrayRec *x = ins[0], *w = ins[1];
   NDArrayRec* b = ins.size() == 3 && !ps.flag("no_bias", false) ? ins[2]
                                                                 : nullptr;
-  if (x->shape.size() != 2 || w->shape.size() != 2 ||
-      x->shape[1] != w->shape[1]) {
-    g_last_error = "FullyConnected: native tier handles 2-D data with "
-                   "matching in-features";
+  int64_t flat_in = 1;
+  for (size_t i = 1; i < x->shape.size(); ++i) flat_in *= x->shape[i];
+  if (x->shape.empty() || w->shape.size() != 2 || flat_in != w->shape[1]) {
+    g_last_error = "FullyConnected: native tier needs in-features matching "
+                   "the weight's second dim";
     return kTryBridge;
   }
-  int64_t N = x->shape[0], In = x->shape[1], Out = w->shape[0];
+  int64_t N = x->shape[0], In = flat_in, Out = w->shape[0];
   NDArrayRec* o = make_out({N, Out}, dt);
   return dtype_dispatch(dt, [&](auto zero) {
     using T = decltype(zero);
@@ -625,6 +628,65 @@ int op_fully_connected(std::vector<NDArrayRec*>& ins, const Params& ps,
           acc += static_cast<double>(xr[k]) * wr[k];
         Y[n * Out + j] = static_cast<T>(acc);
       }
+    outs->push_back(o);
+    return 0;
+  });
+}
+
+int op_batch_norm(std::vector<NDArrayRec*>& ins, const Params& ps,
+                  std::vector<NDArrayRec*>* outs) {
+  // INFERENCE BatchNorm (reference batch_norm.cc use_global_stats path):
+  // y = gamma*(x - moving_mean)*rsqrt(moving_var + eps) + beta per channel.
+  // Training-mode BN (batch statistics + moving-average update) is the jax
+  // tier's job — exported graphs always carry training: false.
+  if (ins.size() != 5) {
+    g_last_error = "BatchNorm: expects (data, gamma, beta, mean, var)";
+    return -1;
+  }
+  if (ps.flag("training", false)) {
+    g_last_error = "BatchNorm: native tier is inference-only";
+    return kTryBridge;
+  }
+  int dt;
+  if (int rc = common_dtype(ins, "BatchNorm", &dt)) return rc;
+  NDArrayRec* x = ins[0];
+  int axis = static_cast<int>(ps.num("axis", 1));
+  if (axis != 1 || x->shape.size() < 2) {
+    g_last_error = "BatchNorm: native tier handles axis=1 only";
+    return kTryBridge;
+  }
+  int64_t C = x->shape[1];
+  for (int i = 1; i < 5; ++i) {
+    if (ins[i]->size() != C) {
+      g_last_error = "BatchNorm: stat shape mismatch";
+      return -1;
+    }
+  }
+  double eps = ps.num("eps", 1e-5);
+  int64_t N = x->shape[0];
+  int64_t inner = 1;
+  for (size_t i = 2; i < x->shape.size(); ++i) inner *= x->shape[i];
+  NDArrayRec* o = make_out(x->shape, dt);
+  bool fix_gamma = ps.flag("fix_gamma", false);
+  return dtype_dispatch(dt, [&](auto zero) {
+    using T = decltype(zero);
+    const T* X = tdata<T>(x);
+    const T* G = tdata<T>(ins[1]);
+    const T* B = tdata<T>(ins[2]);
+    const T* M = tdata<T>(ins[3]);
+    const T* V = tdata<T>(ins[4]);
+    T* Y = tdata<T>(o);
+    for (int64_t c = 0; c < C; ++c) {
+      double g = fix_gamma ? 1.0 : static_cast<double>(G[c]);
+      double scale = g / std::sqrt(static_cast<double>(V[c]) + eps);
+      double shift = static_cast<double>(B[c]) - scale * M[c];
+      for (int64_t n = 0; n < N; ++n) {
+        const T* xr = X + (n * C + c) * inner;
+        T* yr = Y + (n * C + c) * inner;
+        for (int64_t i = 0; i < inner; ++i)
+          yr[i] = static_cast<T>(scale * xr[i] + shift);
+      }
+    }
     outs->push_back(o);
     return 0;
   });
@@ -667,6 +729,7 @@ const std::map<std::string, NativeOp>& native_registry() {
       {"sigmoid", [](std::vector<NDArrayRec*>& i, const Params&, std::vector<NDArrayRec*>* o) {
          return unary_ew(i, o, "sigmoid", [](auto a) { return act_sigmoid(a); }); }},
       {"Convolution", op_convolution},
+      {"BatchNorm", op_batch_norm},
       {"Pooling", op_pooling},
       {"Flatten", op_flatten},
       {"flatten", op_flatten},

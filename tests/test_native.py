@@ -369,7 +369,13 @@ def test_cpp_lenet_inference_from_python_weights(tmp_path):
     assert "all checks passed" in r.stdout
 
 
-def test_cpp_exported_graph_inference(tmp_path):
+@pytest.mark.parametrize("model,in_shape", [
+    ("lenet", (2, 1, 28, 28)),
+    # resnet18: Convolution + BatchNorm(inference) + residual add + global
+    # avg pool + auto-flattening FC — the real zoo deploy shape
+    ("resnet18_v1", (1, 3, 32, 32)),
+])
+def test_cpp_exported_graph_inference(tmp_path, model, in_shape):
     """The full deploy loop (reference: HybridBlock.export ->
     SymbolBlock.imports, served by cpp-package): export() writes
     symbol.json + arg:-prefixed .params; a pure-C++ process rebuilds the
@@ -383,13 +389,13 @@ def test_cpp_exported_graph_inference(tmp_path):
     from mxnet_tpu.serialization import save_ndarrays
 
     mx.random.seed(0)
-    net = get_model("lenet", classes=10)
+    net = get_model(model, classes=10)
     net.initialize()
     net.hybridize()
     rs = np.random.RandomState(1)
-    x = nd.array(rs.rand(2, 1, 28, 28).astype(np.float32))
+    x = nd.array(rs.rand(*in_shape).astype(np.float32))
     y = net(x)
-    sym_file, params_file = net.export(str(tmp_path / "lenet"))
+    sym_file, params_file = net.export(str(tmp_path / model))
 
     iofile = str(tmp_path / "io.params")
     save_ndarrays(iofile, {"x": x.asnumpy(), "y": y.asnumpy()})
