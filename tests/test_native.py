@@ -477,6 +477,32 @@ def test_c_abi_nn_guards_error_not_crash():
                                  {"kernel": [2, 2], "pad": [2, 2]})
 
 
+def test_c_abi_batchnorm_inference_oracle():
+    """Native inference BatchNorm vs the closed-form oracle, including the
+    fix_gamma=True path (gamma forced to 1) and the training->bridge route."""
+    _skip_without_lib()
+    rs = np.random.RandomState(5)
+    x = rs.rand(2, 3, 4, 4).astype(np.float32)
+    gamma = rs.rand(3).astype(np.float32) + 0.5
+    beta = rs.rand(3).astype(np.float32)
+    mean = rs.rand(3).astype(np.float32)
+    var = rs.rand(3).astype(np.float32) + 0.1
+    got = np.asarray(native.imperative_invoke(
+        "BatchNorm", [x, gamma, beta, mean, var], {"eps": 1e-5}))
+    ref = (gamma[None, :, None, None]
+           * (x - mean[None, :, None, None])
+           / np.sqrt(var[None, :, None, None] + 1e-5)
+           + beta[None, :, None, None])
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    got_fg = np.asarray(native.imperative_invoke(
+        "BatchNorm", [x, gamma, beta, mean, var],
+        {"eps": 1e-5, "fix_gamma": True}))
+    ref_fg = ((x - mean[None, :, None, None])
+              / np.sqrt(var[None, :, None, None] + 1e-5)
+              + beta[None, :, None, None])
+    np.testing.assert_allclose(got_fg, ref_fg, rtol=1e-5, atol=1e-6)
+
+
 def test_c_abi_avg_pool_matches_python_tier():
     """count_include_pad=True default: padded avg windows divide by kernel
     area, exactly like the Python/XLA tier (round-5 review finding)."""
