@@ -1,0 +1,116 @@
+"""North-star structural check body (run in a FRESH interpreter).
+
+test_north_star_bert_large_dp_tp_fsdp_structure runs this in a subprocess:
+the 1.4 GB BERT-large device_put over 8 virtual devices grinds for 10+
+minutes when the jax runtime is already warm from ~100 earlier tests
+(allocator pressure), but takes ~2-4 min in a clean process. Same isolation
+pattern as __graft_entry__.dryrun_multichip.
+
+Prints one summary line starting with NORTHSTAR-OK on success; any assert
+failure exits nonzero with a traceback.
+"""
+import os
+import re
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, optimizer
+from mxnet_tpu.models import bert
+from mxnet_tpu.parallel import MeshConfig, TrainStep, make_mesh
+from mxnet_tpu.parallel.sharding import ShardingRules
+
+
+def main():
+    mesh = make_mesh(MeshConfig(dp=2, tp=2, fsdp=2))
+    mx.random.seed(0)
+    net = bert.get_bert("bert_large", pretrain_head=True, vocab_size=30522,
+                        max_length=128)
+    net.initialize()
+    B, T, M = 8, 128, 20
+    rs = np.random.RandomState(0)
+    ids = nd.array(rs.randint(0, 30522, (B, T)), dtype="int32")
+    types = nd.zeros((B, T), dtype="int32")
+    valid = nd.full((B,), T, dtype="int32")
+    pos = nd.array(rs.randint(0, T, (B, M)), dtype="int32")
+    labels = nd.array(rs.randint(0, 30522, (B, M)), dtype="int32")
+    weights = nd.ones((B, M))
+    nsp_labels = nd.array(rs.randint(0, 2, (B,)), dtype="int32")
+    _ = net(ids, types, valid, pos)
+
+    def loss_fn(out, labels, weights, nsp_labels):
+        mlm, nsp = out
+        return bert.pretrain_loss(mlm, nsp, labels, weights, nsp_labels)
+
+    rules = ShardingRules(
+        rules=[
+            (r"(qkv|query|key|value|ffn1|intermediate|fc1)\w*_weight$",
+             ("tp", None)),
+            (r"(proj|ffn2|output_dense|fc2)\w*_weight$", (None, "tp")),
+            (r"(qkv|query|key|value|ffn1|intermediate|fc1)\w*_bias$",
+             ("tp",)),
+            (r"word_embed\w*_weight$", ("tp", None)),
+        ],
+        fsdp_axis="fsdp", min_fsdp_size=1024)
+    ts = TrainStep(net, loss_fn, optimizer.Adam(learning_rate=1e-4),
+                   mesh=mesh, rules=rules, n_model_inputs=4)
+
+    # (c) ZeRO per-device storage arithmetic, from the REAL sharded arrays
+    total = sum(v.nbytes for v in ts.params.values())
+    per_dev = {}
+    for v in ts.params.values():
+        for sh in v.addressable_shards:
+            per_dev[sh.device.id] = per_dev.get(sh.device.id, 0) \
+                + sh.data.nbytes
+    assert len(per_dev) == 8
+    hi = max(per_dev.values())
+    lo = min(per_dev.values())
+    # every device stores ~half the params (fsdp=2; tp splits within the
+    # half), far below full replication; slack covers unsharded leftovers
+    # (layernorms, biases) and tp-vs-fsdp packing asymmetry
+    assert hi < 0.62 * total, (
+        f"per-device {hi / 2**20:.1f} MB vs total {total / 2**20:.1f} MB — "
+        "ZeRO storage split not engaged")
+    assert lo > 0.3 * total / 2, "suspiciously empty device"
+
+    # (a)+(b): compile for the mesh; collectives present, no remat fallback.
+    # SPMD warnings go to stderr; the parent test scans our stderr for the
+    # involuntary-remat marker, so nothing to capture here.
+    compiled = ts.lower_hlo(ids, types, valid, pos, labels, weights,
+                            nsp_labels).compile()
+    text = compiled.as_text()
+    n_ar = len(re.findall(r"all-reduce(?:-start)?\(", text))
+    n_ag = len(re.findall(r"all-gather(?:-start)?\(", text))
+    n_rs = len(re.findall(r"reduce-scatter\(", text))
+    assert n_ag >= 1, "no all-gather: fsdp params not gathered for compute"
+    assert n_ar + n_rs >= 2, (
+        f"grad/tp reduction collectives missing (ar={n_ar} rs={n_rs})")
+    # sanity ceiling: a per-HLO-op collective explosion (thousands) would
+    # signal broken sharding; measured baseline 308 (101 ar + 207 ag — the
+    # CPU backend runs no all-gather combiner)
+    assert n_ar + n_ag + n_rs < 800, (
+        f"{n_ar + n_ag + n_rs} collectives — sharding propagation broken")
+
+    # (d) donation survived partitioning
+    header = next((ln for ln in text.splitlines()
+                   if "input_output_alias" in ln), None)
+    assert header and (header.count("may-alias")
+                       + header.count("must-alias")) >= 100, \
+        "param/opt-state donation lost under dp x tp x fsdp"
+
+    print(f"NORTHSTAR-OK total_mb={total / 2**20:.1f} "
+          f"per_device_mb={hi / 2**20:.1f} ar={n_ar} ag={n_ag} rs={n_rs}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
