@@ -329,9 +329,10 @@ class KVStore {
     if (h_) MXTPUKVStoreFree(h_);
   }
 
-  void set_optimizer(double lr) {
+  void set_optimizer(double lr, double momentum = 0.0) {
     std::string js = "{\"optimizer\": \"sgd\", \"learning_rate\": " +
-                     std::to_string(lr) + "}";
+                     std::to_string(lr) + ", \"momentum\": " +
+                     std::to_string(momentum) + "}";
     check(MXTPUKVStoreSetOptimizer(h_, js.c_str()), "KVStoreSetOptimizer");
   }
   void init(int key, const NDArray& v) {

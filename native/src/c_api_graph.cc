@@ -403,11 +403,14 @@ int exec_eval(ExecRec* ex, SymRec* node, MXTPUNDHandle* out) {
 
 struct KVRec {
   std::map<int, MXTPUNDHandle> store;  // owned
+  std::map<int, MXTPUNDHandle> mom;    // owned momentum state (lazy-init)
   bool sgd = false;
   double lr = 0.01;
+  double momentum = 0.0;
 
   ~KVRec() {
     for (auto& kv : store) MXTPUNDArrayFree(kv.second);
+    for (auto& kv : mom) MXTPUNDArrayFree(kv.second);
   }
 };
 
@@ -664,11 +667,13 @@ int MXTPUKVStoreSetOptimizer(MXTPUKVHandle kv, const char* param_json) {
   auto* k = static_cast<KVRec*>(kv);
   std::string js = param_json ? param_json : "";
   if (js.find("sgd") == std::string::npos) {
-    MXTPUSetLastError("KVStoreSetOptimizer: native tier supports sgd only");
+    MXTPUSetLastError("KVStoreSetOptimizer: native tier supports sgd "
+                      "(optionally with momentum) only");
     return -1;
   }
   k->sgd = true;
   k->lr = param_num(js, "learning_rate", 0.01);
+  k->momentum = param_num(js, "momentum", 0.0);
   return 0;
 }
 
@@ -704,8 +709,27 @@ int MXTPUKVStorePush(MXTPUKVHandle kv, int key, MXTPUNDHandle grad) {
   // after allocator address reuse (same discipline as backward_from)
   bool was_recording = g_ag.recording;
   g_ag.recording = false;
-  MXTPUNDHandle next;
-  if (k->sgd) {  // w <- w - lr * grad
+  MXTPUNDHandle next = nullptr;
+  if (k->sgd && k->momentum > 0.0) {
+    // reference sgd_mom_update: m <- momentum*m - lr*grad; w <- w + m
+    char mbuf[64], lbuf[64];
+    std::snprintf(mbuf, sizeof(mbuf), "{\"scalar\": %.17g}", k->momentum);
+    std::snprintf(lbuf, sizeof(lbuf), "{\"scalar\": %.17g}", -k->lr);
+    bool had_m = k->mom.count(key) > 0;
+    MXTPUNDHandle m = had_m ? k->mom[key] : nd_full_like(it->second, 0.0);
+    MXTPUNDHandle m_scaled = m ? inv1("_mul_scalar", {m}, mbuf) : nullptr;
+    MXTPUNDHandle g_step = inv1("_mul_scalar", {grad}, lbuf);
+    MXTPUNDHandle new_m = (m_scaled && g_step)
+                              ? inv1("add", {m_scaled, g_step}) : nullptr;
+    if (m_scaled) MXTPUNDArrayFree(m_scaled);
+    if (g_step) MXTPUNDArrayFree(g_step);
+    if (m && !had_m) MXTPUNDArrayFree(m);  // fresh zero state: temp only
+    if (new_m != nullptr) {
+      next = inv1("add", {it->second, new_m});
+      if (had_m) MXTPUNDArrayFree(k->mom[key]);
+      k->mom[key] = new_m;  // state persists across pushes
+    }
+  } else if (k->sgd) {  // w <- w - lr * grad
     char buf[64];
     std::snprintf(buf, sizeof(buf), "{\"scalar\": %.17g}", -k->lr);
     MXTPUNDHandle step = inv1("_mul_scalar", {grad}, buf);

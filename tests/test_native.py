@@ -587,6 +587,48 @@ def test_c_abi_params_interop_with_python_tier(tmp_path):
     assert "ndarrayload" in L.MXTPUGetLastError().decode().lower()
 
 
+def test_c_abi_kvstore_momentum_updater():
+    """C kvstore update-on-push with momentum (reference sgd_mom_update on
+    the server Updater): two pushes must match the closed-form numpy math,
+    proving state persists across pushes."""
+    _skip_without_lib()
+    import ctypes
+
+    L = native.lib()
+    w0 = np.array([1.0, 2.0], np.float32)
+    g1 = np.array([0.5, 0.5], np.float32)
+    g2 = np.array([0.25, -0.5], np.float32)
+    lr, mom = 0.1, 0.9
+
+    kv = ctypes.c_void_p()
+    assert L.MXTPUKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    try:
+        js = (f'{{"optimizer": "sgd", "learning_rate": {lr}, '
+              f'"momentum": {mom}}}').encode()
+        assert L.MXTPUKVStoreSetOptimizer(kv, js) == 0, \
+            L.MXTPUGetLastError().decode()
+        h_w = native._numpy_to_handle(L, w0)
+        h_g1 = native._numpy_to_handle(L, g1)
+        h_g2 = native._numpy_to_handle(L, g2)
+        h_out = native._numpy_to_handle(L, np.zeros_like(w0))
+        try:
+            assert L.MXTPUKVStoreInit(kv, 0, h_w) == 0
+            assert L.MXTPUKVStorePush(kv, 0, h_g1) == 0
+            assert L.MXTPUKVStorePush(kv, 0, h_g2) == 0
+            assert L.MXTPUKVStorePull(kv, 0, h_out) == 0
+            got = native._handle_to_numpy(L, h_out)
+        finally:
+            for h in (h_w, h_g1, h_g2, h_out):
+                L.MXTPUNDArrayFree(h)
+        m1 = -lr * g1
+        w1 = w0 + m1
+        m2 = mom * m1 - lr * g2
+        w2 = w1 + m2
+        np.testing.assert_allclose(got, w2, rtol=1e-6)
+    finally:
+        L.MXTPUKVStoreFree(kv)
+
+
 def test_c_abi_bridge_ops_join_the_tape():
     """Round-4 verdict weak #4: bridge-dispatched ops must not silently
     bypass the C autograd tape. Recording through a bridge op now records
