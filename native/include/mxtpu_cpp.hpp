@@ -11,6 +11,7 @@
 #define MXTPU_CPP_HPP_
 
 #include <cstdint>
+#include <cstdio>
 #include <stdexcept>
 #include <string>
 #include <vector>
@@ -330,10 +331,13 @@ class KVStore {
   }
 
   void set_optimizer(double lr, double momentum = 0.0) {
-    std::string js = "{\"optimizer\": \"sgd\", \"learning_rate\": " +
-                     std::to_string(lr) + ", \"momentum\": " +
-                     std::to_string(momentum) + "}";
-    check(MXTPUKVStoreSetOptimizer(h_, js.c_str()), "KVStoreSetOptimizer");
+    // %.17g, not std::to_string: fixed 6-decimal formatting would zero
+    // small rates (1e-7 -> "0.000000") and never engage the momentum path
+    char js[160];
+    std::snprintf(js, sizeof(js),
+                  "{\"optimizer\": \"sgd\", \"learning_rate\": %.17g, "
+                  "\"momentum\": %.17g}", lr, momentum);
+    check(MXTPUKVStoreSetOptimizer(h_, js), "KVStoreSetOptimizer");
   }
   void init(int key, const NDArray& v) {
     check(MXTPUKVStoreInit(h_, key, v.handle()), "KVStoreInit");

@@ -726,8 +726,14 @@ int MXTPUKVStorePush(MXTPUKVHandle kv, int key, MXTPUNDHandle grad) {
     if (m && !had_m) MXTPUNDArrayFree(m);  // fresh zero state: temp only
     if (new_m != nullptr) {
       next = inv1("add", {it->second, new_m});
-      if (had_m) MXTPUNDArrayFree(k->mom[key]);
-      k->mom[key] = new_m;  // state persists across pushes
+      if (next != nullptr) {
+        // commit the momentum state only once the weight update is in
+        // hand — a failed push must leave state consistent for a retry
+        if (had_m) MXTPUNDArrayFree(k->mom[key]);
+        k->mom[key] = new_m;  // state persists across pushes
+      } else {
+        MXTPUNDArrayFree(new_m);
+      }
     }
   } else if (k->sgd) {  // w <- w - lr * grad
     char buf[64];
