@@ -434,6 +434,59 @@ def _apply(op, inputs, kwargs, name=None):
     return Symbol(op, inputs, kwargs, name or _auto_name(op), nout=max(opdef.nout, 1))
 
 
+# creation/custom helpers the reference's generated sym surface carries
+# (symbol/register.py exposes zeros/ones/linspace; symbol.Custom wraps the
+# CustomOp registry) — expressed over the registered creation ops so they
+# stay lazy symbols
+def _as_shape(shape):
+    return tuple(shape) if hasattr(shape, "__iter__") else (int(shape),)
+
+
+def zeros(shape, dtype="float32", name=None):
+    return __getattr__("full")(shape=_as_shape(shape), value=0.0,
+                               dtype=dtype, name=name)
+
+
+def ones(shape, dtype="float32", name=None):
+    return __getattr__("full")(shape=_as_shape(shape), value=1.0,
+                               dtype=dtype, name=name)
+
+
+def linspace(start, stop, num, endpoint=True, dtype="float32", name=None):
+    """num evenly spaced values over [start, stop] (reference linspace):
+    start + arange(num) * step, all lazy registry ops."""
+    n = int(num)
+    denom = (n - 1) if endpoint else n
+    step = (stop - start) / denom if denom > 0 else 0.0
+    idx = __getattr__("arange")(start=0.0, stop=float(n), step=1.0,
+                                dtype=dtype, name=name)
+    return idx * step + start
+
+
+_CUSTOM_SYM_COUNT = 0
+
+
+def Custom(*args, op_type=None, name=None, **kwargs):
+    """Symbolic Custom op (reference symbol.Custom): same user-registered
+    CustomOp as nd.Custom, deferred into the graph. The instance's pure fn
+    (closed over its kwargs) is entered into the central registry under a
+    unique generated name so the executor's string-keyed op resolution
+    works unchanged — the analog of the reference registering 'Custom' as
+    a stateful nnvm op."""
+    global _CUSTOM_SYM_COUNT
+
+    from ..operator import make_custom_fn
+
+    fn, nout_ = make_custom_fn(op_type, kwargs)
+    _CUSTOM_SYM_COUNT += 1
+    op_name = f"_sym_custom_{op_type}_{_CUSTOM_SYM_COUNT}"
+    _registry._REGISTRY[op_name] = _registry.OpDef(
+        name=op_name, fn=fn, nout=nout_)
+    inputs = [a for a in args if isinstance(a, Symbol)]
+    return Symbol(op_name, inputs, {}, name or f"custom_{op_type}",
+                  nout=max(nout_, 1))
+
+
 def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
         init=None, stype=None, **kwargs):
     s = Symbol(None, [], {}, name)

@@ -81,6 +81,20 @@ def test_nd_frozen_surface():
         "move it to DOCUMENTED_ABSENCES with a design justification")
 
 
+def test_sym_surface_tracks_nd():
+    """mx.sym is generated from the same registry (reference:
+    symbol/register.py over the same op list as ndarray/register.py) — every
+    canonical op name must resolve there too, except the imperative-only
+    creation/IO helpers."""
+    SYM_EXEMPT = {
+        # imperative array-creation/ser­ialization surface, no symbolic analog
+        "array", "empty", "cast_storage",
+    }
+    missing = [n for n in CANONICAL_ND
+               if n not in SYM_EXEMPT and not hasattr(mx.sym, n)]
+    assert not missing, f"mx.sym lost canonical names: {missing}"
+
+
 def test_nd_absences_are_documented_not_present():
     """If a documented absence appears, it must be promoted to CANONICAL_ND
     (keeps the absence list honest)."""

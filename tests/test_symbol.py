@@ -216,3 +216,41 @@ def test_sym_auto_param_int_label_softmax_output_trains():
         losses.append(-np.log(np.maximum(
             p[np.arange(4), y.asnumpy().astype(int)], 1e-9)).mean())
     assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_sym_creation_helpers_and_custom():
+    """sym.zeros/ones/linspace (reference symbol/register.py surface) stay
+    lazy and bind correctly; sym.Custom defers a user CustomOp into the
+    graph with working forward AND backward."""
+    from mxnet_tpu import operator as op_mod
+
+    z, o, l = sym.zeros((2, 3)), sym.ones(4), sym.linspace(0.0, 1.0, 5)
+    ex = sym.Group([z, o, l]).simple_bind()
+    outs = ex.forward()
+    np.testing.assert_array_equal(outs[0].asnumpy(), np.zeros((2, 3)))
+    np.testing.assert_array_equal(outs[1].asnumpy(), np.ones(4))
+    np.testing.assert_allclose(outs[2].asnumpy(), np.linspace(0, 1, 5),
+                               rtol=1e-6)
+
+    class Sq(op_mod.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+
+    @op_mod.register("sq_sym_surface_test")
+    class SqProp(op_mod.CustomOpProp):
+        def list_arguments(self): return ["data"]
+        def list_outputs(self): return ["out"]
+        def infer_shape(self, in_shape): return in_shape, [in_shape[0]], []
+        def create_operator(self, ctx, shapes, dtypes): return Sq()
+
+    x = sym.var("x")
+    y = sym.Custom(x, op_type="sq_sym_surface_test")
+    ex2 = y.simple_bind(x=(2, 2))
+    ex2.arg_dict["x"][:] = 3.0
+    (out,) = ex2.forward(is_train=True)
+    np.testing.assert_allclose(out.asnumpy(), 9.0)
+    ex2.backward()
+    np.testing.assert_allclose(ex2.grad_dict["x"].asnumpy(), 6.0)
