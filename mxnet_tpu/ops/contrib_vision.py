@@ -443,3 +443,46 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
 
     loc_target, loc_mask, cls_target = jax.vmap(one)(label, cls_pred)
     return loc_target, loc_mask, cls_target
+
+
+@register("ROIPooling", aliases=("roi_pooling",))
+def roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0):
+    """Quantized max ROI pooling (reference roi_pooling.cc — the Fast R-CNN
+    original; ROIAlign supersedes it but zoo-era models still call it).
+
+    XLA-friendly formulation: a static nearest-neighbor sample grid with
+    spacing <= 1 cell per bin, max-reduced. Because the grid covers every
+    integer cell of each bin, the max equals the reference's exact
+    per-cell max."""
+    pooled_h, pooled_w = (int(pooled_size[0]), int(pooled_size[1]))
+    N, C, H, W = data.shape
+    rois = rois.astype(data.dtype)
+    # upper-bound samples per bin so spacing <= 1 pixel
+    sr_h = max(1, -(-H // pooled_h))
+    sr_w = max(1, -(-W // pooled_w))
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        # reference quantization: round the scaled corners to integers
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = rh / pooled_h
+        bin_w = rw / pooled_w
+        py = jnp.arange(pooled_h, dtype=data.dtype)
+        px = jnp.arange(pooled_w, dtype=data.dtype)
+        sy = jnp.arange(sr_h, dtype=data.dtype) / sr_h
+        sx = jnp.arange(sr_w, dtype=data.dtype) / sr_w
+        ys = y1 + (py[:, None] + sy[None, :]) * bin_h      # (ph, sr_h)
+        xs = x1 + (px[:, None] + sx[None, :]) * bin_w      # (pw, sr_w)
+        iy = jnp.clip(jnp.floor(ys), 0, H - 1).astype(jnp.int32)
+        ix = jnp.clip(jnp.floor(xs), 0, W - 1).astype(jnp.int32)
+        img = data[bidx]                                    # (C, H, W)
+        # gather (C, ph, sr_h, pw, sr_w) then max over the sample axes
+        vals = img[:, iy[:, :, None, None], ix[None, None, :, :]]
+        return jnp.max(vals, axis=(2, 4))                   # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)

@@ -514,3 +514,44 @@ def dequantize(data, min_range, max_range, out_type="float32"):
         amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx_))
         out = data.astype(jnp.float32) * (jnp.maximum(amax, 1e-12) / 127.0)
     return out.astype(jnp.dtype(out_type))
+
+
+@register("bincount")
+def bincount(data, weights=None, minlength=0):
+    """Histogram of non-negative ints (reference np-compat surface). The
+    output length is data-dependent, so this op is eager-only (under jit,
+    pass minlength >= 1 + max to fix the shape)."""
+    d = data.astype(jnp.int32).reshape(-1)
+    try:
+        length = max(int(jnp.max(d)) + 1 if d.size else 1, int(minlength))
+    except Exception:  # tracer: static length must come from minlength
+        if int(minlength) <= 0:
+            raise ValueError(
+                "bincount under jit needs minlength >= 1 + max(data)")
+        length = int(minlength)
+    w = weights.reshape(-1) if weights is not None else None
+    return jnp.bincount(d, weights=w, length=length)
+
+
+@register("onehot_encode")
+def onehot_encode(indices, out):
+    """Legacy 0.x-era one-hot (reference ndarray_function.cc OnehotEncode):
+    the second arg supplies the output shape (n, k)."""
+    return jax.nn.one_hot(indices.astype(jnp.int32), out.shape[-1],
+                          dtype=out.dtype)
+
+
+@register("choose_element_0index")
+def choose_element_0index(lhs, rhs):
+    """out[i] = lhs[i, rhs[i]] (reference ndarray_function.cc; the pre-pick
+    batch gather the legacy RNN/softmax examples used)."""
+    idx = rhs.astype(jnp.int32).reshape(-1)
+    return lhs[jnp.arange(lhs.shape[0]), idx]
+
+
+@register("fill_element_0index")
+def fill_element_0index(lhs, mhs, rhs):
+    """out = lhs with out[i, rhs[i]] = mhs[i] (reference counterpart of
+    choose_element_0index; functional here — returns the filled copy)."""
+    idx = rhs.astype(jnp.int32).reshape(-1)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs.reshape(-1))

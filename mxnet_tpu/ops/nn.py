@@ -313,6 +313,59 @@ def softmax_output(data, label=None, grad_scale=1.0, ignore_label=-1, use_ignore
 
 
 # --------------------------------------------------------------------------
+# regression heads (reference: regression_output-inl.h — Linear/Logistic/MAE
+# RegressionOutput: forward applies the link, backward is the FUSED
+# (link(data) - label) * grad_scale / num_output, independent of the
+# incoming cotangent — what lets classic symbols train with a regression
+# head and Module.backward's ones seed)
+# --------------------------------------------------------------------------
+def _regression_output_fn(link, dlink, grad_scale):
+    @jax.custom_vjp
+    def _ro(data, label):
+        return link(data)
+
+    def _fwd(data, label):
+        out = link(data)
+        return out, (out, label)
+
+    def _bwd(res, g):
+        out, label = res
+        num_out = max(out.size // out.shape[0], 1) if out.ndim else 1
+        ds = dlink(out, label.reshape(out.shape)) * (grad_scale / num_out)
+        return ds.astype(out.dtype), jnp.zeros_like(label)
+
+    _ro.defvjp(_fwd, _bwd)
+    return _ro
+
+
+def _make_regression_head(reg_name, aliases, link, dlink, doc):
+    @register(reg_name, aliases=aliases)
+    def head(data, label=None, grad_scale=1.0):
+        if label is None:
+            return link(data)
+        return _regression_output_fn(link, dlink, float(grad_scale))(
+            data, label)
+
+    head.__doc__ = doc
+    return head
+
+
+_make_regression_head(
+    "LinearRegressionOutput", ("linear_regression_output",),
+    lambda x: x, lambda out, lbl: out - lbl,
+    "Identity link; backward (out - label) * grad_scale / num_output.")
+_make_regression_head(
+    "LogisticRegressionOutput", ("logistic_regression_output",),
+    lambda x: jax.nn.sigmoid(x), lambda out, lbl: out - lbl,
+    "Sigmoid link; the (p - label) gradient is exact for the implied "
+    "cross-entropy loss (reference logistic_regression_output).")
+_make_regression_head(
+    "MAERegressionOutput", ("mae_regression_output",),
+    lambda x: x, lambda out, lbl: jnp.sign(out - lbl),
+    "Identity link; backward sign(out - label) * grad_scale / num_output.")
+
+
+# --------------------------------------------------------------------------
 # normalization (reference: batch_norm.cc, layer_norm.cc, l2_normalization)
 # --------------------------------------------------------------------------
 @register("BatchNorm", aliases=("batch_norm",), nout=3)

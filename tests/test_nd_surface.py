@@ -57,6 +57,9 @@ linalg_extracttrian linalg_maketrian
 zeros ones full arange eye empty array linspace
 cast_storage quantize quantize_v2 dequantize
 im2col col2im multi_all_finite all_finite amp_cast amp_multicast
+LinearRegressionOutput LogisticRegressionOutput MAERegressionOutput
+ROIPooling bincount onehot_encode choose_element_0index
+fill_element_0index
 """.split()
 
 # Deliberate absences, each with the design stance that blesses it.
@@ -200,3 +203,62 @@ def test_negative_binomial_family_moments():
         mx.nd.array(np.array([0.3, 0.3], np.float32)), shape=(2000,)).asnumpy()
     assert s3.shape == (2, 2000)
     assert abs(s3[0].mean() - 1.0) < 0.4 and abs(s3[1].mean() - 5.0) < 1.0
+
+
+def test_regression_heads_fused_gradients():
+    """Linear/Logistic/MAE RegressionOutput (reference
+    regression_output-inl.h): forward applies the link; backward is the
+    FUSED (link(x) - label) * grad_scale / num_output regardless of the
+    incoming cotangent."""
+    from mxnet_tpu import autograd, nd
+
+    x = nd.array(np.array([[0.0, 2.0]], np.float32))
+    lbl = nd.array(np.array([[1.0, 1.0]], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.LinearRegressionOutput(x, lbl)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               (np.array([[0.0, 2.0]]) - 1.0) / 2, rtol=1e-6)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.MAERegressionOutput(x, lbl, grad_scale=2.0)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               np.sign([[0.0 - 1.0, 2.0 - 1.0]]) * 2.0 / 2,
+                               rtol=1e-6)
+    # logistic: p - label, with p = sigmoid(x)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.LogisticRegressionOutput(x, lbl)
+    y.backward()
+    p = 1 / (1 + np.exp(-np.array([[0.0, 2.0]])))
+    np.testing.assert_allclose(x.grad.asnumpy(), (p - 1.0) / 2, rtol=1e-5)
+    # label-free call is just the link
+    np.testing.assert_allclose(nd.LogisticRegressionOutput(x).asnumpy(), p,
+                               rtol=1e-5)
+
+
+def test_roi_pooling_matches_reference_quantization():
+    from mxnet_tpu import nd
+
+    data = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    rois = nd.array(np.array([[0, 0, 0, 3, 3]], np.float32))
+    out = nd.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    np.testing.assert_allclose(out.asnumpy()[0, 0], [[5, 7], [13, 15]])
+
+
+def test_legacy_0index_and_onehot_ops():
+    from mxnet_tpu import nd
+
+    a = nd.array(np.array([[1., 2., 3.], [4., 5., 6.]], np.float32))
+    idx = nd.array(np.array([2, 0], np.float32))
+    np.testing.assert_allclose(nd.choose_element_0index(a, idx).asnumpy(),
+                               [3., 4.])
+    filled = nd.fill_element_0index(
+        a, nd.array(np.array([9., 9.], np.float32)), idx)
+    np.testing.assert_allclose(filled.asnumpy(), [[1, 2, 9], [9, 5, 6]])
+    oh = nd.onehot_encode(idx, nd.zeros((2, 3)))
+    np.testing.assert_allclose(oh.asnumpy(), [[0, 0, 1], [1, 0, 0]])
+    bc = nd.bincount(nd.array(np.array([0, 1, 1, 3], np.float32)))
+    np.testing.assert_allclose(bc.asnumpy(), [1, 2, 0, 1])
