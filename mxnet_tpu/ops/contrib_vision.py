@@ -450,14 +450,17 @@ def roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0):
     """Quantized max ROI pooling (reference roi_pooling.cc — the Fast R-CNN
     original; ROIAlign supersedes it but zoo-era models still call it).
 
-    XLA-friendly formulation: a static nearest-neighbor sample grid with
-    spacing <= 1 cell per bin, max-reduced. Because the grid covers every
-    integer cell of each bin, the max equals the reference's exact
-    per-cell max."""
+    XLA-friendly formulation: per-bin boundaries are CLIPPED to the image
+    (reference behavior), then a static nearest-neighbor grid samples the
+    clipped bin, max-reduced. Spacing <= 1 cell whenever the ROI lies
+    inside the image, making the max exactly the reference's per-cell max;
+    bins of an ROI LARGER than the image sample at coarser spacing (an
+    approximation only for that degenerate case). Bins that clip to empty
+    output 0, like the reference."""
     pooled_h, pooled_w = (int(pooled_size[0]), int(pooled_size[1]))
     N, C, H, W = data.shape
     rois = rois.astype(data.dtype)
-    # upper-bound samples per bin so spacing <= 1 pixel
+    # upper-bound samples per bin so spacing <= 1 pixel for in-image ROIs
     sr_h = max(1, -(-H // pooled_h))
     sr_w = max(1, -(-W // pooled_w))
 
@@ -474,15 +477,23 @@ def roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0):
         bin_w = rw / pooled_w
         py = jnp.arange(pooled_h, dtype=data.dtype)
         px = jnp.arange(pooled_w, dtype=data.dtype)
-        sy = jnp.arange(sr_h, dtype=data.dtype) / sr_h
-        sx = jnp.arange(sr_w, dtype=data.dtype) / sr_w
-        ys = y1 + (py[:, None] + sy[None, :]) * bin_h      # (ph, sr_h)
-        xs = x1 + (px[:, None] + sx[None, :]) * bin_w      # (pw, sr_w)
+        # per-bin [start, end) in cell units, clipped to the image
+        ys0 = jnp.clip(jnp.floor(y1 + py * bin_h), 0, H)          # (ph,)
+        ys1 = jnp.clip(jnp.ceil(y1 + (py + 1) * bin_h), 0, H)
+        xs0 = jnp.clip(jnp.floor(x1 + px * bin_w), 0, W)          # (pw,)
+        xs1 = jnp.clip(jnp.ceil(x1 + (px + 1) * bin_w), 0, W)
+        empty = (ys1[:, None] <= ys0[:, None]) | \
+                (xs1[None, :] <= xs0[None, :])                     # (ph, pw)
+        sy = (jnp.arange(sr_h, dtype=data.dtype) + 0.5) / sr_h
+        sx = (jnp.arange(sr_w, dtype=data.dtype) + 0.5) / sr_w
+        ys = ys0[:, None] + sy[None, :] * (ys1 - ys0)[:, None]     # (ph, sr_h)
+        xs = xs0[:, None] + sx[None, :] * (xs1 - xs0)[:, None]     # (pw, sr_w)
         iy = jnp.clip(jnp.floor(ys), 0, H - 1).astype(jnp.int32)
         ix = jnp.clip(jnp.floor(xs), 0, W - 1).astype(jnp.int32)
-        img = data[bidx]                                    # (C, H, W)
+        img = data[bidx]                                           # (C, H, W)
         # gather (C, ph, sr_h, pw, sr_w) then max over the sample axes
         vals = img[:, iy[:, :, None, None], ix[None, None, :, :]]
-        return jnp.max(vals, axis=(2, 4))                   # (C, ph, pw)
+        out = jnp.max(vals, axis=(2, 4))                           # (C, ph, pw)
+        return jnp.where(empty[None], jnp.zeros((), data.dtype), out)
 
     return jax.vmap(one_roi)(rois)

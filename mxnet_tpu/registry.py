@@ -21,7 +21,8 @@ from typing import Callable, Dict, Optional, Sequence
 __all__ = ["OpDef", "register", "get", "list_ops", "alias"]
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)  # identity hash: Symbol nodes
+# may carry an OpDef directly (sym.Custom) and key shape-infer caches on it
 class OpDef:
     name: str
     fn: Callable  # pure: (*jax_arrays, **params) -> array | tuple(arrays)

@@ -211,7 +211,7 @@ class Symbol:
                 base_key = (s._op, s._name)
                 if base_key not in memo:
                     raws = [ev(i) for i in s._inputs]
-                    out = _registry.get(s._op).fn(*raws, **s._kwargs)
+                    out = _resolve_op(s._op).fn(*raws, **s._kwargs)
                     memo[base_key] = out if isinstance(out, tuple) else (out,)
                 return memo[base_key]
 
@@ -289,8 +289,14 @@ class Symbol:
             if key in index:
                 return index[key]
             inputs = [[walk(i), i._out_index, 0] for i in s._inputs]
+            op = s._op
+            if isinstance(op, _registry.OpDef):
+                # sym.Custom nodes carry their OpDef; serialize its name —
+                # load_json then fails LOUDLY (unknown op) unless the user
+                # re-registers, mirroring the reference's Custom contract
+                op = op.name
             nodes.append({
-                "op": s._op or "null",
+                "op": op or "null",
                 "name": s._name,
                 "attrs": {k: repr(v) for k, v in s._kwargs.items()},
                 "_raw_attrs": _jsonable(s._kwargs),
@@ -407,7 +413,7 @@ def _infer_shapes_partial(head, known):
             return None
         try:
             structs = [jax.ShapeDtypeStruct(sh, jnp.float32) for sh in in_shapes]
-            outs = jax.eval_shape(lambda *a: _registry.get(s._op).fn(*a, **s._kwargs),
+            outs = jax.eval_shape(lambda *a: _resolve_op(s._op).fn(*a, **s._kwargs),
                                   *structs)
             outs = outs if isinstance(outs, tuple) else (outs,)
             node_out[key] = tuple(tuple(o.shape) for o in outs)
@@ -429,8 +435,14 @@ def _auto_name(op):
     return f"{op.lower().strip('_')}{n}"
 
 
+def _resolve_op(op):
+    # Symbol nodes normally carry a registry NAME; sym.Custom nodes carry
+    # their per-instance OpDef directly (no global registry mutation)
+    return op if isinstance(op, _registry.OpDef) else _registry.get(op)
+
+
 def _apply(op, inputs, kwargs, name=None):
-    opdef = _registry.get(op)
+    opdef = _resolve_op(op)
     return Symbol(op, inputs, kwargs, name or _auto_name(op), nout=max(opdef.nout, 1))
 
 
@@ -454,36 +466,42 @@ def ones(shape, dtype="float32", name=None):
 
 def linspace(start, stop, num, endpoint=True, dtype="float32", name=None):
     """num evenly spaced values over [start, stop] (reference linspace):
-    start + arange(num) * step, all lazy registry ops."""
+    start + arange(num) * step, all lazy registry ops. The user's name goes
+    on the RETURNED node so output-name lookups find it."""
     n = int(num)
     denom = (n - 1) if endpoint else n
     step = (stop - start) / denom if denom > 0 else 0.0
     idx = __getattr__("arange")(start=0.0, stop=float(n), step=1.0,
-                                dtype=dtype, name=name)
-    return idx * step + start
-
-
-_CUSTOM_SYM_COUNT = 0
+                                dtype=dtype)
+    scaled = _apply("_mul_scalar", [idx], {"scalar": step})
+    return _apply("_plus_scalar", [scaled], {"scalar": start}, name=name)
 
 
 def Custom(*args, op_type=None, name=None, **kwargs):
-    """Symbolic Custom op (reference symbol.Custom): same user-registered
-    CustomOp as nd.Custom, deferred into the graph. The instance's pure fn
-    (closed over its kwargs) is entered into the central registry under a
-    unique generated name so the executor's string-keyed op resolution
-    works unchanged — the analog of the reference registering 'Custom' as
-    a stateful nnvm op."""
-    global _CUSTOM_SYM_COUNT
-
+    """Symbolic Custom op (reference symbol.Custom over the CustomOp
+    registry). Symbol inputs may come positionally or by keyword
+    (``sym.Custom(data=x, op_type=...)`` — the reference's canonical
+    form); non-Symbol kwargs parameterize the CustomOpProp. The node
+    carries its per-instance OpDef DIRECTLY (no global registry mutation;
+    ``_resolve_op`` accepts it), so transient symbols leak nothing.
+    Serialization note: like the reference, a Custom graph only reloads in
+    a process that re-registers the op — here tojson records the
+    ``Custom:<type>`` name, which load_json resolves to a loud error."""
     from ..operator import make_custom_fn
 
-    fn, nout_ = make_custom_fn(op_type, kwargs)
-    _CUSTOM_SYM_COUNT += 1
-    op_name = f"_sym_custom_{op_type}_{_CUSTOM_SYM_COUNT}"
-    _registry._REGISTRY[op_name] = _registry.OpDef(
-        name=op_name, fn=fn, nout=nout_)
-    inputs = [a for a in args if isinstance(a, Symbol)]
-    return Symbol(op_name, inputs, {}, name or f"custom_{op_type}",
+    sym_args = [a for a in args if isinstance(a, Symbol)]
+    if len(sym_args) != len(args):
+        raise MXNetError("sym.Custom: positional args must be Symbols")
+    kw_syms = [(k, v) for k, v in kwargs.items() if isinstance(v, Symbol)]
+    if sym_args and kw_syms:
+        raise MXNetError(
+            "sym.Custom: pass Symbol inputs either positionally or by "
+            "keyword, not both (slot order would be ambiguous)")
+    params = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+    inputs = sym_args or [v for _, v in kw_syms]
+    fn, nout_ = make_custom_fn(op_type, params)
+    opdef = _registry.OpDef(name=f"Custom:{op_type}", fn=fn, nout=nout_)
+    return Symbol(opdef, inputs, {}, name or f"custom_{op_type}",
                   nout=max(nout_, 1))
 
 
@@ -542,7 +560,7 @@ def eval_symbol(symbol: Symbol, env: dict):
         key = (s._op, s._name)
         if key not in memo:
             ins = tuple(ev(i) for i in s._inputs)
-            out = invoke(_registry.get(s._op), ins, dict(s._kwargs))
+            out = invoke(_resolve_op(s._op), ins, dict(s._kwargs))
             memo[key] = out if isinstance(out, tuple) else (out,)
         return memo[key]
 
