@@ -10,7 +10,13 @@
 
 PY ?= python
 
-.PHONY: ci sanity native fast slow test bench clean
+# chaos pass (docs/RESILIENCE.md): deterministic transient faults on every
+# IO/DCN fault site, fixed seed — the tier-1 suite must pass anyway, proving
+# the retry/atomic-commit layers absorb them. every>=2 so the default
+# 3-attempt retry policy can never see an injected failure twice in a row.
+CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
+
+.PHONY: ci sanity native fast slow test chaos bench clean
 
 ci: sanity native fast
 
@@ -30,6 +36,10 @@ fast: native
 
 slow: native
 	$(PY) -m pytest tests/ -q -m "slow"
+
+chaos: native
+	MXNET_TPU_FAULTS="$(CHAOS_FAULTS)" MXNET_TPU_RETRY_BASE_DELAY=0.005 \
+		$(PY) -m pytest tests/ -q -m "not slow"
 
 test: sanity native
 	$(PY) -m pytest tests/ -q

@@ -6,19 +6,41 @@ Two formats:
   - a *training checkpoint* of (params, opt_state, step) for resume —
     orbax-backed async+sharded when orbax is importable, npz otherwise.
 
-Failure recovery story (SURVEY §5.3): restart from latest checkpoint —
-``latest_checkpoint`` scans the directory; TrainStep.save/restore wire it up.
+Failure recovery story (SURVEY §5.3), hardened by the resilience
+subsystem (docs/RESILIENCE.md):
+
+  - saves stage into ``ckpt-{step}.tmp`` and are published with one atomic
+    ``os.replace`` — a crash mid-save can never shadow the previous good
+    checkpoint with a torn one;
+  - every committed checkpoint carries ``manifest.json`` (per-array sha256
+    + shapes/dtypes, plus file-level sha256/sizes) written *before* the
+    commit rename; ``load_train_state`` verifies the restored leaves
+    against it and raises :class:`CheckpointCorruptError` on any mismatch;
+  - ``latest_checkpoint`` validates candidates (manifest file hashes;
+    ``meta.json`` presence for legacy dirs) and falls back to the newest
+    checkpoint that passes, so a partial/corrupt newest dir degrades to
+    "resume one checkpoint earlier" instead of "crash at restore";
+  - reads and writes run under the retry policy and are fault-injection
+    sites (``ckpt.save`` / ``ckpt.load``) so all of the above is exercised
+    by tests and ``make chaos`` on CPU.
 """
 from __future__ import annotations
 
 import json
+import logging
 import os
-import re
+import shutil
 from typing import Optional
 
 import numpy as np
 
-__all__ = ["save_train_state", "load_train_state", "latest_checkpoint"]
+from .resilience import faults, integrity, retry
+from .resilience.integrity import CheckpointCorruptError  # noqa: F401  (re-export)
+
+__all__ = ["save_train_state", "load_train_state", "latest_checkpoint",
+           "validate_checkpoint", "CheckpointCorruptError"]
+
+logger = logging.getLogger("mxnet_tpu.checkpoint")
 
 
 def _orbax():
@@ -35,60 +57,152 @@ def _orbax():
 
 
 def save_train_state(directory: str, step: int, params, opt_state,
-                     extra: Optional[dict] = None) -> str:
-    """Write checkpoint ``directory/ckpt-{step}``; returns the path."""
+                     extra: Optional[dict] = None,
+                     keep_last: Optional[int] = None) -> str:
+    """Write checkpoint ``directory/ckpt-{step}``; returns the path.
+
+    The write is crash-safe: all payload lands in ``ckpt-{step}.tmp`` and
+    one ``os.replace`` publishes it. ``keep_last`` (default: the
+    ``ckpt_keep_last`` config knob; 0 = keep all) prunes older committed
+    checkpoints after a successful commit.
+    """
     import jax
+
+    from . import config
 
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, f"ckpt-{step}")
+    tmp = path + ".tmp"
     ocp = _orbax()
     state = {"params": params, "opt_state": opt_state}
-    if ocp is not None:
-        ckptr = ocp.StandardCheckpointer()
-        ckptr.save(os.path.abspath(path), state, force=True)
-        ckptr.wait_until_finished()
-    else:  # flat npz fallback
-        flat, treedef = jax.tree_util.tree_flatten(state)
-        os.makedirs(path, exist_ok=True)
-        np.savez(os.path.join(path, "arrays.npz"),
-                 **{str(i): np.asarray(a) for i, a in enumerate(flat)})
-        with open(os.path.join(path, "treedef.txt"), "w") as f:
-            f.write(str(treedef))
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump({"step": step, **(extra or {})}, f)
+    flat, treedef = jax.tree_util.tree_flatten(state)
+
+    # per-array digests need the bytes on host: fine for the npz path (it
+    # materializes anyway — do it once, reused for savez + manifest), but a
+    # multi-host sharded leaf can't be np.asarray'd; those checkpoints get a
+    # file-level manifest only and skip the array-hash tier
+    hashable = all(getattr(a, "is_fully_addressable", True) for a in flat)
+    host_flat = [np.asarray(a) for a in flat] if ocp is None else \
+        (flat if hashable else [])
+
+    def _write():
+        shutil.rmtree(tmp, ignore_errors=True)
+        if ocp is not None:
+            ckptr = ocp.StandardCheckpointer()
+            ckptr.save(os.path.abspath(tmp), state, force=True)
+            ckptr.wait_until_finished()
+            payload_files = []
+            fmt = "orbax"
+        else:  # flat npz fallback
+            os.makedirs(tmp, exist_ok=True)
+            np.savez(os.path.join(tmp, "arrays.npz"),
+                     **{str(i): a for i, a in enumerate(host_flat)})
+            with open(os.path.join(tmp, "treedef.txt"), "w") as f:
+                f.write(str(treedef))
+            payload_files = ["arrays.npz", "treedef.txt"]
+            fmt = "npz"
+        # chaos site: a crash here leaves a torn .tmp (arrays written, no
+        # manifest, no commit) — exactly the mid-save kill the recovery
+        # tests simulate; latest_checkpoint never sees .tmp dirs
+        faults.fire("ckpt.save")
+        manifest = integrity.build_manifest(host_flat, fmt, tmp, payload_files)
+        integrity.write_manifest(tmp, manifest)
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump({"step": step, **(extra or {})}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        integrity.commit_dir(tmp, path)
+
+    retry.retry_call(_write, site="ckpt.save")
+    # always sweep: keep=0 prunes nothing but still clears .tmp/.stale
+    # debris abandoned by earlier crashed saves
+    keep = keep_last if keep_last is not None else config.get("ckpt_keep_last")
+    integrity.sweep_retention(directory, keep)
     return path
 
 
 def load_train_state(path: str, like=None):
     """Load a checkpoint; ``like`` = a (params, opt_state) template pytree
-    with target shardings/dtypes (required for the orbax path)."""
+    with target shardings/dtypes (required for the orbax path).
+
+    Restored leaves are verified against the checkpoint's manifest
+    (per-array sha256); any mismatch raises :class:`CheckpointCorruptError`
+    rather than silently resuming from corrupt state.
+    """
     import jax
 
     ocp = _orbax()
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
-    if ocp is not None and not os.path.exists(os.path.join(path, "arrays.npz")):
-        ckptr = ocp.StandardCheckpointer()
-        template = None
-        if like is not None:
+
+    def _read():
+        faults.fire("ckpt.load")
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        if ocp is not None and not os.path.exists(os.path.join(path, "arrays.npz")):
+            ckptr = ocp.StandardCheckpointer()
+            template = None
+            if like is not None:
+                template = {"params": like[0], "opt_state": like[1]}
+            state = ckptr.restore(os.path.abspath(path), template)
+        else:
+            data = np.load(os.path.join(path, "arrays.npz"))
+            flat = [data[str(i)] for i in range(len(data.files))]
+            assert like is not None, "npz restore requires a template pytree"
             template = {"params": like[0], "opt_state": like[1]}
-        state = ckptr.restore(os.path.abspath(path), template)
-    else:
-        data = np.load(os.path.join(path, "arrays.npz"))
-        flat = [data[str(i)] for i in range(len(data.files))]
-        assert like is not None, "npz restore requires a template pytree"
-        template = {"params": like[0], "opt_state": like[1]}
-        treedef = jax.tree_util.tree_structure(template)
-        state = jax.tree_util.tree_unflatten(treedef, flat)
+            treedef = jax.tree_util.tree_structure(template)
+            state = jax.tree_util.tree_unflatten(treedef, flat)
+        return state, meta
+
+    state, meta = retry.retry_call(_read, site="ckpt.load")
+    try:
+        manifest = integrity.read_manifest(path)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(path, [f"unreadable manifest: {e}"]) from e
+    if manifest is not None and manifest.get("arrays"):
+        flat, _ = jax.tree_util.tree_flatten(state)
+        if all(getattr(a, "is_fully_addressable", True) for a in flat):
+            problems = integrity.verify_arrays(flat, manifest)
+            if problems:
+                raise CheckpointCorruptError(path, problems)
     return state["params"], state["opt_state"], meta["step"]
 
 
-def latest_checkpoint(directory: str) -> Optional[str]:
-    if not os.path.isdir(directory):
-        return None
-    best, best_step = None, -1
-    for name in os.listdir(directory):
-        m = re.fullmatch(r"ckpt-(\d+)", name)
-        if m and int(m.group(1)) > best_step:
-            best, best_step = os.path.join(directory, name), int(m.group(1))
-    return best
+def validate_checkpoint(path: str) -> bool:
+    """Cheap is-this-checkpoint-usable check (no deserialization).
+
+    A committed dir must have a parseable ``meta.json`` (partial pre-
+    resilience writes lack it); when a manifest is present, every listed
+    payload file must exist with the recorded size and sha256. Manifest-less
+    dirs with a valid ``meta.json`` are accepted as legacy checkpoints.
+    """
+    meta_p = os.path.join(path, "meta.json")
+    try:
+        with open(meta_p) as f:
+            json.load(f)
+        manifest = integrity.read_manifest(path)
+    except (OSError, ValueError):
+        return False  # unreadable/corrupt meta or manifest -> not a candidate
+    if manifest is None:
+        return True
+    try:
+        problems = integrity.verify_files(path, manifest)
+    except OSError:
+        return False
+    if problems:
+        logger.warning("checkpoint %s failed validation: %s",
+                       path, "; ".join(problems))
+        return False
+    return True
+
+
+def latest_checkpoint(directory: str, validate: bool = True) -> Optional[str]:
+    """Newest *valid* ``ckpt-N`` under ``directory`` (None when none pass).
+
+    Unverifiable candidates — in-progress/abandoned ``.tmp`` stages, dirs
+    with no ``meta.json``, manifest mismatches — are skipped, falling back
+    to the next-newest valid checkpoint.
+    """
+    for _step, path in integrity.list_checkpoints(directory):
+        if not validate or validate_checkpoint(path):
+            return path
+        logger.warning("skipping unverifiable checkpoint %s", path)
+    return None

@@ -52,6 +52,25 @@ _KNOBS: Dict[str, tuple] = {
                      "xplane trace output directory"),
     "num_cpu_workers": (int, 4, ("MXNET_CPU_WORKER_NTHREADS", "OMP_NUM_THREADS"),
                         "host-side data worker default"),
+    # -- resilience subsystem (docs/RESILIENCE.md) ---------------------------
+    "faults": (str, "", ("MXNET_TPU_FAULTS",),
+               "fault-injection spec armed at import, e.g. "
+               "'ckpt.save:every=3;kv.dcn_psum:on=2:times=2;seed=7' — "
+               "deterministic failures at named sites for chaos testing"),
+    "retry_max_attempts": (int, 3, ("MXNET_TPU_RETRY_MAX_ATTEMPTS",),
+                           "attempts per IO/DCN site before RetryError"),
+    "retry_base_delay": (float, 0.05, ("MXNET_TPU_RETRY_BASE_DELAY",),
+                         "first backoff delay in seconds"),
+    "retry_max_delay": (float, 2.0, ("MXNET_TPU_RETRY_MAX_DELAY",),
+                        "backoff ceiling in seconds"),
+    "retry_jitter": (float, 0.25, ("MXNET_TPU_RETRY_JITTER",),
+                     "max fractional jitter added to each backoff delay"),
+    "retry_timeout": (float, 0.0, ("MXNET_TPU_RETRY_TIMEOUT",),
+                      "per-site wall-clock budget across all attempts of "
+                      "one call, seconds (0 = unlimited)"),
+    "ckpt_keep_last": (int, 0, ("MXNET_TPU_CKPT_KEEP_LAST",),
+                       "retention sweep after each save_train_state: keep "
+                       "the newest N committed checkpoints (0 = keep all)"),
 }
 
 _values: Dict[str, Any] = {}

@@ -13,7 +13,7 @@ from ..trainer import Trainer
 __all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
            "BatchBegin", "BatchEnd", "CheckpointHandler", "EarlyStoppingHandler",
            "LoggingHandler", "MetricHandler", "GradientUpdateHandler",
-           "ValidationHandler", "StoppingHandler"]
+           "ValidationHandler", "StoppingHandler", "PreemptionHandler"]
 
 
 class TrainBegin:
@@ -213,6 +213,49 @@ class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
         if self.max_epoch is not None and epoch is not None \
                 and epoch + 1 >= self.max_epoch:
             self.stop_training = True
+
+
+class PreemptionHandler(TrainBegin, BatchEnd, TrainEnd):
+    """Graceful preemption for the fit loop (resilience subsystem,
+    docs/RESILIENCE.md): SIGTERM/SIGINT flips a flag; at the next batch
+    boundary the net's parameters (and the trainer's optimizer states) are
+    saved and the loop stops — fit() returns normally so the caller's own
+    teardown runs before the process exits.
+
+    Priority -1500 places the save AFTER the gradient update (-2000) of the
+    same batch, so the preemption checkpoint includes the final step.
+    """
+
+    def __init__(self, model_dir, model_prefix="model", guard=None,
+                 priority=-1500):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.priority = priority
+        self.stop_training = False
+        from ...resilience import PreemptionGuard
+
+        self.guard = guard or PreemptionGuard()
+
+    def train_begin(self, estimator, **kwargs):
+        self.stop_training = False
+        self.guard.clear()  # a leftover request from the previous fit()
+        # would otherwise stop this run after one batch
+        self.guard.install()
+
+    def batch_end(self, estimator, **kwargs):
+        import os
+
+        if not self.guard.requested:
+            return
+        os.makedirs(self.model_dir, exist_ok=True)
+        prefix = os.path.join(self.model_dir, self.model_prefix)
+        estimator.net.save_parameters(f"{prefix}-preempt.params")
+        estimator.trainer.save_states(f"{prefix}-preempt.states")
+        logging.info("preemption checkpoint saved to %s-preempt.*", prefix)
+        self.stop_training = True
+
+    def train_end(self, estimator, **kwargs):
+        self.guard.uninstall()
 
 
 class Estimator:

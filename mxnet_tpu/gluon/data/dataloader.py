@@ -43,8 +43,20 @@ def _worker_init(dataset):
     _worker_dataset = dataset
 
 
+def _fetch_batch(dataset, samples, batchify_fn):
+    """One batch fetch+batchify — fault site ``data.batch`` under the retry
+    policy, so a flaky storage read costs a retry instead of the epoch."""
+    from ...resilience import faults, retry
+
+    def _fetch():
+        faults.fire("data.batch")
+        return batchify_fn([dataset[i] for i in samples])
+
+    return retry.retry_call(_fetch, site="data.batch")
+
+
 def _worker_fn(samples, batchify_fn):
-    return batchify_fn([_worker_dataset[i] for i in samples])
+    return _fetch_batch(_worker_dataset, samples, batchify_fn)
 
 
 class DataLoader:
@@ -83,7 +95,7 @@ class DataLoader:
         if self._pool is None:
             prev = None  # 1-deep device prefetch: overlap H2D with consumption
             for samples in self._batch_sampler:
-                batch = self._batchify_fn([self._dataset[i] for i in samples])
+                batch = _fetch_batch(self._dataset, samples, self._batchify_fn)
                 cur = _to_device(batch)
                 if prev is not None:
                     yield prev

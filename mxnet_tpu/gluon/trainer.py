@@ -45,6 +45,10 @@ class Trainer:
         from ..kvstore import create as kv_create
 
         self._kvstore = kv_create(kvstore) if isinstance(kvstore, str) else kvstore
+        # graceful preemption (resilience subsystem): set by install_preemption
+        self._preempt_guard = None
+        self._preempt_save = None
+        self._preempt_exit = True
 
     @property
     def optimizer(self):
@@ -87,8 +91,40 @@ class Trainer:
             skip = scaler.has_overflow(self._params)
             scaler.update_scale(skip)
             if skip:
+                self._check_preemption()
                 return
         self._update(ignore_stale_grad)
+        self._check_preemption()
+
+    # -- graceful preemption (docs/RESILIENCE.md) ----------------------------
+    def install_preemption(self, save_fn, guard=None, exit_on_preempt=True):
+        """SIGTERM/SIGINT -> run ``save_fn()`` (the caller's checkpoint
+        action, e.g. ``lambda: (net.save_parameters(p), trainer.save_states(s))``)
+        at the next completed ``step()``, then raise
+        :class:`~mxnet_tpu.resilience.Preempted` (``SystemExit(0)``).
+        Returns the installed guard."""
+        from ..resilience import PreemptionGuard
+
+        self._preempt_guard = (guard or PreemptionGuard()).install()
+        self._preempt_save = save_fn
+        self._preempt_exit = exit_on_preempt
+        self._preempt_saved = False  # re-arm the one-shot save on reinstall
+        return self._preempt_guard
+
+    def _check_preemption(self):
+        g = self._preempt_guard
+        if g is None or not g.requested:
+            return
+        from ..resilience import Preempted
+
+        # one-shot: with exit_on_preempt=False the caller's loop may run
+        # more steps before winding down — run the checkpoint action once
+        if self._preempt_save is not None and \
+                not getattr(self, "_preempt_saved", False):
+            self._preempt_save()
+            self._preempt_saved = True
+        if self._preempt_exit:
+            raise Preempted(g.signum)
 
     def update(self, batch_size, ignore_stale_grad=False):
         self.step(batch_size, ignore_stale_grad)
@@ -143,12 +179,13 @@ class Trainer:
         import numpy as np
         import jax
 
+        from ..resilience.integrity import atomic_file_write
+
         host_states = jax.tree_util.tree_map(lambda x: np.asarray(x), self._states)
-        with open(fname, "wb") as f:
-            pickle.dump({"states": host_states,
-                         "num_update": self._optimizer.num_update,
-                         "index_update_count": self._optimizer._index_update_count},
-                        f)
+        atomic_file_write(fname, pickle.dumps(
+            {"states": host_states,
+             "num_update": self._optimizer.num_update,
+             "index_update_count": self._optimizer._index_update_count}))
 
     def load_states(self, fname):
         import pickle

@@ -22,6 +22,8 @@ import jax.numpy as jnp
 
 from .base import MXNetError
 from .ndarray import NDArray
+from .resilience import faults, retry
+from .resilience.integrity import atomic_file_write
 
 __all__ = ["KVStore", "create"]
 
@@ -238,14 +240,26 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("no optimizer set on kvstore")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        payload = self._updater.get_states(dump_optimizer)
+
+        def _write():
+            faults.fire("kv.save_states")
+            # temp file + os.replace: a crash mid-write leaves the previous
+            # states file intact instead of a truncated one
+            atomic_file_write(fname, payload)
+
+        retry.retry_call(_write, site="kv.save_states")
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("no optimizer set on kvstore")
-        with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+
+        def _read():
+            faults.fire("kv.load_states")
+            with open(fname, "rb") as f:
+                return f.read()
+
+        self._updater.set_states(retry.retry_call(_read, site="kv.load_states"))
 
     @staticmethod
     def _normalize(key, value):
@@ -254,35 +268,79 @@ class KVStore:
         return [key], [value]
 
 
-def _dcn_psum_batch(raws):
-    """Sum a LIST of arrays across processes with a single allgather: leaves
-    are flattened into one f32 transfer buffer, reduced, and split back —
-    O(1) DCN round-trips per training step regardless of parameter count."""
-    if jax.process_count() == 1 or not raws:
-        return raws
-    from jax.experimental import multihost_utils
+def _transfer_dtype(dt):
+    """Wire dtype for one array in the batched all-reduce: low-precision
+    floats accumulate in f32 (safe_accumulation semantics); f64 and integer
+    gradients keep their own dtype — funnelling everything through f32
+    silently lost their precision."""
+    import numpy as np
 
-    flat = [jnp.ravel(r).astype(jnp.float32) for r in raws]
-    buf = jnp.concatenate(flat) if len(flat) > 1 else flat[0]
-    total = jnp.sum(multihost_utils.process_allgather(buf), axis=0)
-    out, off = [], 0
-    for r in raws:
-        n = r.size
-        out.append(total[off:off + n].reshape(r.shape).astype(r.dtype))
-        off += n
-    return out
+    dt = np.dtype(dt)
+    if dt in (np.dtype(jnp.float16), np.dtype(jnp.bfloat16)):
+        return np.dtype(jnp.float32)
+    return dt
+
+
+def _dcn_psum_batch(raws):
+    """Sum a LIST of arrays across processes with one allgather *per dtype
+    bucket*: leaves sharing a transfer dtype are flattened into a single
+    buffer, reduced, and split back — O(#dtypes) DCN round-trips per
+    training step regardless of parameter count (one, for the typical
+    uniform-precision model).
+
+    Runs under the retry policy with fault site ``kv.dcn_psum_batch``; the
+    gather closure is pure in its inputs, so a retried transient failure
+    reproduces the exact same psum. Retry assumes collective failures are
+    SYMMETRIC — a failed allgather raises on every participant, so all
+    processes re-enter attempt N+1 together. An asymmetric failure (one
+    host dead, the rest fine) is not retryable this way; that is the
+    elastic-worker-recovery follow-up in ROADMAP.md.
+    """
+    if not raws or (jax.process_count() == 1 and not faults.armed()):
+        return raws
+
+    def _gather():
+        faults.fire("kv.dcn_psum_batch")
+        if jax.process_count() == 1:
+            return list(raws)
+        from jax.experimental import multihost_utils
+
+        out = [None] * len(raws)
+        buckets = {}  # transfer dtype -> indices into raws
+        for i, r in enumerate(raws):
+            buckets.setdefault(_transfer_dtype(r.dtype), []).append(i)
+        for tdt, idxs in buckets.items():
+            flat = [jnp.ravel(raws[i]).astype(tdt) for i in idxs]
+            buf = jnp.concatenate(flat) if len(flat) > 1 else flat[0]
+            total = jnp.sum(multihost_utils.process_allgather(buf), axis=0)
+            off = 0
+            for i in idxs:
+                n = raws[i].size
+                out[i] = total[off:off + n].reshape(raws[i].shape).astype(raws[i].dtype)
+                off += n
+        return out
+
+    return retry.retry_call(_gather, site="kv.dcn_psum_batch")
 
 
 def _dcn_psum(x):
     """All-reduce across processes (multi-host DP over DCN). Gathers each
     process's host-local value and sums — the explicit-transfer shape of the
-    reference's dist_sync push aggregation, minus the server role."""
-    if jax.process_count() == 1:
+    reference's dist_sync push aggregation, minus the server role. Runs
+    under the retry policy with fault site ``kv.dcn_psum``."""
+    if jax.process_count() == 1 and not faults.armed():
         return x
-    from jax.experimental import multihost_utils
 
-    gathered = multihost_utils.process_allgather(jnp.asarray(x))
-    return jnp.sum(gathered, axis=0)
+    def _gather():
+        faults.fire("kv.dcn_psum")
+        if jax.process_count() == 1:
+            return x
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(jnp.asarray(x))
+        return jnp.sum(gathered, axis=0)
+
+    return retry.retry_call(_gather, site="kv.dcn_psum")
 
 
 def create(name="local"):

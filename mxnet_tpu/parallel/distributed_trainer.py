@@ -36,9 +36,27 @@ def init(coordinator_address: Optional[str] = None, num_processes: Optional[int]
     if coordinator_address is None:
         _initialized = True  # single process
         return
-    if jax.distributed.is_initialized():
+    # is_initialized() only exists in newer jax; older versions expose the
+    # bootstrap state as jax._src.distributed.global_state.client
+    if hasattr(jax.distributed, "is_initialized"):
+        already = jax.distributed.is_initialized()
+    else:
+        from jax._src import distributed as _dist
+
+        already = _dist.global_state.client is not None
+    if already:
         _initialized = True  # someone (pod runtime, user) already bootstrapped
         return
+    plats = (jax.config.jax_platforms or "").split(",")
+    if "cpu" in plats:
+        try:
+            # multi-process on the CPU backend (the N-local-process CI shape)
+            # needs an actual cross-process collectives impl; the default
+            # 'none' makes every psum fail with "Multiprocess computations
+            # aren't implemented". Must be set before the backend initializes.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass  # older/newer jax without the option: keep prior behavior
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes or int(os.environ.get("MXNET_TPU_NPROC", "1")),

@@ -99,6 +99,10 @@ class TrainStep:
         else:
             self.param_sharding = None
             self.batch_sharding = None
+        # graceful preemption (resilience subsystem): set by install_preemption
+        self._preempt_guard = None
+        self._preempt_dir = None
+        self._preempt_exit = True
         # jit cache keyed on (batch arity, resolved lr/wd multipliers): the
         # in_shardings tuple built by _make_step depends on how many batch
         # arrays the call passes, and the multipliers fold into the program
@@ -224,7 +228,40 @@ class TrainStep:
             self.params, self.opt_state, self.step_count, raws, key, lr, wd)
         # host-side mirror (no device sync — loss is returned as a future)
         self.optimizer.num_update += 1
+        self._check_preemption()
         return loss
+
+    # -- graceful preemption (docs/RESILIENCE.md) ----------------------------
+    def install_preemption(self, directory: str, guard=None,
+                           exit_on_preempt: bool = True):
+        """SIGTERM/SIGINT -> checkpoint into ``directory`` at the next step
+        boundary, then raise :class:`~mxnet_tpu.resilience.Preempted` (a
+        ``SystemExit(0)``) so the process exits cleanly. Returns the
+        installed guard (``guard.request()`` triggers the same path without
+        a real signal; ``exit_on_preempt=False`` checkpoints but lets the
+        caller's loop observe ``guard.requested`` and wind down itself)."""
+        from ..resilience import PreemptionGuard
+
+        self._preempt_guard = (guard or PreemptionGuard()).install()
+        self._preempt_dir = directory
+        self._preempt_exit = exit_on_preempt
+        self._preempt_saved = False  # re-arm the one-shot save on reinstall
+        return self._preempt_guard
+
+    def _check_preemption(self):
+        g = self._preempt_guard
+        if g is None or not g.requested:
+            return
+        from ..resilience import Preempted
+
+        # one-shot: with exit_on_preempt=False the caller's loop may drain
+        # more steps before winding down — don't re-save a full checkpoint
+        # at every one of them
+        if not getattr(self, "_preempt_saved", False):
+            self.save(self._preempt_dir)
+            self._preempt_saved = True
+        if self._preempt_exit:
+            raise Preempted(g.signum)
 
     def sync(self):
         """Write compiled-side params back into the Gluon block."""

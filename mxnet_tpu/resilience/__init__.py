@@ -1,0 +1,31 @@
+"""Resilience subsystem: fault injection, retries, checkpoint integrity,
+graceful preemption (SURVEY §5.3/§5.4 — the recovery story, exercised).
+
+The paper's recovery posture is "restart from the latest checkpoint"; this
+package makes that posture *survivable* under the failures multi-host
+training actually sees, and — crucially — makes every recovery path
+testable on CPU via deterministic fault injection:
+
+  - ``faults``      named fault sites + deterministic triggers
+                    (``MXNET_TPU_FAULTS``, ``make chaos``)
+  - ``retry``       exponential backoff + jitter around IO/DCN edges
+  - ``integrity``   manifests (per-array sha256), atomic commits, retention
+  - ``preemption``  SIGTERM/SIGINT -> checkpoint at step boundary -> exit 0
+
+See docs/RESILIENCE.md for the operator-facing contract.
+"""
+from __future__ import annotations
+
+from . import faults  # noqa: F401
+from . import integrity  # noqa: F401
+from . import preemption  # noqa: F401
+from . import retry  # noqa: F401
+from .faults import InjectedCrash, InjectedFault  # noqa: F401
+from .integrity import CheckpointCorruptError, sweep_retention  # noqa: F401
+from .preemption import Preempted, PreemptionGuard  # noqa: F401
+from .retry import RetryError, RetryPolicy, retry_call  # noqa: F401
+
+__all__ = ["faults", "retry", "integrity", "preemption",
+           "InjectedFault", "InjectedCrash", "CheckpointCorruptError",
+           "Preempted", "PreemptionGuard", "RetryError", "RetryPolicy",
+           "retry_call", "sweep_retention"]

@@ -1,0 +1,249 @@
+"""Deterministic fault-injection registry (SURVEY §5.3 recovery story).
+
+Production multi-host training dies in three places: checkpoint IO, DCN
+collectives, and the input pipeline. Each of those call sites is annotated
+with a named *fault site* (``fire(site)``); this module decides — fully
+deterministically — whether that invocation fails. Arming is programmatic
+(``arm`` / the ``inject`` context manager, for tests) or declarative via
+``MXNET_TPU_FAULTS`` (for the ``make chaos`` CI pass), so every recovery
+path in the framework is testable on CPU with no real signals, no real
+flaky network, and no kill -9.
+
+Two failure flavours:
+
+  - :class:`InjectedFault` (an ``IOError``) — a *transient* failure the
+    retry layer (``resilience.retry``) is expected to absorb;
+  - :class:`InjectedCrash` (a ``BaseException``) — simulated process death
+    mid-operation. It deliberately does NOT derive from ``Exception`` so no
+    retry/except block in the framework can swallow it; whatever partial
+    state was on disk at the fire point is what a restart sees.
+
+Known sites (see docs/RESILIENCE.md):
+
+  ======================  ====================================================
+  ``ckpt.save``           inside ``save_train_state`` — after the array data
+                          is written, before the manifest/commit rename
+  ``ckpt.load``           inside ``load_train_state`` — before reading arrays
+  ``kv.dcn_psum``         the per-key cross-process gradient all-reduce
+  ``kv.dcn_psum_batch``   the batched (one-transfer) all-reduce
+  ``kv.save_states``      ``KVStore.save_optimizer_states`` pre-commit
+  ``data.batch``          one DataLoader batch fetch/batchify
+  ======================  ====================================================
+
+Env grammar (entries separated by ``;``, options by ``:``)::
+
+  MXNET_TPU_FAULTS="ckpt.save:every=3;kv.dcn_psum:on=2:times=2;seed=1234"
+
+  on=N      fire on the Nth invocation of the site (1-based)
+  every=K   fire on every Kth invocation (periodic transient noise)
+  times=M   total number of firings before the trigger disarms (default:
+            unlimited for every=, 1 for on=)
+  p=F       fire with probability F per invocation, drawn from a
+            ``random.Random(seed ^ hash(site))`` stream — deterministic for
+            a fixed seed (the ``seed=N`` entry, default 0)
+  crash     raise InjectedCrash instead of InjectedFault
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import random as _random
+from typing import Dict, List, Optional
+
+__all__ = ["InjectedFault", "InjectedCrash", "arm", "disarm", "reset",
+           "fire", "inject", "count", "armed", "load_spec", "reload_from_env"]
+
+logger = logging.getLogger("mxnet_tpu.resilience.faults")
+
+
+class InjectedFault(IOError):
+    """A transient injected failure — the retry layer should absorb it."""
+
+    def __init__(self, site: str, invocation: int):
+        super().__init__(f"injected fault at site {site!r} (invocation {invocation})")
+        self.site = site
+        self.invocation = invocation
+
+
+class InjectedCrash(BaseException):
+    """Simulated process death at a fault site.
+
+    Derives from BaseException so that no framework-level ``except
+    Exception`` (including the retry layer) can absorb it — exactly like a
+    SIGKILL, the operation stops where it stood and only a fresh process
+    sees the aftermath.
+    """
+
+    def __init__(self, site: str, invocation: int):
+        super().__init__(f"injected crash at site {site!r} (invocation {invocation})")
+        self.site = site
+        self.invocation = invocation
+
+
+class _Trigger:
+    def __init__(self, on: Optional[int] = None, every: Optional[int] = None,
+                 p: Optional[float] = None, times: Optional[int] = None,
+                 crash: bool = False, seed: int = 0, site: str = ""):
+        if sum(x is not None for x in (on, every, p)) != 1:
+            raise ValueError("exactly one of on=/every=/p= must be given")
+        self.on = on
+        self.every = every
+        self.p = p
+        self.times = times if times is not None else (1 if on is not None else None)
+        self.crash = crash
+        # per-(seed, site) stream so p= triggers are reproducible and
+        # independent across sites; crc32 not hash() — str hashing is
+        # randomized per interpreter, which would break the fixed-seed
+        # reproducibility contract
+        import zlib
+
+        self._rng = _random.Random((seed << 32) ^ zlib.crc32(site.encode())) \
+            if p is not None else None
+
+    def matches(self, invocation: int) -> bool:
+        if self.times is not None and self.times <= 0:
+            return False
+        if self.on is not None:
+            hit = invocation == self.on
+        elif self.every is not None:
+            hit = invocation % self.every == 0
+        else:
+            hit = self._rng.random() < self.p
+        if hit and self.times is not None:
+            self.times -= 1
+        return hit
+
+
+_triggers: Dict[str, List[_Trigger]] = {}
+_counts: Dict[str, int] = {}
+_active = False
+_env_loaded = False
+
+
+def _recompute_active() -> None:
+    global _active
+    _active = any(_triggers.values())
+
+
+def armed() -> bool:
+    """Fast check used by hot call sites to skip counter bookkeeping."""
+    _ensure_env()
+    return _active
+
+
+def arm(site: str, on: Optional[int] = None, every: Optional[int] = None,
+        p: Optional[float] = None, times: Optional[int] = None,
+        crash: bool = False, seed: int = 0) -> None:
+    """Arm ``site`` to fail. See module docstring for trigger semantics."""
+    _triggers.setdefault(site, []).append(
+        _Trigger(on=on, every=every, p=p, times=times, crash=crash,
+                 seed=seed, site=site))
+    _recompute_active()
+    logger.info("fault armed: site=%s on=%s every=%s p=%s times=%s crash=%s",
+                site, on, every, p, times, crash)
+
+
+def disarm(site: Optional[str] = None) -> None:
+    """Remove triggers for ``site`` (all sites when None); counters stay."""
+    if site is None:
+        _triggers.clear()
+    else:
+        _triggers.pop(site, None)
+    _recompute_active()
+
+
+def reset() -> None:
+    """Disarm everything and zero all invocation counters."""
+    _triggers.clear()
+    _counts.clear()
+    _recompute_active()
+
+
+def count(site: str) -> int:
+    """How many times ``site`` has fired its invocation counter.
+
+    Counting only happens while any trigger is armed (the fast path is a
+    single bool check), so this is a debugging/testing aid, not telemetry.
+    """
+    return _counts.get(site, 0)
+
+
+def fire(site: str) -> None:
+    """Mark one invocation of ``site``; raise if an armed trigger matches."""
+    _ensure_env()
+    if not _active:
+        return
+    n = _counts.get(site, 0) + 1
+    _counts[site] = n
+    for trig in _triggers.get(site, ()):
+        if trig.matches(n):
+            exc = InjectedCrash(site, n) if trig.crash else InjectedFault(site, n)
+            logger.warning("fault fired: site=%s invocation=%d kind=%s",
+                           site, n, type(exc).__name__)
+            raise exc
+
+
+@contextlib.contextmanager
+def inject(site: str, **kwargs):
+    """Arm ``site`` for the duration of a ``with`` block, then restore the
+    site's previous triggers (counters are left running)."""
+    prev = list(_triggers.get(site, ()))
+    arm(site, **kwargs)
+    try:
+        yield
+    finally:
+        if prev:
+            _triggers[site] = prev
+        else:
+            _triggers.pop(site, None)
+        _recompute_active()
+
+
+def load_spec(spec: str) -> None:
+    """Arm sites from a ``MXNET_TPU_FAULTS``-grammar string."""
+    entries = [e.strip() for e in spec.split(";") if e.strip()]
+    seed = 0
+    body = []
+    for entry in entries:  # seed= applies to all p= entries, wherever written
+        if entry.startswith("seed="):
+            seed = int(entry[5:])
+        else:
+            body.append(entry)
+    for entry in body:
+        parts = entry.split(":")
+        site, opts = parts[0], parts[1:]
+        kw: dict = {"seed": seed}
+        for o in opts:
+            if o == "crash":
+                kw["crash"] = True
+            elif "=" in o:
+                k, v = o.split("=", 1)
+                if k in ("on", "every", "times"):
+                    kw[k] = int(v)
+                elif k == "p":
+                    kw["p"] = float(v)
+                else:
+                    raise ValueError(f"unknown fault option {o!r} in {entry!r}")
+            else:
+                raise ValueError(f"unknown fault option {o!r} in {entry!r}")
+        arm(site, **kw)
+
+
+def _ensure_env() -> None:
+    global _env_loaded
+    if _env_loaded:
+        return
+    _env_loaded = True
+    from .. import config
+
+    spec = config.get("faults")
+    if spec:
+        load_spec(spec)
+
+
+def reload_from_env() -> None:
+    """Re-read ``MXNET_TPU_FAULTS`` (tests that mutate the env call this)."""
+    global _env_loaded
+    reset()
+    _env_loaded = False
+    _ensure_env()

@@ -16,6 +16,16 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # chaos marker (resilience subsystem): tests that *arm* fault injection
+    # themselves, as opposed to the `make chaos` pass which arms
+    # MXNET_TPU_FAULTS globally and runs the ordinary tier-1 suite under it
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests (resilience subsystem); "
+        "`make chaos` runs the tier-1 suite with MXNET_TPU_FAULTS armed")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     """Reference: @with_seed() decorator — reproducible randomness per test."""
