@@ -16,7 +16,7 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity native fast slow test chaos bench clean
+.PHONY: ci sanity native fast slow test chaos obs bench clean
 
 ci: sanity native fast
 
@@ -40,6 +40,14 @@ slow: native
 chaos: native
 	MXNET_TPU_FAULTS="$(CHAOS_FAULTS)" MXNET_TPU_RETRY_BASE_DELAY=0.005 \
 		$(PY) -m pytest tests/ -q -m "not slow"
+	MXNET_TPU_RETRY_BASE_DELAY=0.005 $(PY) tools/obs_smoke.py --chaos-check
+
+# observability gate (docs/OBSERVABILITY.md): a 2-step LeNet train with
+# telemetry on must yield a non-empty obs_report summary covering step/
+# loss/throughput metrics, >=1 recompile, KVStore byte/latency histograms,
+# checkpoint durations, and retry counters that match attempt_log
+obs: native
+	$(PY) tools/obs_smoke.py
 
 test: sanity native
 	$(PY) -m pytest tests/ -q

@@ -46,6 +46,8 @@ from . import callback  # noqa: F401
 from . import contrib  # noqa: F401
 from . import image  # noqa: F401
 from . import config  # noqa: F401
+from . import observability  # noqa: F401
+from . import observability as obs  # noqa: F401
 from . import resilience  # noqa: F401
 from . import test_utils  # noqa: F401
 from .io import recordio  # noqa: F401

@@ -9,7 +9,13 @@ __all__ = ["Speedometer", "do_checkpoint", "LogValidationMetricsCallback",
 
 
 class Speedometer:
-    """Logs samples/sec every ``frequent`` batches (the classic training log)."""
+    """Logs samples/sec every ``frequent`` batches (the classic training log).
+
+    When the observability registry has step telemetry (a ``Trainer``/
+    ``TrainStep`` running with telemetry enabled), throughput is read from
+    the registry's sample/step-time series, so the console line, the JSONL
+    event log, and the Prometheus export all report the same number; the
+    reference-style local wall-clock calculation is the fallback."""
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
@@ -18,6 +24,15 @@ class Speedometer:
         self.init = False
         self.tic = 0
         self.last_count = 0
+        self._last_reg = None
+
+    def _registry_speed(self):
+        """samples/sec from registry deltas since the last log; None when
+        no new step telemetry arrived (telemetry off or loop uninstrumented)."""
+        from .observability import throughput_delta
+
+        speed, self._last_reg = throughput_delta(self._last_reg)
+        return speed
 
     def __call__(self, param):
         count = param.nbatch
@@ -26,7 +41,8 @@ class Speedometer:
         self.last_count = count
         if self.init:
             if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                speed = self._registry_speed() or \
+                    self.frequent * self.batch_size / (time.time() - self.tic)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:

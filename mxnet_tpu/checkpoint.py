@@ -30,10 +30,12 @@ import json
 import logging
 import os
 import shutil
+import time
 from typing import Optional
 
 import numpy as np
 
+from . import observability as _obs
 from .resilience import faults, integrity, retry
 from .resilience.integrity import CheckpointCorruptError  # noqa: F401  (re-export)
 
@@ -113,12 +115,34 @@ def save_train_state(directory: str, step: int, params, opt_state,
             os.fsync(f.fileno())
         integrity.commit_dir(tmp, path)
 
+    t0 = time.perf_counter()
     retry.retry_call(_write, site="ckpt.save")
+    dt = time.perf_counter() - t0
+    # checkpoint IO is rare — record telemetry unconditionally so retention
+    # and duration trends exist even when full telemetry is off
+    nbytes = _dir_bytes(path)
+    _obs.histogram("ckpt_save_seconds", "checkpoint write+commit wall clock",
+                   unit="s").observe(dt)
+    _obs.counter("ckpt_saves_total").inc()
+    _obs.counter("ckpt_bytes_total", unit="bytes").inc(nbytes, op="save")
+    _obs.emit("checkpoint_save", path=path, ckpt_step=step,
+              seconds=round(dt, 6), bytes=nbytes)
     # always sweep: keep=0 prunes nothing but still clears .tmp/.stale
     # debris abandoned by earlier crashed saves
     keep = keep_last if keep_last is not None else config.get("ckpt_keep_last")
     integrity.sweep_retention(directory, keep)
     return path
+
+
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                pass
+    return total
 
 
 def load_train_state(path: str, like=None):
@@ -152,17 +176,30 @@ def load_train_state(path: str, like=None):
             state = jax.tree_util.tree_unflatten(treedef, flat)
         return state, meta
 
+    t0 = time.perf_counter()
     state, meta = retry.retry_call(_read, site="ckpt.load")
     try:
         manifest = integrity.read_manifest(path)
     except (OSError, ValueError) as e:
         raise CheckpointCorruptError(path, [f"unreadable manifest: {e}"]) from e
+    verify_dt = 0.0
     if manifest is not None and manifest.get("arrays"):
         flat, _ = jax.tree_util.tree_flatten(state)
         if all(getattr(a, "is_fully_addressable", True) for a in flat):
+            v0 = time.perf_counter()
             problems = integrity.verify_arrays(flat, manifest)
+            verify_dt = time.perf_counter() - v0
             if problems:
                 raise CheckpointCorruptError(path, problems)
+    dt = time.perf_counter() - t0
+    _obs.histogram("ckpt_load_seconds", "checkpoint restore wall clock "
+                   "(read + manifest verify)", unit="s").observe(dt)
+    _obs.histogram("ckpt_verify_seconds", "manifest sha256 verification",
+                   unit="s").observe(verify_dt)
+    _obs.counter("ckpt_loads_total").inc()
+    _obs.counter("ckpt_bytes_total", unit="bytes").inc(_dir_bytes(path), op="load")
+    _obs.emit("checkpoint_restore", path=path, ckpt_step=meta["step"],
+              seconds=round(dt, 6), verify_seconds=round(verify_dt, 6))
     return state["params"], state["opt_state"], meta["step"]
 
 

@@ -71,6 +71,17 @@ _KNOBS: Dict[str, tuple] = {
     "ckpt_keep_last": (int, 0, ("MXNET_TPU_CKPT_KEEP_LAST",),
                        "retention sweep after each save_train_state: keep "
                        "the newest N committed checkpoints (0 = keep all)"),
+    # -- observability subsystem (docs/OBSERVABILITY.md) ---------------------
+    "telemetry": (bool, False, ("MXNET_TPU_TELEMETRY",),
+                  "arm hot-path telemetry at first use: step/comm/data/ckpt "
+                  "metrics + the JSONL event log (off = one bool check per "
+                  "instrumented call)"),
+    "telemetry_dir": (str, "/tmp/mxnet_tpu_telemetry", ("MXNET_TPU_TELEMETRY_DIR",),
+                      "run directory for events-h{host}.jsonl + metrics.json/"
+                      ".prom exports"),
+    "telemetry_rotate_mb": (int, 64, ("MXNET_TPU_TELEMETRY_ROTATE_MB",),
+                            "event-log rotation threshold per file (one .1 "
+                            "predecessor is kept)"),
 }
 
 _values: Dict[str, Any] = {}

@@ -8,6 +8,7 @@ import time
 
 from ... import autograd
 from ... import metric as metric_mod
+from ... import observability as _obs
 from ..trainer import Trainer
 
 __all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
@@ -47,16 +48,44 @@ class BatchEnd:
 
 
 class LoggingHandler(TrainBegin, EpochEnd, BatchEnd):
+    """Console + event-log progress reporting.
+
+    Loss and throughput come from the observability metrics registry when
+    the loop is instrumented (telemetry on): the ``train_loss`` gauge the
+    fit loop maintains and sample/step-time counter deltas from
+    ``Trainer.step`` — the same series the JSONL log and Prometheus export
+    see, so every surface reports identical numbers. The eval-metric values
+    computed by ``MetricHandler`` are always included."""
+
     def __init__(self, log_interval=50):
         self.log_interval = log_interval
         self._n = 0
+        self._last_reg = None
+
+    def _registry_stats(self):
+        """(samples_per_sec, loss) from registry deltas; Nones without data."""
+        g = _obs.REGISTRY.get("train_loss")
+        loss = g.value() if g is not None else None
+        speed, self._last_reg = _obs.throughput_delta(self._last_reg)
+        return speed, loss
 
     def batch_end(self, estimator, batch=None, **kwargs):
         self._n += 1
         if self.log_interval and self._n % self.log_interval == 0:
             vals = " ".join(f"{m.get()[0]}={m.get()[1]:.5f}"
                             for m in estimator.train_metrics)
+            speed, loss = self._registry_stats()
+            if loss is not None:
+                vals += f" loss={loss:.5f}"
+            if speed is not None:
+                vals += f" throughput={speed:.2f} samples/sec"
             logging.info("Batch[%s] %s", batch, vals)
+            # eval metrics ride in a nested dict: their names are
+            # user-controlled and must never collide with envelope keys
+            _obs.emit("log", scope="batch", batch=batch, loss=loss,
+                      samples_per_sec=speed,
+                      metrics={m.get()[0]: m.get()[1]
+                               for m in estimator.train_metrics})
 
     def epoch_end(self, estimator, epoch=None, **kwargs):
         vals = " ".join(f"{m.get()[0]}={m.get()[1]:.5f}"
@@ -65,7 +94,13 @@ class LoggingHandler(TrainBegin, EpochEnd, BatchEnd):
         if live_val:
             vals += " " + " ".join(f"val_{m.get()[0]}={m.get()[1]:.5f}"
                                    for m in live_val)
+        _speed, loss = self._registry_stats()
+        if loss is not None:
+            vals += f" loss={loss:.5f}"
         logging.info("Epoch[%s] %s", epoch, vals)
+        _obs.emit("log", scope="epoch", epoch=epoch, loss=loss,
+                  metrics={m.get()[0]: m.get()[1]
+                           for m in estimator.train_metrics})
 
 
 class CheckpointHandler(EpochEnd):
@@ -326,6 +361,12 @@ class Estimator:
                     out = self.net(data)
                     loss = self.loss(out, label)
                 loss.backward()
+                if _obs.enabled():
+                    # the registry's train_loss gauge is what LoggingHandler
+                    # and the exporters report; one scalar sync per batch,
+                    # only when telemetry is armed
+                    _obs.gauge("train_loss").set(
+                        float(loss.mean().asnumpy()))
                 for h in handlers:
                     if isinstance(h, BatchEnd):
                         h.batch_end(self, batch=i, label=label, pred=out,

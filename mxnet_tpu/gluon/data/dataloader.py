@@ -10,9 +10,11 @@ compute, which is what the reference's ``PrefetcherIter`` did.
 from __future__ import annotations
 
 import multiprocessing as mp
+import time
 
 import numpy as np
 
+from ... import observability as _obs
 from ...ndarray import NDArray, array
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
@@ -92,13 +94,41 @@ class DataLoader:
         return len(self._batch_sampler)
 
     def __iter__(self):
+        # input-pipeline telemetry (docs/OBSERVABILITY.md): "wait" is the
+        # time this generator spends producing a ready device batch, and
+        # "compute" the time the consumer holds between yields. A stall is
+        # one iteration where the pipeline made the step loop wait longer
+        # than the step itself took — the input-bound signal.
+        obs_on = _obs.enabled()
+
+        def _note(wait, compute):
+            _obs.histogram("data_batch_wait_seconds",
+                           "time the step loop waited on the input pipeline",
+                           unit="s").observe(wait)
+            if compute is not None:
+                _obs.histogram("data_compute_seconds",
+                               "consumer time between batches",
+                               unit="s").observe(compute)
+                if wait > compute:
+                    _obs.counter("data_stalls_total",
+                                 "iterations where batch-wait exceeded "
+                                 "consumer compute").inc()
+                    _obs.emit("data_stall", wait_seconds=round(wait, 6),
+                              compute_seconds=round(compute, 6))
+
         if self._pool is None:
             prev = None  # 1-deep device prefetch: overlap H2D with consumption
+            compute = None
             for samples in self._batch_sampler:
+                t0 = time.perf_counter() if obs_on else 0.0
                 batch = _fetch_batch(self._dataset, samples, self._batchify_fn)
                 cur = _to_device(batch)
+                if obs_on:
+                    _note(time.perf_counter() - t0, compute)
                 if prev is not None:
+                    y0 = time.perf_counter() if obs_on else 0.0
                     yield prev
+                    compute = time.perf_counter() - y0 if obs_on else None
                 prev = cur
             if prev is not None:
                 yield prev
@@ -121,10 +151,17 @@ class DataLoader:
         for _ in range(self._prefetch or 1):
             if not issue():
                 break
+        compute = None
         while queue:
+            t0 = time.perf_counter() if obs_on else 0.0
             batch = queue.popleft().get()
             issue()
-            yield _to_device(batch)
+            dev = _to_device(batch)
+            if obs_on:
+                _note(time.perf_counter() - t0, compute)
+                y0 = time.perf_counter()
+            yield dev
+            compute = time.perf_counter() - y0 if obs_on else None
 
     def __del__(self):
         if self._pool is not None:

@@ -13,6 +13,9 @@ optimizer ops per batch (SURVEY §3.2). Here:
 """
 from __future__ import annotations
 
+import time
+
+from .. import observability as _obs
 from .. import optimizer as opt_mod
 from ..base import MXNetError
 from .parameter import Parameter, ParameterDict
@@ -49,6 +52,10 @@ class Trainer:
         self._preempt_guard = None
         self._preempt_save = None
         self._preempt_exit = True
+        # step callbacks (observability subsystem): monitors hooked in via
+        # Monitor.install(net, trainer=this) observe params/grads per step
+        self._monitors = []
+        self._obs_steps = 0
 
     @property
     def optimizer(self):
@@ -80,7 +87,18 @@ class Trainer:
                     grads.append(p.grad())
             self._kvstore.pushpull_batch(idx, grads)
 
+    def attach_monitor(self, mon):
+        """Register a :class:`~mxnet_tpu.monitor.Monitor` whose tic/toc run
+        around every ``step()`` (the wiring ``Monitor.install(net,
+        trainer=...)`` performs)."""
+        self._monitors.append(mon)
+        return mon
+
     def step(self, batch_size, ignore_stale_grad=False):
+        obs_on = _obs.enabled()
+        t0 = time.perf_counter() if obs_on else 0.0
+        for m in self._monitors:
+            m.tic()
         self._optimizer.rescale_grad = self._scale / batch_size
         self.allreduce_grads()
         scaler = getattr(self, "_amp_loss_scaler", None)
@@ -91,10 +109,28 @@ class Trainer:
             skip = scaler.has_overflow(self._params)
             scaler.update_scale(skip)
             if skip:
+                self._finish_step(obs_on, t0, batch_size, skipped=True)
                 self._check_preemption()
                 return
         self._update(ignore_stale_grad)
+        self._finish_step(obs_on, t0, batch_size)
         self._check_preemption()
+
+    def _finish_step(self, obs_on, t0, batch_size, skipped=False):
+        for m in self._monitors:
+            m.toc_print()
+        if not obs_on:
+            return
+        dt = time.perf_counter() - t0
+        self._obs_steps += 1
+        _obs.set_step(self._obs_steps)
+        _obs.histogram("train_step_seconds", "full train-step wall clock",
+                       unit="s").observe(dt, loop="trainer")
+        _obs.counter("train_steps_total").inc(loop="trainer")
+        _obs.counter("train_samples_total").inc(int(batch_size), loop="trainer")
+        if skipped:
+            _obs.counter("train_amp_skipped_steps_total",
+                         "steps dropped by AMP overflow handling").inc()
 
     # -- graceful preemption (docs/RESILIENCE.md) ----------------------------
     def install_preemption(self, save_fn, guard=None, exit_on_preempt=True):

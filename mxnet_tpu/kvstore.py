@@ -17,9 +17,12 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+import time
+
 import jax
 import jax.numpy as jnp
 
+from . import observability as _obs
 from .base import MXNetError
 from .ndarray import NDArray
 from .resilience import faults, retry
@@ -53,6 +56,8 @@ class KVStore:
         from .ndarray import sparse as _sp
 
         keys, values = self._normalize(key, value)
+        if _obs.enabled():
+            _obs.counter("kv_push_total").inc(len(keys), type=self.type)
         for k, v in zip(keys, values):
             # row_sparse pushes stay sparse end-to-end so the optimizer's
             # lazy row update path triggers (reference: KVStoreLocal::PushImpl
@@ -108,6 +113,8 @@ class KVStore:
         from .ndarray.sparse import BaseSparseNDArray
 
         keys, outs = self._normalize(key, out)
+        if _obs.enabled():
+            _obs.counter("kv_pull_total").inc(len(keys), type=self.type)
         for k, o in zip(keys, outs):
             if k not in self._store:
                 raise MXNetError(f"key {k} not initialized in kvstore")
@@ -281,6 +288,43 @@ def _transfer_dtype(dt):
     return dt
 
 
+def _instrumented_collective(op, arrays, call):
+    """Run ``call()`` (the retried DCN collective) with telemetry: latency
+    histogram, bytes-moved and call counters, per-transfer-dtype bucket
+    counts — the numbers XLA-side fusion makes invisible (SNIPPETS: DCN
+    psum cost dominates multi-host step time; without explicit timing it is
+    indistinguishable from compute)."""
+    import numpy as np
+
+    if not _obs.enabled():
+        return call()
+    t0 = time.perf_counter()
+    out = call()
+    dt = time.perf_counter() - t0
+    # bytes on the WIRE: the batched path widens low-precision floats to
+    # their f32 transfer dtype before the allgather, so f16/bf16 leaves
+    # move 4 bytes/element, not 2; the per-key path sends the source dtype
+    wire_dtype = _transfer_dtype if op == "psum_batch" else (lambda d: d)
+    nbytes = sum(int(a.size) * np.dtype(wire_dtype(a.dtype)).itemsize
+                 for a in arrays)
+    _obs.histogram("kv_psum_seconds", "DCN all-reduce wall clock",
+                   unit="s").observe(dt, op=op)
+    _obs.counter("kv_psum_calls_total").inc(op=op)
+    _obs.counter("kv_psum_bytes_total", unit="bytes").inc(nbytes, op=op)
+    if op == "psum_batch":
+        buckets = {}
+        for a in arrays:
+            tdt = _transfer_dtype(a.dtype)
+            buckets[str(tdt)] = buckets.get(str(tdt), 0) + 1
+        for dtype, n in buckets.items():
+            _obs.counter("kv_psum_dtype_buckets_total",
+                         "arrays per transfer-dtype bucket in batched "
+                         "all-reduces").inc(n, dtype=dtype)
+    _obs.emit("kv_psum", op=op, seconds=round(dt, 6), bytes=nbytes,
+              arrays=len(arrays))
+    return out
+
+
 def _dcn_psum_batch(raws):
     """Sum a LIST of arrays across processes with one allgather *per dtype
     bucket*: leaves sharing a transfer dtype are flattened into a single
@@ -320,7 +364,9 @@ def _dcn_psum_batch(raws):
                 off += n
         return out
 
-    return retry.retry_call(_gather, site="kv.dcn_psum_batch")
+    return _instrumented_collective(
+        "psum_batch", raws,
+        lambda: retry.retry_call(_gather, site="kv.dcn_psum_batch"))
 
 
 def _dcn_psum(x):
@@ -340,7 +386,9 @@ def _dcn_psum(x):
         gathered = multihost_utils.process_allgather(jnp.asarray(x))
         return jnp.sum(gathered, axis=0)
 
-    return retry.retry_call(_gather, site="kv.dcn_psum")
+    return _instrumented_collective(
+        "psum", [x],
+        lambda: retry.retry_call(_gather, site="kv.dcn_psum"))
 
 
 def create(name="local"):

@@ -32,8 +32,20 @@ class Monitor:
         self.activated = False
         self.queue: List[Tuple[int, str, float]] = []
 
-    def install(self, module_or_block):
+    def install(self, module_or_block, trainer=None, train_step=None):
+        """Set the observation target and (optionally) hook the monitor into
+        a training loop: ``trainer=`` registers a step callback on a
+        :class:`~mxnet_tpu.gluon.trainer.Trainer` (tic/toc run around every
+        ``step()``), ``train_step=`` on a
+        :class:`~mxnet_tpu.parallel.TrainStep` (params are synced out of the
+        compiled program at each interval boundary before observation).
+        Without either, the caller drives ``tic``/``toc`` manually as in the
+        reference API."""
         self._target = module_or_block
+        if trainer is not None:
+            trainer.attach_monitor(self)
+        if train_step is not None:
+            train_step.attach_monitor(self)
         return self
 
     def tic(self):
@@ -56,6 +68,12 @@ class Monitor:
                 data = p.data() if hasattr(p, "data") else p
                 self.queue.append((self.step, name,
                                    self.stat_func(np.asarray(data.asnumpy()))))
+                # no grad rows when observing a TrainStep: grads exist only
+                # inside its fused program (the Parameter buffers stay the
+                # init-time zeros — reporting those would read as dead
+                # gradients); train_grad_norm covers them instead
+                if getattr(self, "_skip_grads", False):
+                    continue
                 grad = getattr(p, "grad", None)
                 g = grad() if callable(grad) else grad
                 if g is not None:
@@ -63,6 +81,14 @@ class Monitor:
                                        self.stat_func(np.asarray(g.asnumpy()))))
         self.activated = False
         res = sorted(self.queue, key=lambda x: x[1]) if self.sort else list(self.queue)
+        # route stat rows through the structured event log (no-op unless
+        # telemetry is enabled) so monitor output lands next to step/comm
+        # metrics instead of only on stdout
+        from . import observability as _obs
+
+        for step, name, value in res:
+            _obs.emit("monitor_stat", tensor=name, value=float(value),
+                      monitor_step=step)
         return res
 
     def toc_print(self):

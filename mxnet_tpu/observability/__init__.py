@@ -1,0 +1,169 @@
+"""Unified telemetry subsystem (docs/OBSERVABILITY.md).
+
+Three pieces, one switch:
+
+  - ``metrics``  — process-wide registry of counters / gauges / histograms
+                   with labels; Prometheus-textfile + JSON exporters;
+  - ``events``   — structured JSONL event log (one writer, run-id / host /
+                   monotonic step envelope, size rotation);
+  - ``span``     — times a region into the ``span_seconds`` histogram AND
+                   forwards the name (+ current step) to
+                   ``jax.profiler.TraceAnnotation`` so wall-clock metrics
+                   and XPlane trace rows correlate by step id.
+
+The switch: hot-path instrumentation (TrainStep, KVStore collectives, the
+DataLoader) is gated on :func:`enabled` — a single module-global bool read,
+so telemetry-off overhead is one branch per call site. Low-frequency sites
+(retry attempts, checkpoint IO, profiler ``scope()``) always record into
+the registry: they are rare, and their counters must be trustworthy even
+when nobody asked for full telemetry (e.g. ``make chaos`` asserting retry
+counts).
+
+Enable via ``MXNET_TPU_TELEMETRY=1`` (+ ``MXNET_TPU_TELEMETRY_DIR``) or
+programmatically::
+
+    from mxnet_tpu import observability as obs
+    obs.enable("/tmp/run42")        # events-h0.jsonl + metrics.json on exit
+    ...train...
+    obs.shutdown()                  # flush metrics.json / metrics.prom
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from . import events  # noqa: F401
+from . import metrics  # noqa: F401
+from .events import emit, read_events, set_step  # noqa: F401
+from .metrics import REGISTRY, counter, gauge, histogram  # noqa: F401
+
+__all__ = ["metrics", "events", "REGISTRY", "counter", "gauge", "histogram",
+           "emit", "set_step", "read_events", "enabled", "enable", "disable",
+           "shutdown", "span", "timed_region", "telemetry_dir",
+           "throughput_delta"]
+
+
+def throughput_delta(prev):
+    """samples/sec from the registry's step telemetry since ``prev``.
+
+    The one shared throughput calculation every console reporter uses
+    (``Speedometer``, estimator ``LoggingHandler``), so they can never
+    drift from each other or from the exporters. Returns ``(speed, state)``
+    — pass ``state`` back as ``prev`` on the next call; ``speed`` is None
+    until two calls bracket new step telemetry.
+    """
+    c = REGISTRY.get("train_samples_total")
+    h = REGISTRY.get("train_step_seconds")
+    if c is None or h is None:
+        return None, prev
+    cur = (c.total(), h.total_sum())
+    if prev is None:
+        return None, cur
+    ds, dt = cur[0] - prev[0], cur[1] - prev[1]
+    return (ds / dt if ds > 0 and dt > 0 else None), cur
+
+_enabled: Optional[bool] = None  # tri-state: None = not yet resolved from config
+_dir: Optional[str] = None
+_atexit_registered = False
+
+
+def enabled() -> bool:
+    """Fast gate for hot-path instrumentation (one global read after the
+    first call resolves the ``MXNET_TPU_TELEMETRY`` config knob)."""
+    global _enabled
+    if _enabled is None:
+        from .. import config
+
+        if config.get("telemetry"):
+            enable()
+        else:
+            _enabled = False
+    return _enabled
+
+
+def telemetry_dir() -> Optional[str]:
+    return _dir
+
+
+def enable(directory: Optional[str] = None, run_id: Optional[str] = None) -> str:
+    """Turn telemetry on: open the per-host event log under ``directory``
+    (default: the ``telemetry_dir`` config knob) and arrange for
+    ``metrics.json`` / ``metrics.prom`` to be written at :func:`shutdown`
+    (also registered atexit). Returns the run directory."""
+    global _enabled, _dir, _atexit_registered
+    from .. import config
+
+    _dir = os.path.abspath(directory or config.get("telemetry_dir"))
+    os.makedirs(_dir, exist_ok=True)
+    host = events._host_index()
+    events.LOG.configure(
+        os.path.join(_dir, f"events-h{host}.jsonl"), run_id=run_id,
+        rotate_bytes=config.get("telemetry_rotate_mb") * 1024 * 1024)
+    _enabled = True
+    if not _atexit_registered:
+        atexit.register(shutdown)
+        _atexit_registered = True
+    events.emit("telemetry_enabled", dir=_dir)
+    return _dir
+
+
+def disable() -> None:
+    """Turn the hot-path gate off and close the event log (registry content
+    is kept — counters survive an enable/disable cycle)."""
+    global _enabled
+    _enabled = False
+    events.LOG.close()
+
+
+def shutdown() -> None:
+    """Flush exporters into the run directory and close the event log.
+    Idempotent; registered atexit by :func:`enable`."""
+    if _dir is None:
+        return
+    host = events._host_index()
+    suffix = f"-h{host}" if host else ""
+    try:
+        REGISTRY.write_json(os.path.join(_dir, f"metrics{suffix}.json"))
+        REGISTRY.write_prometheus(os.path.join(_dir, f"metrics{suffix}.prom"))
+    except OSError:
+        pass
+    events.LOG.close()
+
+
+@contextmanager
+def timed_region(metric_name: str, help: str, name: str, **labels):
+    """Always-on core of :func:`span` (and ``profiler.scope``): time a
+    region into ``metric_name``'s histogram under a
+    ``jax.profiler.TraceAnnotation`` carrying the current step id.
+    Exception-safe — the sample records even when the body raises."""
+    import jax
+
+    step = events.current_step()
+    try:
+        ann = jax.profiler.TraceAnnotation(name, step=step)
+    except TypeError:  # older jax: no metadata kwargs
+        ann = jax.profiler.TraceAnnotation(name)
+    with ann:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            histogram(metric_name, help,
+                      unit="s").observe(time.perf_counter() - t0, **labels)
+
+
+@contextmanager
+def span(name: str, **labels):
+    """Time a region into ``span_seconds{span=name,...}`` and annotate the
+    XPlane trace with the same name + current step id, so a slow span found
+    in metrics can be located in the TensorBoard/Perfetto timeline (and
+    vice versa). No-op (one bool check) when telemetry is off."""
+    if not enabled():
+        yield
+        return
+    with timed_region("span_seconds", "obs.span region wall-clock", name,
+                      span=name, **labels):
+        yield

@@ -1,0 +1,188 @@
+"""Structured JSONL event log — one writer per process, rotation, stable
+schema.
+
+Every record is one JSON object per line with a fixed envelope::
+
+    {"ts": <unix seconds>, "run": "<run id>", "host": <process index>,
+     "step": <monotonic step>, "event": "<name>", ...payload...}
+
+``run`` is shared by every host of one training run (derived from time+pid
+on host 0 semantics are fine for single-controller runs; multi-host runs
+pass an explicit run id). ``step`` is whatever the step loop last declared
+via :func:`set_step` unless the emitter overrides it, so asynchronous
+emitters (DataLoader workers, checkpoint IO) land on the training step they
+belong to and can be correlated with the XPlane trace rows annotated by
+``obs.span``.
+
+Rotation: when the active file exceeds ``rotate_bytes`` the writer renames
+it to ``<path>.1`` (replacing any previous ``.1``) and reopens — bounded
+disk, two files max, and :func:`read_events` transparently reads both in
+order.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Iterator, List, Optional
+
+__all__ = ["EventLog", "LOG", "emit", "set_step", "configure", "close",
+           "read_events", "current_step"]
+
+
+def _host_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class EventLog:
+    def __init__(self):
+        self._fh = None
+        self._path: Optional[str] = None
+        self._run_id: Optional[str] = None
+        self._rotate_bytes = 64 * 1024 * 1024
+        self._step = 0
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+    def configure(self, path: str, run_id: Optional[str] = None,
+                  rotate_bytes: Optional[int] = None) -> "EventLog":
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._path = path
+            self._fh = open(path, "a", buffering=1)  # line-buffered
+            self._run_id = run_id or f"{int(time.time())}-{os.getpid()}"
+            if rotate_bytes is not None:
+                self._rotate_bytes = int(rotate_bytes)
+        return self
+
+    @property
+    def configured(self) -> bool:
+        return self._fh is not None
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    @property
+    def run_id(self) -> Optional[str]:
+        return self._run_id
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    # -- write path ----------------------------------------------------------
+    def set_step(self, step: int) -> None:
+        self._step = int(step)
+
+    def current_step(self) -> int:
+        return self._step
+
+    def emit(self, event: str, **fields) -> bool:
+        """Write one record; returns False (and is a near-no-op) when the
+        log was never configured — call sites don't need their own guard."""
+        if self._fh is None:
+            return False
+        step = fields.pop("step", None)
+        rec = {"ts": round(time.time(), 6), "run": self._run_id,
+               "host": _host_index(),
+               "step": self._step if step is None else int(step),
+               "event": event}
+        rec.update(fields)
+        line = json.dumps(rec, default=_json_fallback)
+        with self._lock:
+            if self._fh is None:
+                return False
+            try:
+                self._fh.write(line + "\n")
+                self._maybe_rotate()
+            except (OSError, ValueError):
+                # telemetry must NEVER fail the train loop: on a dead disk/
+                # deleted dir, drop the log and keep training (metrics — in
+                # memory — survive)
+                try:
+                    self._fh.close()
+                except Exception:
+                    pass
+                self._fh = None
+                import logging
+
+                logging.getLogger("mxnet_tpu.observability").warning(
+                    "event log %s unwritable; disabling event emission",
+                    self._path)
+                return False
+        return True
+
+    def _maybe_rotate(self) -> None:
+        if self._fh.tell() < self._rotate_bytes:
+            return
+        try:
+            self._fh.close()
+            os.replace(self._path, self._path + ".1")
+        finally:
+            # reopen even if the rename failed (truncation beats a closed
+            # handle); a reopen failure propagates to emit()'s guard above
+            self._fh = open(self._path, "a", buffering=1)
+
+
+def _json_fallback(o):
+    try:
+        return float(o)  # jax/numpy scalars
+    except Exception:
+        return str(o)
+
+
+def read_events(path: str) -> List[dict]:
+    """Read every record from ``path`` (including its ``.1`` rotation
+    predecessor, oldest first). ``path`` may also be a directory, in which
+    case every ``events*.jsonl`` file under it is read (multi-host runs
+    write one file per host)."""
+    if os.path.isdir(path):
+        files: List[str] = []
+        for name in sorted(os.listdir(path)):
+            if name.startswith("events") and name.endswith(".jsonl.1"):
+                files.append(os.path.join(path, name))
+        for name in sorted(os.listdir(path)):
+            if name.startswith("events") and name.endswith(".jsonl"):
+                files.append(os.path.join(path, name))
+    else:
+        files = ([path + ".1"] if os.path.exists(path + ".1") else []) + [path]
+    out: List[dict] = []
+    for p in files:
+        try:
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn final line after a crash
+        except OSError:
+            continue
+    return out
+
+
+def iter_events(path: str) -> Iterator[dict]:
+    yield from read_events(path)
+
+
+#: the process-wide default event log
+LOG = EventLog()
+
+emit = LOG.emit
+set_step = LOG.set_step
+current_step = LOG.current_step
+configure = LOG.configure
+close = LOG.close

@@ -12,12 +12,14 @@ machinery, and updated parameters are written back on request (``sync``).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import observability as _obs
 from .. import random as _rng
 from ..gluon.block import _HybridTrace
 from ..ndarray import NDArray
@@ -103,11 +105,18 @@ class TrainStep:
         self._preempt_guard = None
         self._preempt_dir = None
         self._preempt_exit = True
-        # jit cache keyed on (batch arity, resolved lr/wd multipliers): the
-        # in_shardings tuple built by _make_step depends on how many batch
-        # arrays the call passes, and the multipliers fold into the program
-        # as constants, so either changing needs its own jitted program
+        # jit cache keyed on (batch arity, resolved lr/wd multipliers,
+        # telemetry flag): the in_shardings tuple built by _make_step depends
+        # on how many batch arrays the call passes, the multipliers fold into
+        # the program as constants, and telemetry adds a grad-norm output —
+        # any of them changing needs its own jitted program
         self._compiled: Dict[tuple, Callable] = {}
+        # recompile detection (observability): every (program key, batch
+        # shapes/dtypes) signature seen so far — a miss means XLA is about to
+        # lower+compile a new executable, which fused execution otherwise
+        # hides completely
+        self._program_sigs: set = set()
+        self._monitors: list = []
 
     # -- functional loss -----------------------------------------------------
     def _loss_of(self, params: Dict[str, jax.Array], batch, key):
@@ -143,7 +152,7 @@ class TrainStep:
             wd_mult[p.name] = wm * float(opt.wd_mult.get(p.name, 1.0))
         return lr_mult, wd_mult
 
-    def _make_step(self, n_batch):
+    def _make_step(self, n_batch, with_gnorm=False):
         opt = self.optimizer
         lr_mult, wd_mult = self._resolve_mults()
 
@@ -174,6 +183,12 @@ class TrainStep:
                                         wd * wd_mult.get(name, 1.0), t)
                 new_params[name] = nw
                 new_state[name] = ns
+            if with_gnorm:
+                # global grad-norm for telemetry: a handful of fused reduces,
+                # compiled into the same program only when telemetry is on
+                gsq = sum(jnp.sum(jnp.square(grads[n].astype(jnp.float32)))
+                          for n in opt_state)
+                return new_params, new_state, t, loss, jnp.sqrt(gsq)
             return new_params, new_state, t, loss
 
         donate = (0, 1) if self.donate else ()
@@ -199,6 +214,8 @@ class TrainStep:
                 NamedSharding(self.mesh, P()),
                 NamedSharding(self.mesh, P()),
             )
+            if with_gnorm:
+                out_shardings = out_shardings + (NamedSharding(self.mesh, P()),)
             return jax.jit(step, donate_argnums=donate,
                            in_shardings=in_shardings,
                            out_shardings=out_shardings)
@@ -207,6 +224,8 @@ class TrainStep:
     # -- public API ----------------------------------------------------------
     def __call__(self, *batch):
         """Run one step. batch = (x, label, ...) as NDArray/jax arrays."""
+        obs_on = _obs.enabled()
+        t0 = time.perf_counter() if obs_on else 0.0
         raws = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b) for b in batch)
         if self.batch_sharding is not None:
             raws = tuple(jax.device_put(r, self.batch_sharding) for r in raws)
@@ -217,19 +236,97 @@ class TrainStep:
         lr_mult, wd_mult = self._resolve_mults()
         cache_key = (len(raws),
                      tuple(sorted(lr_mult.items())),
-                     tuple(sorted(wd_mult.items())))
+                     tuple(sorted(wd_mult.items())),
+                     obs_on)
+        if obs_on:
+            # signatures seen while telemetry was off DO recompile once it
+            # flips on (the gnorm output changes the program), so counting
+            # only enabled-mode misses stays truthful
+            self._note_recompile(cache_key, raws)
         step = self._compiled.get(cache_key)
         if step is None:
-            step = self._compiled[cache_key] = self._make_step(len(raws))
+            step = self._compiled[cache_key] = self._make_step(
+                len(raws), with_gnorm=obs_on)
         key = _rng.next_key()
         lr = jnp.float32(self.optimizer.learning_rate)
         wd = jnp.float32(self.optimizer.wd)
-        self.params, self.opt_state, self.step_count, loss = step(
-            self.params, self.opt_state, self.step_count, raws, key, lr, wd)
+        gnorm = None
+        if obs_on:
+            (self.params, self.opt_state, self.step_count, loss,
+             gnorm) = step(self.params, self.opt_state, self.step_count,
+                           raws, key, lr, wd)
+        else:
+            self.params, self.opt_state, self.step_count, loss = step(
+                self.params, self.opt_state, self.step_count, raws, key, lr, wd)
         # host-side mirror (no device sync — loss is returned as a future)
         self.optimizer.num_update += 1
+        if obs_on:
+            self._record_step(t0, raws, loss, gnorm)
+        self._run_monitors()
         self._check_preemption()
         return loss
+
+    # -- telemetry (docs/OBSERVABILITY.md) -----------------------------------
+    def _note_recompile(self, cache_key, raws):
+        """Count lowered-program cache misses: jax.jit recompiles silently
+        on any new (arity, shape, dtype, folded-constant) signature; under
+        fusion that cost is invisible without this counter."""
+        sig = (cache_key[:3],
+               tuple((tuple(r.shape), str(r.dtype)) for r in raws))
+        if sig in self._program_sigs:
+            return
+        first = not self._program_sigs
+        reason = "first" if first else (
+            "shape" if any(s[0] == sig[0] for s in self._program_sigs)
+            else "hyperparams")
+        self._program_sigs.add(sig)
+        _obs.counter("train_recompiles_total",
+                     "TrainStep program lowerings (cache misses)").inc(
+                         reason=reason)
+        _obs.emit("recompile", reason=reason,
+                  shapes=[list(r.shape) for r in raws],
+                  dtypes=[str(r.dtype) for r in raws])
+
+    def _record_step(self, t0, raws, loss, gnorm):
+        # reading loss/gnorm blocks on the device — when telemetry is on,
+        # step time is the real wall-clock of the whole step, not dispatch
+        loss_f = float(jax.device_get(loss))
+        gnorm_f = float(jax.device_get(gnorm)) if gnorm is not None else None
+        dt = time.perf_counter() - t0
+        step_no = int(self.optimizer.num_update)
+        _obs.set_step(step_no)
+        samples = int(raws[0].shape[0]) if raws and getattr(raws[0], "ndim", 0) else 1
+        tokens = int(raws[0].size) if raws else 0
+        _obs.histogram("train_step_seconds", "full train-step wall clock",
+                       unit="s").observe(dt, loop="train_step")
+        _obs.counter("train_steps_total").inc(loop="train_step")
+        _obs.counter("train_samples_total").inc(samples, loop="train_step")
+        _obs.counter("train_tokens_total").inc(tokens, loop="train_step")
+        _obs.gauge("train_tokens_per_sec", unit="tokens/s").set(
+            tokens / dt if dt > 0 else 0.0)
+        _obs.gauge("train_loss").set(loss_f)
+        if gnorm_f is not None:
+            _obs.gauge("train_grad_norm").set(gnorm_f)
+        _obs.emit("train_step", loss=loss_f, grad_norm=gnorm_f,
+                  step_seconds=round(dt, 6), samples=samples, tokens=tokens,
+                  tokens_per_sec=round(tokens / dt, 3) if dt > 0 else 0.0)
+
+    def attach_monitor(self, mon):
+        """Register a :class:`~mxnet_tpu.monitor.Monitor`: at each step's
+        interval boundary the compiled-side params are synced back into the
+        Gluon block and the monitor's stat function observes them (grads
+        live only inside the fused program and are summarized by the
+        ``train_grad_norm`` gauge instead)."""
+        mon._skip_grads = True  # Parameter grad buffers are stale here
+        self._monitors.append(mon)
+        return mon
+
+    def _run_monitors(self):
+        for m in self._monitors:
+            m.tic()
+            if m.activated:
+                self.sync()
+            m.toc_print()
 
     # -- graceful preemption (docs/RESILIENCE.md) ----------------------------
     def install_preemption(self, directory: str, guard=None,

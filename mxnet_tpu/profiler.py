@@ -8,6 +8,7 @@ mapped onto ``jax.profiler`` traces + named annotations.
 """
 from __future__ import annotations
 
+import logging
 import os
 import time
 from contextlib import contextmanager
@@ -16,7 +17,14 @@ import jax
 
 __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume", "scope", "Profiler"]
 
-_state = {"running": False, "dir": "/tmp/mxnet_tpu_profile", "aggregate": {}}
+logger = logging.getLogger("mxnet_tpu.profiler")
+
+_state = {"running": False, "dir": "/tmp/mxnet_tpu_profile", "ever_ran": False}
+
+# python-side scope() aggregates live in the observability metrics registry
+# (one source of numeric truth — docs/OBSERVABILITY.md); this is the metric
+# name dumps() reads and reset clears
+_SCOPE_METRIC = "profiler_scope_seconds"
 
 
 def set_config(filename=None, profile_all=False, profile_symbolic=True,
@@ -28,12 +36,31 @@ def set_config(filename=None, profile_all=False, profile_symbolic=True,
 
 
 def set_state(state="stop", profile_process="worker"):
-    if state == "run" and not _state["running"]:
-        jax.profiler.start_trace(_state["dir"])
+    """Start/stop the jax trace session. Idempotent-safe: a second
+    ``set_state("run")`` is a no-op, and a session jax reports as already
+    active (e.g. started by other code) is adopted instead of crashing —
+    our matching ``stop`` then closes it rather than leaking it. Any other
+    start failure (unwritable dir, ...) propagates."""
+    if state == "run":
+        if _state["running"]:
+            return
+        try:
+            jax.profiler.start_trace(_state["dir"])
+        except Exception as e:
+            if "already" not in str(e).lower():
+                raise
+            # a live session we lost track of: adopt it
+            logger.warning("start_trace: %s; adopting the active session", e)
         _state["running"] = True
+        _state["ever_ran"] = True
         _state["t0"] = time.time()
-    elif state == "stop" and _state["running"]:
-        jax.profiler.stop_trace()
+    elif state == "stop":
+        if not _state["running"]:
+            return
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # session already closed elsewhere: just untrack
+            logger.warning("stop_trace failed (%s); marking stopped", e)
         _state["running"] = False
 
 
@@ -46,9 +73,12 @@ def resume(profile_process="worker"):
 
 
 def dump(finished=True, profile_process="worker"):
+    """Finish the active session and return the trace directory — or None
+    when no trace was ever started (previously this returned the configured
+    dir regardless, so callers mistook 'no data' for a usable dump)."""
     if _state["running"]:
         set_state("stop")
-    return _state["dir"]
+    return _state["dir"] if _state["ever_ran"] else None
 
 
 def _aggregate_xplane(dump_dir):
@@ -112,32 +142,38 @@ def dumps(reset=False):
     trace with the Python-side ``scope()`` aggregates. Columns match the
     reference: Name, Total Count, Time total/avg/min/max (ms).
     """
+    from .observability import REGISTRY
+
     header = f"{'Name':<48} {'Count':>8} {'Total(ms)':>12} {'Avg(ms)':>10} {'Min(ms)':>10} {'Max(ms)':>10}"
     lines = ["Profile Statistics", header, "-" * len(header)]
     rows = []
     for name, (count, total_ns, mn, mx) in _aggregate_xplane(_state["dir"]).items():
         rows.append((name, count, total_ns / 1e6, total_ns / 1e6 / count,
                      mn / 1e6, mx / 1e6))
-    for name, (count, total) in _state["aggregate"].items():
-        t_ms = total * 1e3
-        rows.append((f"scope:{name}", count, t_ms, t_ms / count, t_ms / count,
-                     t_ms / count))
+    hist = REGISTRY.get(_SCOPE_METRIC)
+    if hist is not None:
+        for labels, s in hist.series():
+            if not s["count"]:
+                continue
+            t_ms = s["sum"] * 1e3
+            rows.append((f"scope:{labels.get('scope', '?')}", s["count"], t_ms,
+                         t_ms / s["count"], s["min"] * 1e3, s["max"] * 1e3))
     rows.sort(key=lambda r: -r[2])
     for name, count, tot, avg, mn, mx in rows:
         lines.append(f"{name[:48]:<48} {count:>8} {tot:>12.3f} {avg:>10.3f} "
                      f"{mn:>10.3f} {mx:>10.3f}")
     if reset:
-        _state["aggregate"] = {}
+        REGISTRY.reset(_SCOPE_METRIC)
     return "\n".join(lines)
 
 
 @contextmanager
 def scope(name="<unk>:"):
-    with jax.profiler.TraceAnnotation(name):
-        t0 = time.time()
+    from .observability import timed_region
+
+    with timed_region(_SCOPE_METRIC, "profiler.scope() region wall-clock",
+                      name, scope=name):
         yield
-        c, t = _state["aggregate"].get(name, (0, 0.0))
-        _state["aggregate"][name] = (c + 1, t + time.time() - t0)
 
 
 annotate = scope

@@ -104,6 +104,17 @@ def _record(site: str, rec: dict) -> None:
     h.append(rec)
     if len(h) > _HISTORY_CAP:
         del h[:-_HISTORY_CAP]
+    # observability bridge: every attempt also lands in the process-wide
+    # metrics registry (labels: site, ok), so per-site retry counters are
+    # aggregated alongside step/comm/ckpt metrics instead of living only in
+    # this module's history list. Always on — retries are rare and the
+    # counters must be trustworthy even without full telemetry (make chaos
+    # asserts them).
+    from .. import observability as _obs
+
+    _obs.counter("retry_attempts_total",
+                 "retry_call attempts per fault site").inc(
+                     site=site, ok="true" if rec["ok"] else "false")
 
 
 def retry_call(fn: Callable, site: str, policy: Optional[RetryPolicy] = None):

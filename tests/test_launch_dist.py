@@ -104,6 +104,26 @@ _CHILD4 = textwrap.dedent("""
     multihost_utils.process_allgather = orig_ag
     assert len(calls) == 1, f"expected 1 collective for 4 params, got {len(calls)}"
 
+    # --- 6. observability: KVStore byte/latency metrics on the REAL
+    # multi-process DCN path (ISSUE 2 acceptance) --------------------------
+    from mxnet_tpu import observability as obs
+    obs.enable(os.path.join(os.environ["OBS_DIR"]))
+    kv.push("w", nd.full((4,), float(rank + 1)))
+    out = nd.zeros((4,))
+    kv.pull("w", out=out)
+    lat = obs.REGISTRY.get("kv_psum_seconds")
+    assert lat is not None and lat.stats(op="psum")["count"] >= 1
+    assert lat.stats(op="psum")["sum"] > 0
+    assert obs.REGISTRY.get("kv_psum_bytes_total").value(op="psum") == 16  # 4xf32
+    # the batched Trainer path again, instrumented this time
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(2)
+    assert lat.stats(op="psum_batch")["count"] >= 1
+    assert obs.REGISTRY.get("kv_psum_dtype_buckets_total").value(dtype="float32") == 4
+    obs.shutdown()
+
     print(f"RANK{rank}-OK4", flush=True)
 """)
 
@@ -123,6 +143,7 @@ def test_four_process_dist_matrix(tmp_path):
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
     env["PYTHONPATH"] = repo_root
+    env["OBS_DIR"] = str(tmp_path / "obs")
     res = subprocess.run(
         [sys.executable, "tools/launch.py", "-n", "4", sys.executable, str(child)],
         capture_output=True, text=True, timeout=290, env=env, cwd=repo_root)
