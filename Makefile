@@ -16,7 +16,7 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity native fast slow test chaos obs bench clean
+.PHONY: ci sanity native fast slow test chaos obs perfwin bench clean
 
 ci: sanity native fast
 
@@ -48,6 +48,13 @@ chaos: native
 # checkpoint durations, and retry counters that match attempt_log
 obs: native
 	$(PY) tools/obs_smoke.py
+
+# fused multi-step window gate (docs/PERFORMANCE.md): CPU dry-run of the
+# compiled k-step scan window on a LeNet — asserts ONE window lowering,
+# prefetch queue metrics armed, and amortized per-step time strictly below
+# the single-step path; artifact committed as BENCH_r06.json
+perfwin: native
+	$(PY) tools/benchall.py --window 4 --out BENCH_r06.json
 
 test: sanity native
 	$(PY) -m pytest tests/ -q

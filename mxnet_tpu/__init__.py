@@ -46,6 +46,9 @@ from . import callback  # noqa: F401
 from . import contrib  # noqa: F401
 from . import image  # noqa: F401
 from . import config  # noqa: F401
+
+config.apply_compile_cache()  # MXNET_TPU_COMPILE_CACHE: persistent XLA cache
+
 from . import observability  # noqa: F401
 from . import observability as obs  # noqa: F401
 from . import resilience  # noqa: F401

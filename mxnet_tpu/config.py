@@ -11,7 +11,7 @@ from __future__ import annotations
 import os
 from typing import Any, Dict
 
-__all__ = ["get", "set", "knobs", "describe"]
+__all__ = ["get", "set", "knobs", "describe", "apply_compile_cache"]
 
 # name -> (type, default, env aliases, doc)
 _KNOBS: Dict[str, tuple] = {
@@ -71,6 +71,13 @@ _KNOBS: Dict[str, tuple] = {
     "ckpt_keep_last": (int, 0, ("MXNET_TPU_CKPT_KEEP_LAST",),
                        "retention sweep after each save_train_state: keep "
                        "the newest N committed checkpoints (0 = keep all)"),
+    # -- compilation (docs/PERFORMANCE.md) -----------------------------------
+    "compile_cache": (str, "", ("MXNET_TPU_COMPILE_CACHE",),
+                      "persistent XLA compilation-cache directory "
+                      "(jax_compilation_cache_dir), honored at import: "
+                      "re-runs skip lowering+compile for every already-seen "
+                      "program signature, including the k-step window "
+                      "programs; empty = disabled"),
     # -- observability subsystem (docs/OBSERVABILITY.md) ---------------------
     "telemetry": (bool, False, ("MXNET_TPU_TELEMETRY",),
                   "arm hot-path telemetry at first use: step/comm/data/ckpt "
@@ -115,3 +122,34 @@ def knobs():
 def describe(name: str) -> str:
     typ, default, envs, doc = _KNOBS[name]
     return f"{name} ({typ.__name__}, default={default!r}, env={'/'.join(envs)}): {doc}"
+
+
+def apply_compile_cache():
+    """Honor ``MXNET_TPU_COMPILE_CACHE`` at init: point jax's persistent
+    compilation cache at the directory so a restarted run pays zero XLA
+    compile time for every program signature it has seen before (the
+    single-step programs AND the per-(window, shapes) scan windows).
+    Called from package import; returns the applied directory or None."""
+    d = get("compile_cache")
+    if not d:
+        return None
+    import warnings
+
+    import jax
+
+    d = os.path.abspath(d)
+    try:
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+    except (OSError, AttributeError) as e:
+        warnings.warn(f"MXNET_TPU_COMPILE_CACHE={d!r} not applied: {e}")
+        return None
+    # cache tiny/fast programs too — the CI dry-runs and unit meshes are
+    # exactly the programs worth skipping on the next run
+    for knob, v in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                    ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(knob, v)
+        except Exception:  # older jax: knob absent
+            pass
+    return d

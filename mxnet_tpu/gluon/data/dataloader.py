@@ -45,16 +45,26 @@ def _worker_init(dataset):
     _worker_dataset = dataset
 
 
+_retry_policy = None
+
+
 def _fetch_batch(dataset, samples, batchify_fn):
     """One batch fetch+batchify — fault site ``data.batch`` under the retry
-    policy, so a flaky storage read costs a retry instead of the epoch."""
+    policy, so a flaky storage read costs a retry instead of the epoch.
+    The policy object is built once per process: per-batch construction
+    re-reads six config knobs and seeds a fresh RNG from os.urandom, pure
+    fixed overhead on the input hot path."""
+    global _retry_policy
     from ...resilience import faults, retry
+
+    if _retry_policy is None:
+        _retry_policy = retry.RetryPolicy()
 
     def _fetch():
         faults.fire("data.batch")
         return batchify_fn([dataset[i] for i in samples])
 
-    return retry.retry_call(_fetch, site="data.batch")
+    return retry.retry_call(_fetch, site="data.batch", policy=_retry_policy)
 
 
 def _worker_fn(samples, batchify_fn):
@@ -93,6 +103,37 @@ class DataLoader:
     def __len__(self):
         return len(self._batch_sampler)
 
+    def host_batches(self):
+        """Host-side (numpy) batch stream, no device placement — the feed
+        for :meth:`prefetch_to_device`, whose background thread does the
+        sharded ``device_put`` + window stacking off the hot path."""
+        if self._pool is None:
+            for samples in self._batch_sampler:
+                yield _fetch_batch(self._dataset, samples, self._batchify_fn)
+            return
+
+        # async pool pipeline with bounded in-flight requests
+        import collections
+
+        queue = collections.deque()
+        it = iter(self._batch_sampler)
+
+        def issue():
+            try:
+                samples = next(it)
+            except StopIteration:
+                return False
+            queue.append(self._pool.apply_async(_worker_fn, (samples, self._batchify_fn)))
+            return True
+
+        for _ in range(self._prefetch or 1):
+            if not issue():
+                break
+        while queue:
+            batch = queue.popleft().get()
+            issue()
+            yield batch
+
     def __iter__(self):
         # input-pipeline telemetry (docs/OBSERVABILITY.md): "wait" is the
         # time this generator spends producing a ready device batch, and
@@ -116,52 +157,35 @@ class DataLoader:
                     _obs.emit("data_stall", wait_seconds=round(wait, 6),
                               compute_seconds=round(compute, 6))
 
-        if self._pool is None:
-            prev = None  # 1-deep device prefetch: overlap H2D with consumption
-            compute = None
-            for samples in self._batch_sampler:
-                t0 = time.perf_counter() if obs_on else 0.0
-                batch = _fetch_batch(self._dataset, samples, self._batchify_fn)
-                cur = _to_device(batch)
-                if obs_on:
-                    _note(time.perf_counter() - t0, compute)
-                if prev is not None:
-                    y0 = time.perf_counter() if obs_on else 0.0
-                    yield prev
-                    compute = time.perf_counter() - y0 if obs_on else None
-                prev = cur
-            if prev is not None:
-                yield prev
-            return
-
-        # async pool pipeline with bounded in-flight requests
-        import collections
-
-        queue = collections.deque()
-        it = iter(self._batch_sampler)
-
-        def issue():
-            try:
-                samples = next(it)
-            except StopIteration:
-                return False
-            queue.append(self._pool.apply_async(_worker_fn, (samples, self._batchify_fn)))
-            return True
-
-        for _ in range(self._prefetch or 1):
-            if not issue():
-                break
+        prev = None  # 1-deep device prefetch: overlap H2D with consumption
         compute = None
-        while queue:
+        src = self.host_batches()
+        while True:
             t0 = time.perf_counter() if obs_on else 0.0
-            batch = queue.popleft().get()
-            issue()
-            dev = _to_device(batch)
+            try:
+                batch = next(src)
+            except StopIteration:
+                break
+            cur = _to_device(batch)
             if obs_on:
                 _note(time.perf_counter() - t0, compute)
-                y0 = time.perf_counter()
-            yield dev
-            compute = time.perf_counter() - y0 if obs_on else None
+            if prev is not None:
+                y0 = time.perf_counter() if obs_on else 0.0
+                yield prev
+                compute = time.perf_counter() - y0 if obs_on else None
+            prev = cur
+        if prev is not None:
+            yield prev
+
+    def prefetch_to_device(self, train_step=None, window=1, accum=1, depth=2):
+        """Feed a ``TrainStep`` without per-step ``device_put`` on the
+        caller thread: worker batches stay numpy, and the prefetch thread
+        does the sharded placement + ``window`` stacking for the compiled
+        k-step scan window (``TrainStep.run``; docs/PERFORMANCE.md)."""
+        from ...io.prefetch import DevicePrefetcher
+
+        return DevicePrefetcher(self.host_batches(), train_step=train_step,
+                                window=window, accum=accum, depth=depth)
 
     def __del__(self):
         if self._pool is not None:

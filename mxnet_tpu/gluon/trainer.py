@@ -56,6 +56,8 @@ class Trainer:
         # Monitor.install(net, trainer=this) observe params/grads per step
         self._monitors = []
         self._obs_steps = 0
+        # fused multi-step path (run()): lazily-built TrainStep, cached per net
+        self._fused = None
 
     @property
     def optimizer(self):
@@ -164,6 +166,77 @@ class Trainer:
 
     def update(self, batch_size, ignore_stale_grad=False):
         self.step(batch_size, ignore_stale_grad)
+
+    # -- fused multi-step training (docs/PERFORMANCE.md) ---------------------
+    def run(self, net, loss_fn, data_iter, steps=None, window=None,
+            accum=None, mesh=None, rules=None, n_model_inputs=1):
+        """Compiled k-step training windows over this trainer's optimizer.
+
+        Builds (and caches) a :class:`~mxnet_tpu.parallel.TrainStep` for
+        ``net`` sharing this trainer's optimizer, seeds it from any
+        imperative optimizer states accumulated via :meth:`step`, and
+        delegates to ``TrainStep.run`` — one jitted XLA program (a
+        ``lax.scan`` of fwd+bwd+update) and one host sync per ``window``
+        steps. Afterwards the updated params are synced back into ``net``
+        and this trainer's per-parameter states are refreshed, so
+        imperative ``step()`` and fused ``run()`` can be interleaved.
+
+        Returns the stacked per-step losses (device future).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from ..parallel.train_step import TrainStep
+
+        ts = None
+        sig = (net, loss_fn, mesh, rules, n_model_inputs)
+        if self._fused is not None and all(
+                a is b for a, b in zip(self._fused[0], sig)):
+            ts = self._fused[1]
+        if ts is None:
+            self._ensure_states()
+            ts = TrainStep(net, loss_fn, self._optimizer, mesh=mesh,
+                           rules=rules, n_model_inputs=n_model_inputs)
+            self._fused = (sig, ts)
+        # re-seed the fused side from the imperative state EVERY call:
+        # interleaved step()s replace p._nd._data and self._states, and a
+        # cached TrainStep would otherwise train on (and sync back) stale
+        # copies taken at construction time
+        params = {p.name: p._nd._data for p in ts._plist}
+        if ts.param_sharding is not None:
+            params = {k: jax.device_put(v, ts.param_sharding[k])
+                      for k, v in params.items()}
+        ts.params = params
+        for i, p in enumerate(self._params):
+            if self._states_created[i] and p.name in ts.opt_state \
+                    and self._states[i] is not None:
+                ts.opt_state[p.name] = jax.tree_util.tree_map(
+                    jnp.asarray, self._states[i])
+        ts.step_count = jnp.asarray(self._optimizer.num_update, jnp.int32)
+        before = self._optimizer.num_update
+        try:
+            losses = ts.run(data_iter, steps, window=window, accum=accum)
+        finally:
+            # even when run() raises mid-stream (prefetch producer error, or
+            # the designed Preempted at a window boundary), the net must get
+            # the post-window params back — its old buffers were donated to
+            # the window program — and the counters must stay consistent
+            ts.sync()
+            # advance the per-index counters by the steps actually run: a
+            # later imperative step() reads its Adam/schedule t from
+            # _index_update_count, and num_update is the max() over them
+            ran = self._optimizer.num_update - before
+            for i in range(len(self._params)):
+                self._optimizer._index_update_count[i] = \
+                    self._optimizer._index_update_count.get(i, 0) + ran
+            name2idx = {p.name: i for i, p in enumerate(self._params)}
+            for name, st in ts.opt_state.items():
+                i = name2idx.get(name)
+                if i is not None:
+                    self._states[i] = st
+                    self._states_created[i] = True
+        self._check_preemption()
+        return losses
 
     def _update(self, ignore_stale_grad=False):
         self._ensure_states()

@@ -1,5 +1,7 @@
 """Data iterators (reference: ``src/io/`` + ``python/mxnet/io/``)."""
 from .io import DataIter, DataBatch, DataDesc, NDArrayIter, ResizeIter, PrefetchingIter  # noqa: F401
+from . import prefetch  # noqa: F401
+from .prefetch import DevicePrefetcher  # noqa: F401
 from . import recordio  # noqa: F401
 from .recordio import MXRecordIO, IndexedRecordIO  # noqa: F401
 from .image_iter import ImageRecordIter, imdecode_record  # noqa: F401

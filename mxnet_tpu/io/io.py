@@ -69,6 +69,17 @@ class DataIter:
     def getpad(self):
         return 0
 
+    def prefetch_to_device(self, train_step=None, window=1, accum=1, depth=2):
+        """Adapter to the async device-prefetch queue (``io.prefetch``): a
+        background thread pulls ``DataBatch``-es from this iterator,
+        flattens data+label, does the sharded ``jax.device_put`` with
+        ``train_step.batch_sharding`` and stacks ``window`` steps — feed
+        the result to ``TrainStep.run`` (docs/PERFORMANCE.md)."""
+        from .prefetch import DevicePrefetcher
+
+        return DevicePrefetcher(self, train_step=train_step, window=window,
+                                accum=accum, depth=depth)
+
 
 class NDArrayIter(DataIter):
     def __init__(self, data, label=None, batch_size=1, shuffle=False,

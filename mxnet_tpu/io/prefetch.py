@@ -1,0 +1,287 @@
+"""Async device prefetch: feed the compiled train step off the hot path.
+
+The single-step hot-path tax outside the fused program itself is per-batch
+Python on the caller's thread — flattening the batch, the sharded
+``jax.device_put``, and (for the k-step window program) stacking ``window``
+batches into one leading-dim array per input. :class:`DevicePrefetcher`
+moves all of it onto a background thread with a small bounded queue, so
+host->device transfer and window assembly overlap device compute
+(double-buffered by default, the reference ``PrefetcherIter`` idea extended
+to sharded placement + window stacking).
+
+Sources: any iterable of batches — tuples/lists of arrays or ``NDArray``s,
+``DataBatch`` (data+label flattened in order), or a host-batch stream like
+``DataLoader.host_batches()``. ``DataLoader.prefetch_to_device(...)`` and
+``DataIter.prefetch_to_device(...)`` construct one wired to a ``TrainStep``
+(whose ``batch_sharding`` drives placement, and which then skips its own
+per-call ``device_put``).
+
+Queue items are tagged groups: ``("window", stacked_batches, k)`` for a
+full window of ``k`` steps (each component ``[k, B, ...]``, or
+``[k, accum, B, ...]`` with gradient accumulation), or
+``("single", batch, 1)`` for a trailing partial window, consumed by
+``TrainStep.run`` as individual compiled steps.
+
+Telemetry (docs/OBSERVABILITY.md): ``prefetch_queue_depth`` gauge,
+``prefetch_stalls_total`` counter + ``prefetch_wait_seconds`` histogram
+when the consumer blocks on an empty queue (the input-bound signal for the
+window path), ``prefetch_batches_total`` counter.
+"""
+from __future__ import annotations
+
+import queue as _queuelib
+import threading
+import time
+
+import numpy as np
+
+from .. import observability as _obs
+from ..ndarray import NDArray
+
+__all__ = ["DevicePrefetcher"]
+
+_SENTINEL = object()
+
+
+def _flatten_batch(item):
+    """Normalize one source item to a flat tuple of host numpy arrays."""
+    from .io import DataBatch
+
+    if isinstance(item, DataBatch):
+        parts = list(item.data or []) + list(item.label or [])
+    else:
+        parts = [item]
+    flat = []
+
+    def rec(x):
+        if isinstance(x, (tuple, list)):
+            for y in x:
+                rec(y)
+        else:
+            flat.append(x)
+
+    rec(parts)
+    return tuple(np.asarray(p.asnumpy() if isinstance(p, NDArray) else p)
+                 for p in flat)
+
+
+class DevicePrefetcher:
+    """Background-thread device prefetch queue (see module docstring).
+
+    Parameters
+    ----------
+    source : iterable of batches (see module docstring for accepted forms).
+    train_step : parallel.TrainStep or None — supplies ``batch_sharding``
+        for placement; when given, the prefetcher attaches itself so the
+        step skips its own per-call ``device_put``.
+    window : stack this many consecutive batches into one device array per
+        input (the k of the compiled k-step scan window).
+    accum : microbatches per step — each window element consumes
+        ``accum`` source batches, stacked as a second leading dim.
+    depth : max ready groups in the queue (2 = double buffering).
+    """
+
+    def __init__(self, source, train_step=None, window=1, accum=1, depth=2):
+        if window < 1 or accum < 1:
+            raise ValueError("window and accum must be >= 1")
+        self.window = int(window)
+        self.accum = int(accum)
+        self._source = source
+        self._train_step = train_step
+        self._queue = _queuelib.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._exc = None
+        self._done = False
+        # register the queue metrics up front: "armed" must be observable
+        # (e.g. by `make perfwin`) even before the first stall happens
+        _obs.counter("prefetch_stalls_total",
+                     "consumer blocked on an empty device-prefetch queue")
+        _obs.gauge("prefetch_queue_depth",
+                   "ready groups in the device-prefetch queue")
+        if train_step is not None:
+            train_step.attach_prefetcher(self)
+        self._thread = threading.Thread(
+            target=self._producer, name="mxnet-tpu-device-prefetch",
+            daemon=True)
+        self._thread.start()
+
+    # -- device placement ----------------------------------------------------
+    def _place_single(self, host_tuple):
+        import jax
+
+        sh = None if self._train_step is None else self._train_step.batch_sharding
+        if sh is None:
+            return tuple(jax.device_put(a) for a in host_tuple)
+        return tuple(jax.device_put(a, sh) for a in host_tuple)
+
+    def _place_window(self, group):
+        """Stack a full group of window*accum host batches into one device
+        array per input component: [k(,accum),B,...]."""
+        import jax
+
+        k = len(group) // self.accum
+        sh = (None if self._train_step is None
+              else self._train_step.window_batch_sharding(self.accum))
+        comps = []
+        for j in range(len(group[0])):
+            stacked = np.stack([g[j] for g in group])
+            if self.accum > 1:
+                stacked = stacked.reshape((k, self.accum) + stacked.shape[1:])
+            comps.append(jax.device_put(stacked) if sh is None
+                         else jax.device_put(stacked, sh))
+        return tuple(comps), k
+
+    # -- producer thread -----------------------------------------------------
+    def _producer(self):
+        group_n = self.window * self.accum
+        pending = None  # a batch whose shapes broke the current group
+        exhausted = False
+        try:
+            it = iter(self._source)
+            while not self._stop.is_set() and not (exhausted and pending is None):
+                group = []
+                if pending is not None:
+                    group.append(pending)
+                    pending = None
+                while len(group) < group_n and not self._stop.is_set():
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    h = _flatten_batch(item)
+                    # np.stack needs equal shapes: a ragged batch (e.g. a
+                    # DataLoader last_batch="keep" tail, or a bucketed
+                    # shape change) flushes the current group and starts
+                    # the next one
+                    if group and tuple(a.shape for a in h) != \
+                            tuple(a.shape for a in group[0]):
+                        pending = h
+                        break
+                    group.append(h)
+                if self._stop.is_set():
+                    return
+                if not group:
+                    break
+                placed = len(group)
+                if len(group) == group_n and group_n > 1:
+                    payload, k = self._place_window(group)
+                    self._enqueue(("window", payload, k))
+                elif self.accum > 1:
+                    # partial window: accumulation semantics must survive,
+                    # so emit the whole accum-groups as a smaller window
+                    # (one extra program for the tail shape) and drop any
+                    # sub-group remainder — a fractional accumulation
+                    # group would train at a different effective batch size
+                    k, rem = divmod(len(group), self.accum)
+                    placed = k * self.accum
+                    if k:
+                        payload, k = self._place_window(group[:placed])
+                        self._enqueue(("window", payload, k))
+                    if rem:
+                        _obs.counter(
+                            "prefetch_dropped_batches_total",
+                            "trailing microbatches short of one full "
+                            "accumulation group").inc(rem)
+                        _obs.emit("prefetch_dropped", batches=rem,
+                                  accum=self.accum)
+                else:
+                    # partial window (or window=accum=1): emit as
+                    # individually-placed single steps
+                    for h in group:
+                        self._enqueue(("single", self._place_single(h), 1))
+                if placed and _obs.enabled():
+                    _obs.counter("prefetch_batches_total",
+                                 "host batches moved to device by the "
+                                 "prefetcher").inc(placed)
+        except BaseException as e:  # surfaced to the consumer
+            self._exc = e
+        finally:
+            self._finish()
+
+    def _enqueue(self, item):
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                if _obs.enabled():
+                    _obs.gauge("prefetch_queue_depth").set(self._queue.qsize())
+                return
+            except _queuelib.Full:
+                continue
+
+    def _finish(self):
+        while True:
+            try:
+                self._queue.put(_SENTINEL, timeout=0.1)
+                return
+            except _queuelib.Full:
+                if self._stop.is_set():
+                    return  # close() is draining and won't wait on a sentinel
+
+    # -- consumer ------------------------------------------------------------
+    def next_group(self):
+        """Blocking pop: ``(kind, payload, n_steps)`` where kind is
+        ``"window"`` (stacked device batches) or ``"single"`` (one device
+        batch), or ``(None, None, 0)`` once the source is exhausted.
+        Re-raises any producer-side exception."""
+        if self._done:
+            return (None, None, 0)
+        t0 = time.perf_counter()
+        stalled = False
+        try:
+            item = self._queue.get_nowait()
+        except _queuelib.Empty:
+            stalled = True
+            item = self._queue.get()
+        if item is _SENTINEL:
+            self._done = True
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            return (None, None, 0)
+        if _obs.enabled():
+            _obs.gauge("prefetch_queue_depth").set(self._queue.qsize())
+            if stalled:
+                _obs.counter("prefetch_stalls_total").inc()
+                _obs.histogram("prefetch_wait_seconds",
+                               "time the consumer blocked on the prefetch "
+                               "queue", unit="s").observe(
+                                   time.perf_counter() - t0)
+        return item
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        kind, payload, _n = self.next_group()
+        if kind is None:
+            raise StopIteration
+        return payload
+
+    def close(self):
+        """Stop the producer, drain the queue, and detach from the train
+        step. Idempotent; safe mid-stream."""
+        self._stop.set()
+        thread = getattr(self, "_thread", None)
+        while thread is not None and thread.is_alive():
+            try:
+                self._queue.get_nowait()
+            except _queuelib.Empty:
+                pass
+            thread.join(timeout=0.05)
+        self._done = True
+        ts = self._train_step
+        if ts is not None and getattr(ts, "_prefetcher", None) is self:
+            ts._prefetcher = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -106,6 +106,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = False):
         return (acc / l[..., None]).astype(q_blk.dtype)
 
     spec = P(None, None, axis, None)
-    fn = shard_map(per_shard, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_vma=False)
+    try:
+        fn = shard_map(per_shard, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    except TypeError:  # jax < 0.6 spells the replication check 'check_rep'
+        fn = shard_map(per_shard, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
     return fn(q, k, v)

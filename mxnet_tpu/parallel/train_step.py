@@ -12,6 +12,7 @@ machinery, and updated parameters are written back on request (``sync``).
 from __future__ import annotations
 
 import functools
+import math
 import time
 from typing import Callable, Dict, Optional
 
@@ -117,6 +118,13 @@ class TrainStep:
         # hides completely
         self._program_sigs: set = set()
         self._monitors: list = []
+        # attached DevicePrefetcher (io.prefetch): batches arrive already
+        # device-resident + sharded, so __call__/run skip the per-call
+        # device_put on the caller thread
+        self._prefetcher = None
+        # window-program dispatch count (one host sync per dispatch when
+        # telemetry is on) — tests assert one dispatch per window
+        self._window_dispatches = 0
 
     # -- functional loss -----------------------------------------------------
     def _loss_of(self, params: Dict[str, jax.Array], batch, key):
@@ -152,37 +160,54 @@ class TrainStep:
             wd_mult[p.name] = wm * float(opt.wd_mult.get(p.name, 1.0))
         return lr_mult, wd_mult
 
-    def _make_step(self, n_batch, with_gnorm=False):
+    def _grad_fn(self):
+        """``value_and_grad`` of the ZeRO-aware loss, shared by the
+        single-step and window programs.
+
+        ZeRO compute/storage split: fsdp-sharded params are explicitly
+        all-gathered for compute (constraint to the fsdp-free spec); the
+        constraint's transpose reduce-scatters the grads back to the
+        storage layout. Without this GSPMD may instead compute weight grads
+        in the storage layout, forcing an involuntary full remat of the
+        activation cotangent (round-3 MULTICHIP tail warning)."""
+        def lossf(p, batch, key):
+            cp = dict(p)
+            for name, cspec in self._compute_specs.items():
+                cp[name] = jax.lax.with_sharding_constraint(
+                    p[name], NamedSharding(self.mesh, cspec))
+            return self._loss_of(cp, batch, key)
+
+        return jax.value_and_grad(lossf)
+
+    def _apply_update(self, params, opt_state, t, grads, lr, wd,
+                      lr_mult, wd_mult):
+        """One optimizer application over the whole param dict (traced)."""
         opt = self.optimizer
+        new_params, new_state = dict(params), {}
+        for name in params:
+            if name not in opt_state:
+                continue
+            nw, ns = opt.update_raw(params[name], grads[name], opt_state[name],
+                                    lr * lr_mult.get(name, 1.0),
+                                    wd * wd_mult.get(name, 1.0), t)
+            new_params[name] = nw
+            new_state[name] = ns
+        return new_params, new_state
+
+    def _opt_shardings(self):
+        return {
+            k: jax.tree_util.tree_map(lambda _: self.param_sharding[k], v)
+            for k, v in self.opt_state.items()}
+
+    def _make_step(self, n_batch, with_gnorm=False):
         lr_mult, wd_mult = self._resolve_mults()
+        grad_fn = self._grad_fn()
 
         def step(params, opt_state, step_count, batch, key, lr, wd):
-            # ZeRO compute/storage split: fsdp-sharded params are explicitly
-            # all-gathered for compute (constraint to the fsdp-free spec);
-            # the constraint's transpose reduce-scatters the grads back to
-            # the storage layout. Without this GSPMD may instead compute
-            # weight grads in the storage layout, forcing an involuntary
-            # full remat of the activation cotangent (round-3 MULTICHIP
-            # tail warning).
-            def lossf(p, batch, key):
-                cp = dict(p)
-                for name, cspec in self._compute_specs.items():
-                    cp[name] = jax.lax.with_sharding_constraint(
-                        p[name], NamedSharding(self.mesh, cspec))
-                return self._loss_of(cp, batch, key)
-
-            loss, grads = jax.value_and_grad(lossf)(params, batch, key)
-            new_params, new_state = dict(params), {}
+            loss, grads = grad_fn(params, batch, key)
             t = step_count + 1
-            for name in params:
-                if name not in opt_state:
-                    continue
-                w, g = params[name], grads[name]
-                nw, ns = opt.update_raw(w, g, opt_state[name],
-                                        lr * lr_mult.get(name, 1.0),
-                                        wd * wd_mult.get(name, 1.0), t)
-                new_params[name] = nw
-                new_state[name] = ns
+            new_params, new_state = self._apply_update(
+                params, opt_state, t, grads, lr, wd, lr_mult, wd_mult)
             if with_gnorm:
                 # global grad-norm for telemetry: a handful of fused reduces,
                 # compiled into the same program only when telemetry is on
@@ -193,9 +218,7 @@ class TrainStep:
 
         donate = (0, 1) if self.donate else ()
         if self.mesh is not None:
-            opt_shardings = {
-                k: jax.tree_util.tree_map(lambda _: self.param_sharding[k], v)
-                for k, v in self.opt_state.items()}
+            opt_shardings = self._opt_shardings()
             in_shardings = (
                 self.param_sharding,
                 opt_shardings,
@@ -221,13 +244,109 @@ class TrainStep:
                            out_shardings=out_shardings)
         return jax.jit(step, donate_argnums=donate)
 
+    def window_batch_sharding(self, accum: int = 1):
+        """Sharding for a window-stacked batch array: the per-step batch
+        spec shifted right by the leading [window] (and [accum]) dims."""
+        if self.batch_sharding is None:
+            return None
+        nlead = 2 if accum > 1 else 1
+        return NamedSharding(
+            self.mesh, P(*((None,) * nlead + tuple(self.batch_sharding.spec))))
+
+    def _make_window(self, n_batch, window, accum, with_gnorm=False):
+        """ONE jitted program for ``window`` consecutive steps: a
+        ``jax.lax.scan`` whose carry (params / opt-state / step-count) is
+        donated and whose per-step losses come back as a stacked future —
+        forward+backward+update xK with zero per-step Python or dispatch
+        (the 'one program per window' extension of the per-step fusion
+        thesis; docs/PERFORMANCE.md).
+
+        With ``accum`` > 1 each scan step consumes ``accum`` stacked
+        microbatches: gradients are accumulated in the fsdp *storage*
+        layout (Xu et al. 2020 — accumulate sharded, never gathered) and
+        the optimizer applies the mean once per step."""
+        lr_mult, wd_mult = self._resolve_mults()
+        grad_fn = self._grad_fn()
+
+        def window_fn(params, opt_state, step_count, batches, keys, lrs, wd):
+            # lrs is a [window] vector scanned alongside the batches: with
+            # an lr_scheduler each step i trains at scheduler(num_update+i),
+            # exactly what i sequential __call__s would read
+            def body(carry, xs):
+                p, s, t = carry
+                batch, key, lr = xs
+                if accum == 1:
+                    loss, grads = grad_fn(p, batch, key)
+                else:
+                    def constrain(g):
+                        if self.mesh is None:
+                            return g
+                        return {k: (jax.lax.with_sharding_constraint(
+                                        v, self.param_sharding[k])
+                                    if k in self.param_sharding else v)
+                                for k, v in g.items()}
+
+                    def micro(acc, mxs):
+                        mb, midx = mxs
+                        l, g = grad_fn(p, mb, jax.random.fold_in(key, midx))
+                        return (acc[0] + l,
+                                jax.tree_util.tree_map(
+                                    jnp.add, acc[1], constrain(g))), None
+
+                    zeros = constrain(
+                        {k: jnp.zeros(v.shape, v.dtype)
+                         for k, v in p.items()})
+                    (lsum, gsum), _ = jax.lax.scan(
+                        micro, (jnp.float32(0.0), zeros),
+                        (batch, jnp.arange(accum)))
+                    loss = lsum / accum
+                    grads = jax.tree_util.tree_map(lambda x: x / accum, gsum)
+                t2 = t + 1
+                np_, ns = self._apply_update(p, s, t2, grads, lr, wd,
+                                             lr_mult, wd_mult)
+                if with_gnorm:
+                    gsq = sum(jnp.sum(jnp.square(grads[n].astype(jnp.float32)))
+                              for n in s)
+                    return (np_, ns, t2), (loss, jnp.sqrt(gsq))
+                return (np_, ns, t2), loss
+
+            carry, ys = jax.lax.scan(
+                body, (params, opt_state, step_count),
+                (tuple(batches), keys, lrs))
+            params, opt_state, t = carry
+            if with_gnorm:
+                losses, gnorms = ys
+                return params, opt_state, t, losses, gnorms
+            return params, opt_state, t, ys
+
+        donate = (0, 1) if self.donate else ()
+        if self.mesh is not None:
+            opt_shardings = self._opt_shardings()
+            wsharding = self.window_batch_sharding(accum)
+            rep = NamedSharding(self.mesh, P())
+            in_shardings = (
+                self.param_sharding, opt_shardings, rep,
+                tuple(wsharding for _ in range(n_batch)),
+                rep, rep, rep,
+            )
+            out_shardings = (self.param_sharding, opt_shardings, rep, rep)
+            if with_gnorm:
+                out_shardings = out_shardings + (rep,)
+            return jax.jit(window_fn, donate_argnums=donate,
+                           in_shardings=in_shardings,
+                           out_shardings=out_shardings)
+        return jax.jit(window_fn, donate_argnums=donate)
+
     # -- public API ----------------------------------------------------------
     def __call__(self, *batch):
         """Run one step. batch = (x, label, ...) as NDArray/jax arrays."""
         obs_on = _obs.enabled()
         t0 = time.perf_counter() if obs_on else 0.0
         raws = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b) for b in batch)
-        if self.batch_sharding is not None:
+        if self.batch_sharding is not None and self._prefetcher is None:
+            # with a prefetcher attached the batch is already device-resident
+            # in the right sharding — re-placing it on the caller thread is
+            # exactly the hot-path tax the prefetcher exists to remove
             raws = tuple(jax.device_put(r, self.batch_sharding) for r in raws)
         # the resolved lr/wd multipliers fold into the compiled program as
         # constants, so the cache key carries them: opt.set_lr_mult /
@@ -266,19 +385,159 @@ class TrainStep:
         self._check_preemption()
         return loss
 
+    # -- fused multi-step window (docs/PERFORMANCE.md) -----------------------
+    def attach_prefetcher(self, prefetcher):
+        """Mark batches as arriving device-resident (sharded by an
+        ``io.prefetch.DevicePrefetcher``): ``__call__``/``run`` skip the
+        per-call ``jax.device_put``. Called by the prefetcher itself."""
+        self._prefetcher = prefetcher
+        return prefetcher
+
+    def run(self, data_iter, steps=None, window=None, accum=None):
+        """Run ``steps`` training steps in compiled windows of ``window``.
+
+        Each full window lowers to ONE jitted XLA program — a
+        ``jax.lax.scan`` of forward+backward+update over ``window`` stacked
+        on-device batches with donated params/opt-state carry — so the
+        fixed dispatch/readback cost is paid once per window instead of
+        once per step. ``data_iter`` is any iterable of batches (tuples of
+        arrays, ``DataBatch``, a ``DataLoader``), or an already-constructed
+        :class:`~mxnet_tpu.io.prefetch.DevicePrefetcher` (e.g. from
+        ``loader.prefetch_to_device(train_step, window)``); plain iterables
+        are wrapped in a prefetcher so the sharded ``device_put`` + window
+        stacking happen on a background thread, overlapped with compute.
+
+        ``accum`` > 1 folds microbatch gradient accumulation into the same
+        program: each step consumes ``accum`` batches from the iterator,
+        accumulates grads in the fsdp storage layout, and applies the mean
+        once. A trailing partial window falls back to single compiled
+        steps (``accum == 1``) or a smaller window program (``accum > 1``,
+        accumulation preserved; microbatches short of one full group are
+        dropped and counted in ``prefetch_dropped_batches_total``).
+        Monitor and preemption checks run at window boundaries.
+
+        Returns the per-step losses as one stacked device future (shape
+        ``[steps_run]``) — reading it is the only host sync.
+        """
+        import itertools
+
+        from ..io.prefetch import DevicePrefetcher
+
+        own = not isinstance(data_iter, DevicePrefetcher)
+        if own:
+            window = 8 if window is None else window
+            accum = 1 if accum is None else accum
+            # a DataLoader's __iter__ yields device-placed batches; sources
+            # exposing the public host_batches() protocol (DataLoader, or
+            # any custom loader opting in) feed the prefetcher their
+            # host-side stream instead, so batches aren't placed, read
+            # back, and placed again
+            host_fn = getattr(data_iter, "host_batches", None)
+            src = host_fn() if callable(host_fn) else data_iter
+            if steps is not None:
+                src = itertools.islice(iter(src), steps * accum)
+            pf = DevicePrefetcher(src, train_step=self, window=window,
+                                  accum=accum)
+        else:
+            pf = data_iter
+            # the prefetcher already stacked its groups — a silently ignored
+            # mismatching request would train at the wrong effective batch
+            if window is not None and window != pf.window:
+                raise ValueError(f"window={window} but the prefetcher was "
+                                 f"built with window={pf.window}")
+            if accum is not None and accum != pf.accum:
+                raise ValueError(f"accum={accum} but the prefetcher was "
+                                 f"built with accum={pf.accum}")
+            window, accum = pf.window, pf.accum
+            if steps is not None and steps % window:
+                raise ValueError(
+                    f"steps={steps} not divisible by the prefetcher's "
+                    f"window={window}")
+        losses = []
+        done = 0
+        try:
+            while steps is None or done < steps:
+                kind, payload, n = pf.next_group()
+                if kind is None:
+                    break
+                if kind == "window":
+                    losses.append(self._run_window(payload, n, accum))
+                else:
+                    losses.append(jnp.reshape(self(*payload), (1,)))
+                done += n
+        finally:
+            if own:
+                pf.close()
+        if not losses:
+            return jnp.zeros((0,), jnp.float32)
+        return jnp.concatenate(losses) if len(losses) > 1 else losses[0]
+
+    def _run_window(self, batches, window, accum):
+        """Dispatch one compiled k-step window (batches already stacked +
+        device-resident). One program, one dispatch, and — with telemetry
+        on — one host sync for the whole window."""
+        obs_on = _obs.enabled()
+        t0 = time.perf_counter() if obs_on else 0.0
+        lr_mult, wd_mult = self._resolve_mults()
+        cache_key = ("window", window, accum, len(batches),
+                     tuple(sorted(lr_mult.items())),
+                     tuple(sorted(wd_mult.items())), obs_on)
+        if obs_on:
+            self._note_recompile(cache_key, batches, kind="window")
+        fn = self._compiled.get(cache_key)
+        if fn is None:
+            fn = self._compiled[cache_key] = self._make_window(
+                len(batches), window, accum, with_gnorm=obs_on)
+        # draw the window's keys from the same host-side stream k sequential
+        # __call__s would consume — the fused path is bit-compatible with
+        # the single-step path for a fixed seed
+        keys = jnp.stack([_rng.next_key() for _ in range(window)])
+        # per-step lr vector: window step i reads the scheduler at
+        # num_update + i, exactly what i sequential __call__s would see
+        opt = self.optimizer
+        if getattr(opt, "lr_scheduler", None) is not None:
+            base = opt.num_update
+            lrs = jnp.asarray([float(opt.lr_scheduler(base + i))
+                               for i in range(window)], jnp.float32)
+        else:
+            lrs = jnp.full((window,), opt.learning_rate, jnp.float32)
+        wd = jnp.float32(opt.wd)
+        gnorms = None
+        if obs_on:
+            (self.params, self.opt_state, self.step_count, losses,
+             gnorms) = fn(self.params, self.opt_state, self.step_count,
+                          batches, keys, lrs, wd)
+        else:
+            self.params, self.opt_state, self.step_count, losses = fn(
+                self.params, self.opt_state, self.step_count, batches, keys,
+                lrs, wd)
+        self._window_dispatches += 1
+        self.optimizer.num_update += window
+        if obs_on:
+            self._record_window(t0, batches, losses, gnorms, window, accum)
+        self._run_monitors()
+        self._check_preemption()
+        return losses
+
     # -- telemetry (docs/OBSERVABILITY.md) -----------------------------------
-    def _note_recompile(self, cache_key, raws):
+    def _note_recompile(self, cache_key, raws, kind="step"):
         """Count lowered-program cache misses: jax.jit recompiles silently
         on any new (arity, shape, dtype, folded-constant) signature; under
-        fusion that cost is invisible without this counter."""
-        sig = (cache_key[:3],
+        fusion that cost is invisible without this counter. Window-path
+        misses (a new (window, accum, shapes) signature lowering) count
+        under ``reason="window"``."""
+        sig = (cache_key[:-1],  # the program key minus the telemetry flag
                tuple((tuple(r.shape), str(r.dtype)) for r in raws))
         if sig in self._program_sigs:
             return
-        first = not self._program_sigs
-        reason = "first" if first else (
-            "shape" if any(s[0] == sig[0] for s in self._program_sigs)
-            else "hyperparams")
+        if kind == "window":
+            reason = "window"
+        elif not self._program_sigs:
+            reason = "first"
+        elif any(s[0] == sig[0] for s in self._program_sigs):
+            reason = "shape"
+        else:
+            reason = "hyperparams"
         self._program_sigs.add(sig)
         _obs.counter("train_recompiles_total",
                      "TrainStep program lowerings (cache misses)").inc(
@@ -309,6 +568,36 @@ class TrainStep:
             _obs.gauge("train_grad_norm").set(gnorm_f)
         _obs.emit("train_step", loss=loss_f, grad_norm=gnorm_f,
                   step_seconds=round(dt, 6), samples=samples, tokens=tokens,
+                  tokens_per_sec=round(tokens / dt, 3) if dt > 0 else 0.0)
+
+    def _record_window(self, t0, batches, losses, gnorms, window, accum):
+        # ONE device sync for the whole window: losses+gnorms fetched
+        # together, so window time is true wall clock of K fused steps
+        loss_h, gnorm_h = jax.device_get((losses, gnorms))
+        dt = time.perf_counter() - t0
+        _obs.set_step(int(self.optimizer.num_update))
+        b0 = batches[0] if batches else None
+        nlead = 2 if accum > 1 else 1
+        samples = (int(math.prod(b0.shape[:nlead + 1]))
+                   if b0 is not None and b0.ndim > nlead else window)
+        tokens = int(b0.size) if b0 is not None else 0
+        _obs.histogram("train_step_seconds", "full train-step wall clock",
+                       unit="s").observe(dt, loop="run_window")
+        _obs.counter("train_steps_total").inc(window, loop="run_window")
+        _obs.counter("train_samples_total").inc(samples, loop="run_window")
+        _obs.counter("train_tokens_total").inc(tokens, loop="run_window")
+        _obs.gauge("train_tokens_per_sec", unit="tokens/s").set(
+            tokens / dt if dt > 0 else 0.0)
+        _obs.gauge("train_loss").set(float(loss_h[-1]))
+        if gnorm_h is not None:
+            _obs.gauge("train_grad_norm").set(float(gnorm_h[-1]))
+        _obs.emit("train_window", window=window, accum=accum,
+                  loss=float(loss_h[-1]),
+                  loss_mean=float(sum(float(x) for x in loss_h) / len(loss_h)),
+                  grad_norm=None if gnorm_h is None else float(gnorm_h[-1]),
+                  window_seconds=round(dt, 6),
+                  step_seconds_amortized=round(dt / window, 6),
+                  samples=samples, tokens=tokens,
                   tokens_per_sec=round(tokens / dt, 3) if dt > 0 else 0.0)
 
     def attach_monitor(self, mon):
@@ -393,8 +682,26 @@ class TrainStep:
         return True
 
     def lower_hlo(self, *batch):
+        """Lower (don't run) the SAME program ``__call__`` would execute
+        for this batch signature: the resolved lr/wd multipliers, the mesh
+        in/out shardings, the telemetry-mode grad-norm output, and the jit
+        cache are all shared — so HLO assertions inspect the real
+        executable, and a later ``__call__`` with the same signature reuses
+        this jit function instead of compiling a second program."""
+        obs_on = _obs.enabled()
         raws = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b) for b in batch)
-        step = self._make_step(len(raws))
+        if self.batch_sharding is not None and self._prefetcher is None:
+            raws = tuple(jax.device_put(r, self.batch_sharding) for r in raws)
+        lr_mult, wd_mult = self._resolve_mults()
+        cache_key = (len(raws),
+                     tuple(sorted(lr_mult.items())),
+                     tuple(sorted(wd_mult.items())),
+                     obs_on)
+        step = self._compiled.get(cache_key)
+        if step is None:
+            step = self._compiled[cache_key] = self._make_step(
+                len(raws), with_gnorm=obs_on)
         key = _rng.next_key()
         return step.lower(self.params, self.opt_state, self.step_count, raws, key,
-                          jnp.float32(1e-3), jnp.float32(0.0))
+                          jnp.float32(self.optimizer.learning_rate),
+                          jnp.float32(self.optimizer.wd))
