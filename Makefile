@@ -16,7 +16,7 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity native fast slow test chaos obs perfwin bench clean
+.PHONY: ci sanity native fast slow test chaos obs perfwin genbench bench clean
 
 ci: sanity native fast
 
@@ -55,6 +55,14 @@ obs: native
 # the single-step path; artifact committed as BENCH_r06.json
 perfwin: native
 	$(PY) tools/benchall.py --window 4 --out BENCH_r06.json
+
+# compiled-generation gate (docs/INFERENCE.md): cached KV decode vs the
+# naive re-forward loop on a tiny GPT-2, CPU, median of alternating A/B
+# pairs — FAILS unless tokens match, amortized per-token speedup >= 3x,
+# and exactly (prefill buckets used + 1) programs were lowered; artifact
+# committed as GENBENCH_r01.json
+genbench:
+	$(PY) tools/genbench.py --out GENBENCH_r01.json
 
 test: sanity native
 	$(PY) -m pytest tests/ -q

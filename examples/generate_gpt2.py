@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Compiled autoregressive generation + continuous-batching demo
+(docs/INFERENCE.md).
+
+Builds a small GPT-2, stands up the two-program generation engine
+(bucketed prefill + one donated decode step), and serves a burst of
+mixed-length requests through the slot-based continuous batcher while
+printing per-request TTFT / throughput. Runs in seconds on CPU:
+
+  python examples/generate_gpt2.py
+  python examples/generate_gpt2.py --model gpt2_117m --batch-size 8
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.inference import ContinuousBatcher, GenerationEngine, SamplingConfig
+from mxnet_tpu.models import gpt2
+from mxnet_tpu.observability import REGISTRY
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="gpt2_tiny", choices=list(gpt2.gpt2_configs))
+    ap.add_argument("--vocab", type=int, default=2048,
+                    help="trimmed vocab so the demo stays CPU-friendly")
+    ap.add_argument("--batch-size", type=int, default=4,
+                    help="decode slots (static batch rows)")
+    ap.add_argument("--max-length", type=int, default=256)
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--max-new-tokens", type=int, default=24)
+    ap.add_argument("--sampling", default="greedy",
+                    choices=["greedy", "temperature", "top_k"])
+    ap.add_argument("--temperature", type=float, default=0.8)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    net = gpt2.get_gpt2(args.model, dropout=0.0, vocab_size=args.vocab,
+                        max_length=args.max_length)
+    net.initialize()
+    _ = net(nd.array(np.zeros((1, 4)), dtype="int32"))  # materialize params
+
+    eng = GenerationEngine(
+        net, batch_size=args.batch_size, max_length=args.max_length,
+        prefill_buckets=(16, 32, 64), eos_id=None, pad_id=0,
+        sampling=SamplingConfig(method=args.sampling,
+                                temperature=args.temperature))
+    bat = ContinuousBatcher(eng)
+
+    rs = np.random.RandomState(1)
+    reqs = [bat.submit(list(rs.randint(1, args.vocab, rs.randint(4, 48))),
+                       max_new_tokens=args.max_new_tokens)
+            for _ in range(args.requests)]
+    bat.run_until_idle()
+
+    for r in reqs:
+        toks = r.result()
+        print(f"req {r.id}: prompt={len(r.prompt):3d} tok  "
+              f"ttft={1e3 * r.ttft:7.1f} ms  generated={len(toks):3d}  "
+              f"[{', '.join(map(str, toks[:8]))}{', ...' if len(toks) > 8 else ''}]")
+    programs = REGISTRY.get("gen_recompiles_total")
+    print(f"\ncompiled programs: {eng.compiled_programs} "
+          f"(prefill buckets used + 1 decode) — "
+          f"{int(programs.total()) if programs else 0} counted by telemetry")
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,7 @@ from . import config  # noqa: F401
 config.apply_compile_cache()  # MXNET_TPU_COMPILE_CACHE: persistent XLA cache
 
 from . import observability  # noqa: F401
+from . import inference  # noqa: F401
 from . import observability as obs  # noqa: F401
 from . import resilience  # noqa: F401
 from . import test_utils  # noqa: F401

@@ -1,0 +1,17 @@
+"""Compiled autoregressive inference (docs/INFERENCE.md).
+
+Two pieces:
+
+  - :class:`GenerationEngine` — exactly two jitted program families for
+    token generation: bucketed-length *prefill* (one XLA program per prompt
+    bucket) and a single-token *decode step* (one program, donated KV-cache
+    carry, sampling + EOS masking compiled in);
+  - :class:`ContinuousBatcher` — slot-based continuous batching: queued
+    requests are admitted into free rows of the static decode batch at step
+    boundaries, so serving never changes a shape and never recompiles.
+"""
+from .engine import GenerationEngine, SamplingConfig  # noqa: F401
+from .batcher import ContinuousBatcher, GenRequest  # noqa: F401
+
+__all__ = ["GenerationEngine", "SamplingConfig", "ContinuousBatcher",
+           "GenRequest"]

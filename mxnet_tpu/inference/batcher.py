@@ -1,0 +1,162 @@
+"""Slot-based continuous batching over a :class:`GenerationEngine`.
+
+The decode batch is a fixed (B, …) shape; a *slot* is one row of it.
+Queued requests are admitted into free slots only at step boundaries —
+admission is a batch-1 prefill program writing one cache row, so joining
+traffic never changes a shape and never recompiles anything. Finished rows
+(EOS, token budget, or cache end) free their slot for the next request.
+
+Serving telemetry (docs/OBSERVABILITY.md):
+
+  - ``ttft_seconds``          — submit → first sampled token (includes
+                                queue wait + prefill), per request;
+  - ``decode_tokens_per_s``   — generated-token rate after the first token,
+                                per request;
+  - ``gen_queue_depth``       — requests waiting for a slot (gauge);
+  - ``gen_active_slots``      — rows currently decoding (gauge);
+  - ``gen_requests_total{reason=...}`` — completions by finish reason.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from typing import List, Optional, Sequence
+
+from .. import observability as _obs
+
+__all__ = ["ContinuousBatcher", "GenRequest"]
+
+
+class GenRequest:
+    """Handle for one submitted generation request."""
+
+    def __init__(self, req_id: int, prompt, max_new_tokens: int):
+        self.id = req_id
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.output: List[int] = []
+        self.slot: Optional[int] = None
+        self.finish_reason: Optional[str] = None  # eos | length | cache_full
+        self.submit_t = time.perf_counter()
+        self.first_token_t: Optional[float] = None
+        self.finish_t: Optional[float] = None
+
+    @property
+    def done(self) -> bool:
+        return self.finish_reason is not None
+
+    def result(self) -> List[int]:
+        if not self.done:
+            raise RuntimeError(f"request {self.id} still running")
+        return list(self.output)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+
+class ContinuousBatcher:
+    """FIFO admission of queued requests into free decode slots."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._queue: deque = deque()
+        self._slots: List[Optional[GenRequest]] = [None] * engine.batch_size
+        self._ids = itertools.count()
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32) -> GenRequest:
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        self.engine.bucket_for(len(prompt))  # reject oversize prompts now
+        req = GenRequest(next(self._ids), prompt, max_new_tokens)
+        self._queue.append(req)
+        self._gauges()
+        return req
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue)
+
+    @property
+    def active(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    # -- serving loop --------------------------------------------------------
+    def _gauges(self):
+        _obs.gauge("gen_queue_depth",
+                   "requests waiting for a decode slot").set(len(self._queue))
+        _obs.gauge("gen_active_slots", "decode rows in flight").set(self.active)
+
+    def _finish(self, slot: int, reason: str):
+        req = self._slots[slot]
+        self._slots[slot] = None
+        self.engine.release_slot(slot)
+        req.finish_reason = reason
+        req.finish_t = time.perf_counter()
+        _obs.counter("gen_requests_total", "completed generation requests").inc(
+            reason=reason)
+        gen = len(req.output) - 1  # tokens after the TTFT token
+        span = req.finish_t - (req.first_token_t or req.submit_t)
+        if gen > 0 and span > 0:
+            _obs.histogram("decode_tokens_per_s",
+                           "per-request generation rate after first token",
+                           unit="tokens/s").observe(gen / span)
+
+    def _admit(self):
+        """Step-boundary admission: fill free slots FIFO. Each admission is
+        one bucketed prefill (no shape change for the running rows)."""
+        for slot in range(self.engine.batch_size):
+            if not self._queue:
+                break
+            if self._slots[slot] is not None:
+                continue
+            req = self._queue.popleft()
+            req.slot = slot
+            self._slots[slot] = req
+            tok = self.engine.prefill(req.prompt, slot)
+            req.first_token_t = time.perf_counter()
+            _obs.histogram("ttft_seconds", "submit -> first sampled token",
+                           unit="s").observe(req.first_token_t - req.submit_t)
+            req.output.append(tok)
+            if self.engine.done[slot]:  # first token was EOS
+                self._finish(slot, "eos")
+            elif req.max_new_tokens == 1:
+                self._finish(slot, "length")
+
+    def step(self) -> bool:
+        """Admit, then run one compiled decode step. Returns True while any
+        work (active rows or queued requests) remains."""
+        self._admit()
+        self._gauges()
+        if self.active == 0:
+            return bool(self._queue)
+        was_active = [s for s, r in enumerate(self._slots) if r is not None]
+        tok, done, _ = self.engine.decode_step()
+        for slot in was_active:
+            req = self._slots[slot]
+            req.output.append(int(tok[slot]))
+            if done[slot]:
+                # distinguish a sampled EOS from a forced cache-end finish
+                hit_end = self.engine.positions[slot] >= self.engine.max_length
+                sampled_eos = (self.engine.eos_id is not None
+                               and req.output[-1] == self.engine.eos_id)
+                self._finish(slot, "eos" if sampled_eos else
+                             ("cache_full" if hit_end else "eos"))
+            elif len(req.output) >= req.max_new_tokens:
+                self._finish(slot, "length")
+        self._gauges()
+        return bool(self._queue) or self.active > 0
+
+    def run_until_idle(self, max_steps: Optional[int] = None) -> None:
+        """Drive steps until queue and slots are empty (or ``max_steps``)."""
+        steps = 0
+        while self.step():
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break

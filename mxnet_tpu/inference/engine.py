@@ -1,0 +1,315 @@
+"""Compiled KV-cache generation engine (docs/INFERENCE.md).
+
+The training insight of ``TrainStep.run`` — one donated jit program instead
+of a per-step dispatch storm — applied to decoding. A naive sampling loop
+re-forwards the whole growing sequence every token: O(N·L²) attention
+recompute plus a fresh dispatch (or, hybridized, a fresh *compile* per
+growing shape). This engine runs exactly two compiled program families:
+
+  - **prefill** — the prompt, padded to a static bucket length, runs one
+    cached causal forward that writes the prompt's K/V into one row of the
+    static decode cache and samples the first new token. One XLA program
+    per bucket length, batch-1 row insert (``lax.dynamic_update_slice`` at
+    the slot index), so admitting a request never touches the other rows.
+  - **decode** — one token for every row of the static batch: cache update
+    via per-row ``dynamic_update_slice``, attention against the full
+    buffers, sampling (greedy / temperature / top-k) and per-row EOS
+    done-masking all compiled in. The cache is a donated carry, so XLA
+    updates it in place.
+
+Nothing in the serving loop changes a shape, so the compiled-program count
+is exactly ``len(buckets used) + 1`` — counted through the observability
+registry (``gen_recompiles_total{reason="prefill_bucket"|"decode"}``), the
+same discipline as ``train_recompiles_total``.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import observability as _obs
+from ..gluon.block import _HybridTrace
+from ..ndarray import NDArray
+from ..ops import random_ops as _rops
+
+__all__ = ["GenerationEngine", "SamplingConfig"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """Decode-time sampling, folded into the compiled programs as constants
+    (changing it makes a new engine / new programs, counted as recompiles).
+    """
+
+    method: str = "greedy"  # greedy | temperature | top_k
+    temperature: float = 1.0
+    top_k: int = 40
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.method not in ("greedy", "temperature", "top_k"):
+            raise ValueError(f"unknown sampling method {self.method!r}")
+
+    @property
+    def stochastic(self) -> bool:
+        return self.method != "greedy" and self.temperature > 0
+
+
+def _default_buckets(max_length: int) -> Tuple[int, ...]:
+    out, b = [], 16
+    while b < max_length:
+        out.append(b)
+        b *= 2
+    return tuple(out) or (max_length - 1,)
+
+
+class GenerationEngine:
+    """Compiled autoregressive generation over a static decode batch.
+
+    Parameters
+    ----------
+    net : GPT2Model (or any block whose ``hybrid_forward`` threads
+        ``cache=``/``start_pos=`` and that provides ``init_cache``).
+        Must be initialized; dropout should be 0 for exact equivalence
+        (evaluation mode disables it regardless).
+    batch_size : rows of the static decode batch (= serving slots).
+    max_length : KV-cache length per row (default: the net's max_length).
+    prefill_buckets : ascending prompt-length buckets; each bucket used
+        costs one prefill compile. Default: powers of two from 16.
+    eos_id : token that finishes a row (compiled into the done-mask);
+        None = rows only finish by max_new_tokens.
+    pad_id : token emitted by finished rows and used for prompt padding.
+    sampling : SamplingConfig (or method string), compiled in.
+    """
+
+    def __init__(self, net, batch_size: int = 4, max_length: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 eos_id: Optional[int] = None, pad_id: int = 0,
+                 sampling=None, cache_dtype: str = "float32"):
+        self.net = net
+        self.batch_size = int(batch_size)
+        self.max_length = int(max_length or net._max_length)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.pad_id = int(pad_id)
+        if sampling is None:
+            sampling = SamplingConfig()
+        elif isinstance(sampling, str):
+            sampling = SamplingConfig(method=sampling)
+        self.sampling = sampling
+        buckets = tuple(sorted(prefill_buckets or
+                               _default_buckets(self.max_length)))
+        if not buckets or buckets[-1] >= self.max_length:
+            raise ValueError(f"prefill buckets {buckets} must be non-empty "
+                             f"and < max_length={self.max_length}")
+        self.prefill_buckets = buckets
+
+        self._plist = [p for _, p in sorted(net.collect_params().items())]
+        for p in self._plist:
+            if p._nd is None:
+                raise ValueError(f"parameter {p.name} not initialized; run "
+                                 "one forward pass first")
+        #: device state: per-layer (k_buf, v_buf), the donated decode carry
+        self.cache = net.init_cache(self.batch_size, self.max_length,
+                                    dtype=cache_dtype)
+        # host state (tiny (B,) vectors shipped to the device each step —
+        # keeping them host-side makes slot admission trivial)
+        self.positions = np.zeros(self.batch_size, np.int32)
+        self.done = np.ones(self.batch_size, bool)  # empty slots are "done"
+        self.last_tokens = np.full(self.batch_size, self.pad_id, np.int32)
+
+        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(1,),
+                                    static_argnums=())
+        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(1,))
+        # lowered-program signatures seen (cf. TrainStep._note_recompile):
+        # a miss means XLA compiles a new executable
+        self._program_sigs: set = set()
+        self._key = None  # lazily created PRNG key for stochastic sampling
+        self._fixed_key = None
+
+    # -- program accounting --------------------------------------------------
+    @property
+    def compiled_programs(self) -> int:
+        """How many XLA executables this engine has lowered (prefill buckets
+        actually used + the decode step)."""
+        return len(self._program_sigs)
+
+    def _note_program(self, sig, reason):
+        if sig in self._program_sigs:
+            return
+        self._program_sigs.add(sig)
+        _obs.counter("gen_recompiles_total",
+                     "generation program lowerings (cache misses)").inc(
+                         reason=reason)
+        _obs.emit("recompile", reason=reason, sig=list(map(str, sig)))
+
+    # -- sampling (compiled into both programs) ------------------------------
+    def _sample(self, logits2d, key):
+        cfg = self.sampling
+        if cfg.method == "greedy":
+            return jnp.argmax(logits2d, axis=-1).astype(jnp.int32)
+        if cfg.method == "temperature":
+            return _rops.temperature_sampling(
+                logits2d, temperature=cfg.temperature, key=key)
+        return _rops.top_k_sampling(logits2d, k=cfg.top_k,
+                                    temperature=cfg.temperature, key=key)
+
+    def _next_key(self):
+        if not self.sampling.stochastic:
+            if self._fixed_key is None:
+                self._fixed_key = jax.random.key(self.sampling.seed)
+            return self._fixed_key
+        if self._key is None:
+            self._key = jax.random.key(self.sampling.seed)
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _params(self):
+        return tuple(p._nd._data for p in self._plist)
+
+    # -- pure programs -------------------------------------------------------
+    def _prefill_fn(self, params, cache, tokens, slot, length, key):
+        """(params, cache, (1, Lb) tokens, slot, real length, key) ->
+        (cache', first sampled token, last-prompt-position logits)."""
+        row_cache = [tuple(jax.lax.dynamic_slice_in_dim(b, slot, 1, axis=0)
+                           for b in layer) for layer in cache]
+        start = jnp.zeros((1,), jnp.int32)
+        with _HybridTrace(self._plist, list(params), False, key):
+            logits, new_rows = self.net(
+                NDArray(tokens),
+                cache=[(NDArray(k), NDArray(v)) for k, v in row_cache],
+                start_pos=NDArray(start))
+        logits = logits._data  # (1, Lb, vocab)
+        new_cache = [
+            tuple(jax.lax.dynamic_update_slice_in_dim(full, row._data, slot,
+                                                      axis=0)
+                  for full, row in zip(layer, rows))
+            for layer, rows in zip(cache, new_rows)]
+        last = jax.lax.dynamic_index_in_dim(logits, length - 1, axis=1,
+                                            keepdims=False)[0]  # (vocab,)
+        tok = self._sample(last[None, :], key)[0].astype(jnp.int32)
+        return new_cache, tok, last
+
+    def _decode_fn(self, params, cache, tokens, positions, done, key):
+        """One token for every row: (cache', next tokens, done', logits).
+        Finished rows emit ``pad_id`` and keep their cache frontier."""
+        with _HybridTrace(self._plist, list(params), False, key):
+            logits, new_cache = self.net(
+                NDArray(tokens.reshape(self.batch_size, 1)),
+                cache=[(NDArray(k), NDArray(v)) for k, v in cache],
+                start_pos=NDArray(positions))
+        logits = logits._data[:, 0]  # (B, vocab)
+        sampled = self._sample(logits, key)
+        next_tok = jnp.where(done, jnp.int32(self.pad_id), sampled)
+        if self.eos_id is not None:
+            done = done | (sampled == self.eos_id)
+        new_cache = [tuple(b._data for b in layer) for layer in new_cache]
+        return new_cache, next_tok.astype(jnp.int32), done, logits
+
+    # -- host API ------------------------------------------------------------
+    def bucket_for(self, length: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= length:
+                return b
+        raise ValueError(f"prompt length {length} exceeds largest prefill "
+                         f"bucket {self.prefill_buckets[-1]}")
+
+    def prefill(self, prompt, slot: int) -> int:
+        """Admit a prompt into row ``slot``: write its K/V into the cache,
+        sample the first new token (returned as a host int — this sync is
+        the time-to-first-token point). Never touches other rows."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        length = prompt.size
+        if not 0 < length:
+            raise ValueError("empty prompt")
+        if not 0 <= slot < self.batch_size:
+            raise ValueError(f"slot {slot} out of range")
+        bucket = self.bucket_for(length)
+        padded = np.full((1, bucket), self.pad_id, np.int32)
+        padded[0, :length] = prompt
+        t0 = time.perf_counter()
+        self._note_program(("prefill", bucket), "prefill_bucket")
+        cache, tok, last = self._prefill_jit(
+            self._params(), self.cache, jnp.asarray(padded),
+            jnp.asarray(slot, jnp.int32), jnp.asarray(length, jnp.int32),
+            self._next_key())
+        self.cache = cache
+        tok = int(tok)  # host sync: the first token is ready here
+        self.positions[slot] = length
+        self.last_tokens[slot] = tok
+        self.done[slot] = (self.eos_id is not None and tok == self.eos_id)
+        if _obs.enabled():
+            _obs.histogram("gen_prefill_seconds", "prompt prefill wall clock",
+                           unit="s").observe(time.perf_counter() - t0,
+                                             bucket=bucket)
+        self._last_logits = last
+        return tok
+
+    def decode_step(self):
+        """One compiled step over the whole batch. Returns
+        ``(next_tokens (B,) np.int32, done (B,) np.bool_, logits (B, V)
+        device array)``. Rows that were already done emit ``pad_id``."""
+        t0 = time.perf_counter()
+        active_in = ~self.done
+        self._note_program(("decode", self.batch_size), "decode")
+        cache, tok, done, logits = self._decode_jit(
+            self._params(), self.cache, jnp.asarray(self.last_tokens),
+            jnp.asarray(self.positions), jnp.asarray(self.done),
+            self._next_key())
+        self.cache = cache
+        # np.array (copy): zero-copy views of jax buffers are read-only,
+        # and this host state is mutated by release_slot/prefill
+        tok = np.array(tok)
+        done = np.array(done)
+        # rows active going into the step consumed one cache index
+        self.positions = self.positions + active_in.astype(np.int32)
+        # a row whose frontier hit the buffer end cannot take another token
+        full = active_in & (self.positions >= self.max_length)
+        if full.any():
+            done = done | full
+            _obs.counter("gen_cache_overflow_total",
+                         "rows force-finished at the KV-cache end").inc(
+                             int(full.sum()))
+        self.done = done
+        self.last_tokens = tok
+        if _obs.enabled():
+            dt = time.perf_counter() - t0
+            _obs.histogram("gen_decode_step_seconds",
+                           "one compiled decode step wall clock",
+                           unit="s").observe(dt)
+        return tok, done, logits
+
+    def release_slot(self, slot: int) -> None:
+        """Mark a row free (emits pad, frontier frozen) — the next prefill
+        into this slot overwrites it."""
+        self.done[slot] = True
+        self.last_tokens[slot] = self.pad_id
+
+    # -- convenience: whole-batch generation ---------------------------------
+    def generate(self, prompts, max_new_tokens: int = 32) -> List[List[int]]:
+        """Generate up to ``max_new_tokens`` for each prompt (≤ batch_size
+        prompts, one slot each). Returns the generated token lists (prompt
+        excluded); rows stop at EOS, max_new_tokens, or a full cache."""
+        if len(prompts) > self.batch_size:
+            raise ValueError(f"{len(prompts)} prompts > batch_size="
+                             f"{self.batch_size}; use ContinuousBatcher")
+        self.done[:] = True  # park unused rows
+        outs: List[List[int]] = []
+        for i, p in enumerate(prompts):
+            tok = self.prefill(p, slot=i)
+            outs.append([tok])
+        while True:
+            active = [i for i in range(len(prompts))
+                      if not self.done[i] and len(outs[i]) < max_new_tokens]
+            if not active:
+                break
+            tok, done, _ = self.decode_step()
+            for i in active:
+                outs[i].append(int(tok[i]))
+                if len(outs[i]) >= max_new_tokens and not self.done[i]:
+                    self.release_slot(i)  # cap reached: stop advancing
+        return outs

@@ -12,6 +12,16 @@ from .. import initializer as init
 
 __all__ = ["GPT2Model", "get_gpt2", "gpt2_configs", "lm_loss"]
 
+
+def _chunk_positions(F, t, start_pos=None):
+    """Position ids for a t-token chunk: ``arange(t)`` for a full forward,
+    per-row ``start_pos + arange(t)`` for a cached chunk (rows admitted by
+    the batcher at different times sit at different sequence positions)."""
+    ar = F.arange(0, t, dtype="int32")
+    if start_pos is None:
+        return ar
+    return start_pos.reshape((-1, 1)).astype("int32") + ar.reshape((1, -1))
+
 gpt2_configs = {
     "gpt2_tiny": dict(num_layers=2, units=128, num_heads=2, max_length=512,
                       vocab_size=50257),
@@ -41,16 +51,23 @@ class GPT2Block(HybridBlock):
                                  weight_initializer=init.Normal(0.02))
             self.drop = nn.Dropout(dropout)
 
-    def hybrid_forward(self, F, x):
+    def hybrid_forward(self, F, x, cache=None, start_pos=None):
         b, t, c = x.shape
         h = self._heads
         y = self.ln1(x)
         qkv = self.qkv(y).reshape((b, t, 3, h, c // h)).transpose((2, 0, 3, 1, 4))
-        att = F.multi_head_attention(qkv[0], qkv[1], qkv[2], causal=True)
+        if cache is None:
+            att = F.multi_head_attention(qkv[0], qkv[1], qkv[2], causal=True)
+        else:
+            # autoregressive path (docs/INFERENCE.md): only the t new tokens
+            # flow through; K/V history lives in the static-shape cache
+            att, k_buf, v_buf = F.multi_head_attention(
+                qkv[0], qkv[1], qkv[2], cache=cache, position=start_pos)
         att = att.transpose((0, 2, 1, 3)).reshape((b, t, c))
         x = x + self.drop(self.proj(att))
         y = self.ffn2(F.Activation(self.ffn1(self.ln2(x)), act_type="tanh_gelu"))
-        return x + self.drop(y)
+        out = x + self.drop(y)
+        return out if cache is None else (out, (k_buf, v_buf))
 
 
 class GPT2Model(HybridBlock):
@@ -58,6 +75,9 @@ class GPT2Model(HybridBlock):
                  vocab_size=50257, dropout=0.1, **kwargs):
         super().__init__(**kwargs)
         self._units = units
+        self._num_layers = num_layers
+        self._num_heads = num_heads
+        self._max_length = max_length
         with self.name_scope():
             self.word_embed = nn.Embedding(vocab_size, units, prefix="word_embed_",
                                            weight_initializer=init.Normal(0.02))
@@ -71,17 +91,34 @@ class GPT2Model(HybridBlock):
                                           prefix=f"layer{i}_"))
             self.ln_f = nn.LayerNorm(in_channels=units, prefix="lnf_")
 
-    def hybrid_forward(self, F, token_ids):
+    def init_cache(self, batch_size, max_length=None, dtype="float32"):
+        """Allocate per-layer ``(k_buf, v_buf)`` static decode buffers of
+        shape (B, H, Tmax, Ch) — the carry of the compiled decode step
+        (``mxnet_tpu.inference.GenerationEngine``)."""
+        from ..ops.attention import alloc_kv_cache
+
+        return alloc_kv_cache(batch_size, self._num_heads,
+                              max_length or self._max_length,
+                              self._units // self._num_heads,
+                              self._num_layers, dtype=dtype)
+
+    def hybrid_forward(self, F, token_ids, cache=None, start_pos=None):
         b, t = token_ids.shape
-        pos = F.arange(0, t, dtype="int32")
+        pos = _chunk_positions(F, t, start_pos)
         x = self.drop(self.word_embed(token_ids) + self.position_embed(pos))
-        for blk in self.blocks:
-            x = blk(x)
+        new_cache = []
+        for i, blk in enumerate(self.blocks):
+            if cache is None:
+                x = blk(x)
+            else:
+                x, layer_cache = blk(x, cache=cache[i], start_pos=start_pos)
+                new_cache.append(layer_cache)
         x = self.ln_f(x)
         # weight-tied LM head (GPT-2 ties input/output embeddings)
         logits = F.dot(x.reshape((b * t, self._units)),
                        self.word_embed.weight.data(), transpose_b=True)
-        return logits.reshape((b, t, -1))
+        logits = logits.reshape((b, t, -1))
+        return logits if cache is None else (logits, new_cache)
 
 
 def get_gpt2(model_name="gpt2_345m", dropout=0.1, **overrides):

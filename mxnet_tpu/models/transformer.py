@@ -43,7 +43,8 @@ class MultiHeadAttention(HybridBlock):
                                  weight_initializer=init.Xavier())
             self.drop = nn.Dropout(dropout)
 
-    def hybrid_forward(self, F, x, mem=None, mask=None, causal=False):
+    def hybrid_forward(self, F, x, mem=None, mask=None, causal=False,
+                       cache=None, start_pos=None):
         # shape-agnostic (0/-1/-3 reshape codes + slice_axis): traces both
         # under jit tracers AND as a Symbol graph (HybridBlock.export)
         h, u = self._heads, self._units
@@ -61,6 +62,11 @@ class MultiHeadAttention(HybridBlock):
         def heads(z):  # (b, t, u) -> (b, h, t, u//h)
             return z.reshape((0, 0, h, -1)).transpose((0, 2, 1, 3))
 
+        if cache is not None:  # cached autoregressive self-attention
+            out, k_buf, v_buf = F.multi_head_attention(
+                heads(q), heads(k), heads(v), cache=cache, position=start_pos)
+            out = out.transpose((0, 2, 1, 3)).reshape((0, 0, -3))
+            return self.drop(self.proj(out)), (k_buf, v_buf)
         out = F.multi_head_attention(heads(q), heads(k), heads(v), mask=mask,
                                      causal=causal)
         out = out.transpose((0, 2, 1, 3)).reshape((0, 0, -3))  # merge h,d
@@ -107,10 +113,17 @@ class DecoderLayer(HybridBlock):
             self.ffn = _FFN(units, hidden_size, dropout, prefix="ffn_")
             self.ln3 = nn.LayerNorm(in_channels=units, prefix="ln3_")
 
-    def hybrid_forward(self, F, x, mem, mem_mask=None):
-        x = self.ln1(x + self.self_attn(x, causal=True))
+    def hybrid_forward(self, F, x, mem, mem_mask=None, cache=None,
+                       start_pos=None):
+        if cache is None:
+            x = self.ln1(x + self.self_attn(x, causal=True))
+        else:
+            att, new_cache = self.self_attn(x, cache=cache,
+                                            start_pos=start_pos)
+            x = self.ln1(x + att)
         x = self.ln2(x + self.cross_attn(x, mem=mem, mask=mem_mask))
-        return self.ln3(x + self.ffn(x))
+        x = self.ln3(x + self.ffn(x))
+        return x if cache is None else (x, new_cache)
 
 
 class Transformer(HybridBlock):
@@ -161,6 +174,38 @@ class Transformer(HybridBlock):
         for layer in self.dec_layers:
             y = layer(y, mem, mem_mask)
         return self.out_proj(y)
+
+    # -- cached autoregressive decoding (docs/INFERENCE.md) ------------------
+    def init_decode_cache(self, batch_size, max_length=None, dtype="float32"):
+        """Per-decoder-layer ``(k_buf, v_buf)`` self-attention buffers.
+        Cross-attention K/V are recomputed from ``mem`` each step (mem is
+        small and fixed; caching it is a follow-up)."""
+        from ..ops.attention import alloc_kv_cache
+
+        heads = self.dec_layers[0].self_attn._heads
+        return alloc_kv_cache(batch_size, heads,
+                              max_length or self.pos_embed._input_dim,
+                              self._units // heads, len(self.dec_layers),
+                              dtype=dtype)
+
+    def decode_step(self, tgt_ids, mem, mem_mask=None, cache=None,
+                    start_pos=None):
+        """One cached decoder chunk: embeds ``tgt_ids`` (B, t) at per-row
+        offsets ``start_pos`` and runs the decoder stack against the
+        self-attention cache. Returns ``(logits, new_cache)``."""
+        from .. import ndarray as F
+        from .gpt2 import _chunk_positions
+
+        _, t = tgt_ids.shape
+        pos = _chunk_positions(F, t, start_pos)
+        scale = math.sqrt(self._units)
+        y = self.drop(self.tgt_embed(tgt_ids) * scale + self.pos_embed(pos))
+        new_cache = []
+        for i, layer in enumerate(self.dec_layers):
+            y, layer_cache = layer(y, mem, mem_mask, cache=cache[i],
+                                   start_pos=start_pos)
+            new_cache.append(layer_cache)
+        return self.out_proj(y), new_cache
 
 
 def get_transformer(model_name="transformer_base", dropout=0.1, **overrides):

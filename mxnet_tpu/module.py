@@ -87,18 +87,32 @@ class BaseModule:
             self.update_metric(eval_metric, batch.label)
         return eval_metric.get_name_value()
 
+    # in-flight window for predict(): enough batches to keep dispatch ahead
+    # of compute without retaining the whole eval set's outputs in device
+    # memory at once
+    _PREDICT_WINDOW = 16
+
     def predict(self, eval_data, num_batch=None, reset=True):
         if reset:
             eval_data.reset()
-        outs = []
+        # keep outputs as device futures so batch k+1's dispatch overlaps
+        # batch k's compute (the TrainStep loss-future discipline); drain to
+        # host a window behind the dispatch frontier — by then the compute
+        # has overlapped, and device memory stays O(window), not O(batches)
+        import jax
+
+        from .ndarray import array as _arr
+
+        pending, host = [], []
         for i, batch in enumerate(eval_data):
             if num_batch is not None and i >= num_batch:
                 break
             self.forward(batch, is_train=False)
-            outs.append(self.get_outputs()[0].asnumpy())
-        from .ndarray import array as _arr
-
-        return _arr(np.concatenate(outs))
+            pending.append(self.get_outputs()[0]._data)
+            if len(pending) >= self._PREDICT_WINDOW:
+                host.append(np.asarray(jax.device_get(pending.pop(0))))
+        host.extend(np.asarray(h) for h in jax.device_get(pending))
+        return _arr(np.concatenate(host))
 
 
 class _BatchEndParam:

@@ -91,6 +91,64 @@ def interleaved_matmul_encdec_valatt(kv_proj, att, heads=1):
 
 
 # --------------------------------------------------------------------------
+# cached (autoregressive) attention
+# --------------------------------------------------------------------------
+def _unwrap(x):
+    # hybrid_forward passes cache entries through the nd kwargs channel,
+    # which does not unwrap containers — accept NDArray or raw array
+    return getattr(x, "_data", x)
+
+
+def alloc_kv_cache(batch_size, num_heads, max_length, channels, num_layers,
+                   dtype="float32"):
+    """Per-layer ``(k_buf, v_buf)`` zero buffers of shape (B, H, Tmax, Ch) —
+    the static decode carry both model zoos hand to the cached path
+    (``GPT2Model.init_cache`` / ``Transformer.init_decode_cache``)."""
+    from ..base import dtype_np
+
+    shape = (int(batch_size), int(num_heads), int(max_length), int(channels))
+    return [(jnp.zeros(shape, dtype_np(dtype)), jnp.zeros(shape, dtype_np(dtype)))
+            for _ in range(int(num_layers))]
+
+
+def _cached_mha(q, k_new, v_new, k_buf, v_buf, position):
+    """Incremental attention against static max-length K/V buffers.
+
+    q/k_new/v_new: (B, H, Tq, Ch) — the Tq new positions of each row;
+    k_buf/v_buf:   (B, H, Tmax, Ch) — the persistent cache;
+    position:      (B,) int32 — per-row start index of this chunk (rows
+                   admitted by the batcher at different times carry
+                   different positions, no shape change involved).
+
+    The new K/V land in the buffers first (vmapped ``dynamic_update_slice``
+    at each row's own offset), then every query attends to buffer entries
+    ``<= position + i`` — which is exactly the causal mask of the full
+    forward, so logits match a from-scratch re-forward to fp tolerance.
+    Buffer slots past a row's frontier hold zeros/stale K/V but are masked
+    to -inf before the softmax, so they contribute exactly 0.
+    """
+    b, h, tq, ch = q.shape
+    tmax = k_buf.shape[2]
+
+    def write(buf, new, p):  # one row: (H, Tmax, Ch) <- (H, Tq, Ch) at p
+        return jax.lax.dynamic_update_slice(buf, new.astype(buf.dtype),
+                                            (0, p, 0))
+
+    k_buf = jax.vmap(write)(k_buf, k_new, position)
+    v_buf = jax.vmap(write)(v_buf, v_new, position)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(ch, jnp.float32))
+    scores = jnp.einsum("bhqc,bhkc->bhqk", q, k_buf).astype(jnp.float32) * scale
+    key_idx = jnp.arange(tmax, dtype=jnp.int32)[None, None, None, :]
+    q_pos = (position[:, None, None, None]
+             + jnp.arange(tq, dtype=jnp.int32)[None, None, :, None])
+    scores = jnp.where(key_idx <= q_pos, scores, -jnp.inf)
+    att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkc->bhqc", att, v_buf)
+    return out, k_buf, v_buf
+
+
+# --------------------------------------------------------------------------
 # blessed fused attention entry point
 # --------------------------------------------------------------------------
 def _reference_mha(q, k, v, mask=None, causal=False):
@@ -108,17 +166,34 @@ def _reference_mha(q, k, v, mask=None, causal=False):
 
 
 @register("multi_head_attention", aliases=("_contrib_multi_head_attention",))
-def multi_head_attention(q, k, v, mask=None, causal=False, use_flash="auto"):
+def multi_head_attention(q, k, v, mask=None, causal=False, use_flash="auto",
+                         cache=None, position=None):
     """Fused scaled-dot-product attention over (B, H, T, Ch) tensors.
 
     ``use_flash='auto'`` picks the Pallas flash kernel on TPU backends when
     shapes are tile-friendly, otherwise the XLA einsum path.
+
+    ``cache=(k_buf, v_buf), position=`` switches to the autoregressive
+    cached path (docs/INFERENCE.md): k/v carry only the *new* positions,
+    the buffers hold the whole static max-length history, and the call
+    returns ``(out, k_buf', v_buf')`` instead of just ``out``. ``position``
+    is a per-row ``(B,)`` int32 (or scalar) start index; masking enforces
+    the same causal structure as ``causal=True`` on the full sequence.
     """
     from . import flash_attention as fa
     from ..contrib.amp import cast_inputs
 
     orig_dtype = q.dtype
     q, k, v = cast_inputs(q, k, v)  # AMP: score/context matmuls on the MXU
+    if cache is not None:
+        if position is None:
+            raise ValueError("multi_head_attention(cache=...) needs position=")
+        k_buf, v_buf = (_unwrap(c) for c in cache)
+        position = jnp.asarray(_unwrap(position), jnp.int32)
+        if position.ndim == 0:
+            position = jnp.broadcast_to(position, (q.shape[0],))
+        out, k_buf, v_buf = _cached_mha(q, k, v, k_buf, v_buf, position)
+        return out.astype(orig_dtype), k_buf, v_buf
     if use_flash == "auto":
         use_flash = fa.flash_supported(q, k, v, mask)
     if use_flash:

@@ -63,6 +63,37 @@ def sample_multinomial(data, shape=(), get_prob=False, dtype="int32", key=None):
     return idx
 
 
+# --------------------------------------------------------------------------
+# LM decoding samplers (inference engine, docs/INFERENCE.md). Pure jnp and
+# key-explicit so the GenerationEngine can compile them INTO the decode
+# program (the key is a traced argument, not global state) — but they are
+# registered ops too, so eager `nd.top_k_sampling(logits)` draws from the
+# global chain like every other stochastic op.
+# --------------------------------------------------------------------------
+@register("temperature_sampling", stochastic=True)
+def temperature_sampling(logits, temperature=1.0, key=None):
+    """Sample token ids from ``softmax(logits / temperature)`` over the last
+    axis. ``temperature=0`` degenerates to greedy argmax (no key consumed by
+    the math — the branch is static)."""
+    if not temperature:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / float(temperature)
+    return jax.random.categorical(_key(key), scaled, axis=-1).astype(jnp.int32)
+
+
+@register("top_k_sampling", stochastic=True)
+def top_k_sampling(logits, k=40, temperature=1.0, key=None):
+    """Sample from the ``k`` highest-probability tokens (last axis): logits
+    below the k-th largest are masked to -inf, then temperature-sampled.
+    ``k<=0`` or ``k >= vocab`` means no truncation."""
+    k = int(k)
+    vocab = logits.shape[-1]
+    if 0 < k < vocab:
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return temperature_sampling(logits, temperature=temperature, key=key)
+
+
 @register("shuffle", aliases=("_shuffle",), stochastic=True)
 def shuffle(data, key=None):
     return jax.random.permutation(_key(key), data, axis=0)

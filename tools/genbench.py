@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""A/B gate for compiled KV-cache generation (`make genbench`).
+
+Times greedy generation on a tiny GPT-2 (CPU) two ways:
+
+  naive  — the only pre-engine option: re-forward the WHOLE growing
+           sequence eagerly for every token (O(L²) attention recompute,
+           a dispatch storm per step);
+  cached — ``GenerationEngine.generate``: bucketed prefill + the single
+           compiled decode step (donated KV-cache carry).
+
+Methodology mirrors ``make perfwin``: warm both paths first (compiles out
+of the timed region), then alternate naive/cached measurement pairs and
+take the MEDIAN per-pair speedup, so background load hits both sides of a
+pair equally. The gate FAILS unless
+
+  - both paths emit identical token streams (greedy, same params),
+  - the amortized per-token speedup is >= --min-speedup (default 3x),
+  - the engine lowered exactly (prefill buckets used + 1) programs, per
+    the ``gen_recompiles_total`` telemetry.
+
+Artifact: ``GENBENCH_r01.json`` (committed).
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _utc():
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+def build_net(vocab, max_length):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.models import gpt2
+
+    mx.random.seed(0)
+    net = gpt2.get_gpt2("gpt2_tiny", dropout=0.0, vocab_size=vocab,
+                        max_length=max_length)
+    net.initialize()
+    _ = net(nd.array(np.zeros((1, 4)), dtype="int32"))
+    return net
+
+
+def naive_generate(net, prompt, gen_len):
+    """Greedy token loop the way user code must write it without the
+    engine: eager full re-forward of the growing sequence every step."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+
+    seq = list(prompt)
+    for _ in range(gen_len):
+        logits = net(nd.array(np.asarray([seq]), dtype="int32")).asnumpy()
+        seq.append(int(np.argmax(logits[0, -1])))
+    return seq[len(prompt):]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prompt-len", type=int, default=16)
+    ap.add_argument("--gen-len", type=int, default=64)
+    ap.add_argument("--vocab", type=int, default=2048,
+                    help="trimmed vocab: keeps the naive loop affordable "
+                    "on CPU without changing the asymptotics")
+    ap.add_argument("--max-length", type=int, default=256)
+    ap.add_argument("--pairs", type=int, default=3,
+                    help="alternating naive/cached measurement pairs")
+    ap.add_argument("--min-speedup", type=float, default=3.0)
+    ap.add_argument("--out", default="GENBENCH_r01.json")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from mxnet_tpu.inference import GenerationEngine
+    from mxnet_tpu.observability import REGISTRY
+
+    net = build_net(args.vocab, args.max_length)
+    buckets = (args.prompt_len, args.prompt_len * 2)
+    eng = GenerationEngine(net, batch_size=1, max_length=args.max_length,
+                           prefill_buckets=buckets, eos_id=None, pad_id=0)
+    prompt = list(np.random.RandomState(7).randint(1, args.vocab,
+                                                   args.prompt_len))
+
+    # -- warm both paths (compiles / first-dispatch out of the timed region)
+    warm_cached = eng.generate([prompt], max_new_tokens=args.gen_len)[0]
+    warm_naive = naive_generate(net, prompt, args.gen_len)
+    if warm_cached != warm_naive:
+        print(f"FAIL: token streams diverge\n cached={warm_cached[:10]}...\n"
+              f" naive ={warm_naive[:10]}...")
+        return 1
+
+    pairs = []
+    for _ in range(args.pairs):
+        t0 = time.perf_counter()
+        naive_generate(net, prompt, args.gen_len)
+        t_naive = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        eng.generate([prompt], max_new_tokens=args.gen_len)
+        t_cached = time.perf_counter() - t0
+        pairs.append((t_naive, t_cached))
+
+    n_ms = statistics.median(p[0] for p in pairs) * 1e3 / args.gen_len
+    c_ms = statistics.median(p[1] for p in pairs) * 1e3 / args.gen_len
+    speedup = statistics.median(p[0] / p[1] for p in pairs)
+
+    counter = REGISTRY.get("gen_recompiles_total")
+    programs = int(counter.total()) if counter else 0
+    want_programs = 1 + 1  # one bucket used (prompt fits the first) + decode
+
+    row = {
+        "ts": _utc(),
+        "bench": "genbench",
+        "model": "gpt2_tiny",
+        "vocab": args.vocab,
+        "prompt_len": args.prompt_len,
+        "gen_len": args.gen_len,
+        "pairs": args.pairs,
+        "backend": jax.devices()[0].platform,
+        "naive_ms_per_token": round(n_ms, 3),
+        "cached_ms_per_token": round(c_ms, 3),
+        "speedup_median_of_pairs": round(speedup, 2),
+        "compiled_programs": programs,
+        "compiled_programs_expected": want_programs,
+        "prefill_buckets": list(buckets),
+        "tokens_match_naive": True,
+    }
+    out = os.path.join(REPO, args.out)
+    with open(out, "w") as f:
+        json.dump(row, f, indent=1)
+    print(json.dumps(row))
+
+    if programs != want_programs:
+        print(f"FAIL: {programs} compiled programs, expected {want_programs} "
+              "(per-token recompiles?)")
+        return 1
+    if speedup < args.min_speedup:
+        print(f"FAIL: cached decode {speedup:.2f}x over naive, "
+              f"gate needs >= {args.min_speedup}x")
+        return 1
+    print(f"OK: cached decode {speedup:.2f}x faster per token "
+          f"({c_ms:.2f} vs {n_ms:.2f} ms/token), {programs} programs")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
