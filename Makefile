@@ -16,7 +16,7 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity native fast slow test chaos obs perfwin genbench bench clean
+.PHONY: ci sanity native fast slow test chaos obs perfwin genbench ampbench bench clean
 
 ci: sanity native fast
 
@@ -63,6 +63,14 @@ perfwin: native
 # committed as GENBENCH_r01.json
 genbench:
 	$(PY) tools/genbench.py --out GENBENCH_r01.json
+
+# compiled mixed-precision gate (docs/PERFORMANCE.md "Mixed precision"):
+# HLO dtype assertions (bf16 dots + f32 master update, f16 loss scaling
+# fully in-graph) + memory_analysis remat delta (>=30% peak temp bytes on
+# the long-context step) + a dispatch-isolated f32-vs-bf16 step-time A/B
+# (recorded, not gated on CPU); artifact committed as AMPBENCH_r01.json
+ampbench:
+	$(PY) tools/ampbench.py --out AMPBENCH_r01.json
 
 test: sanity native
 	$(PY) -m pytest tests/ -q

@@ -11,23 +11,38 @@ scaler; ``convert_model``/Block casting maps to ``net.cast``.
 Op lists survive conceptually: matmul/conv-class ops run in bf16, reductions
 and normalizations accumulate f32 (the ops in ``mxnet_tpu.ops`` already do
 f32 accumulation internally — see ``_reduce``/``layer_norm``/``batch_norm``).
+
+Two layers now coexist (docs/MIGRATING.md "amp.init → compiled-policy
+mapping"):
+
+  - the host-side surface above, for imperative ``Trainer.step`` loops;
+  - the **compiled policy** (:class:`Policy` / :func:`resolve_policy`),
+    threaded into ``parallel.TrainStep(amp=...)``: casts live inside the
+    jitted program against fp32 master weights, and float16's dynamic loss
+    scaling runs entirely in-graph. ``amp="auto"`` (the TrainStep default)
+    inherits the ``init()`` dtype, so existing ``amp.init()`` scripts get
+    the compiled policy for free.
 """
 from __future__ import annotations
 
 import contextlib
-import threading
+import dataclasses
 
 import jax.numpy as jnp
 
 __all__ = ["init", "init_trainer", "scale_loss", "convert_model", "LossScaler",
-           "amp_dtype"]
+           "amp_dtype", "Policy", "resolve_policy"]
 
-_STATE = threading.local()
-_STATE.dtype = None
+# PROCESS-global, deliberately not threading.local: amp.init() flips a
+# compile-affecting policy for the whole program, and both DataLoader
+# prefetch threads (ops reading compute_dtype) and TrainSteps built on
+# worker threads (resolve_policy("auto")) must see it — a thread-local
+# here silently degraded those to f32
+_STATE = {"dtype": None}
 
 
 def amp_dtype():
-    return getattr(_STATE, "dtype", None)
+    return _STATE["dtype"]
 
 
 def compute_dtype():
@@ -53,11 +68,71 @@ def cast_inputs(*arrays):
                  for a in arrays)
 
 
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Compiled-in mixed-precision policy (docs/PERFORMANCE.md "Mixed
+    precision").
+
+    Unlike the host-side ``init()``/``LossScaler`` compatibility surface,
+    a Policy is threaded INTO the jitted training program
+    (``parallel.TrainStep(amp=...)``): float32 parameters and model inputs
+    are cast to ``compute_dtype`` inside the traced loss, so XLA fuses the
+    casts away and every matmul-class op lowers to a low-precision dot,
+    while the *stored* parameters — the fp32 master weights — and the
+    optimizer update stay float32. For ``float16`` the dynamic loss scale
+    rides the compiled carry (scale / good-step counter / skipped total):
+    overflow detection is a compiled ``jnp.isfinite`` all-reduce feeding a
+    ``lax.cond`` skip-update, with no per-step host sync — replacing the
+    host-side ``LossScaler.has_overflow`` per-param loop, and compatible
+    with the k-step ``lax.scan`` window.
+    """
+
+    compute_dtype: str = "bfloat16"   # 'bfloat16' | 'float16'
+    loss_scale: float = 2.0 ** 16     # initial dynamic scale (float16 only)
+    scale_factor: float = 2.0
+    scale_window: int = 2000
+
+    def __post_init__(self):
+        if self.compute_dtype not in ("bfloat16", "float16"):
+            raise ValueError(f"Policy compute_dtype must be 'bfloat16' or "
+                             f"'float16', got {self.compute_dtype!r}")
+
+    @property
+    def jnp_compute_dtype(self):
+        return jnp.bfloat16 if self.compute_dtype == "bfloat16" else jnp.float16
+
+    @property
+    def dynamic_scaling(self) -> bool:
+        """bf16 shares f32's exponent range — only float16 needs scaling."""
+        return self.compute_dtype == "float16"
+
+
+def resolve_policy(amp):
+    """Normalize a TrainStep ``amp=`` argument to a Policy (or None).
+
+    ``"auto"`` inherits the process-global ``amp.init()`` dtype (None when
+    AMP was never initialised) — the compiled-policy mapping of the
+    reference's global flag. ``None``/``False`` disable; a dtype string or
+    an explicit Policy pass through.
+    """
+    if amp is None or amp is False:
+        return None
+    if isinstance(amp, Policy):
+        return amp
+    if amp == "auto":
+        d = amp_dtype()
+        return None if d is None else Policy(compute_dtype=d)
+    if isinstance(amp, str):
+        return Policy(compute_dtype=amp)
+    raise TypeError(f"amp= must be 'auto', None, a dtype string, or a "
+                    f"Policy, got {type(amp)}")
+
+
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
     """Enable AMP globally. On TPU target_dtype defaults to bfloat16."""
     assert target_dtype in ("bfloat16", "float16")
-    _STATE.dtype = target_dtype
+    _STATE["dtype"] = target_dtype
     # invalidate jit programs traced under the previous policy — otherwise a
     # hybridized net keeps replaying its f32 dots and AMP silently no-ops
     from ..gluon import block as _block
@@ -101,7 +176,7 @@ def list_widest_type_cast_ops(target_dtype="bfloat16"):
 
 def _reset():
     """Disable AMP (test hook)."""
-    _STATE.dtype = None
+    _STATE["dtype"] = None
     # invalidate jit caches traced under a different amp policy
     from ..gluon import block as _block
 
@@ -143,6 +218,13 @@ class LossScaler:
 def init_trainer(trainer):
     trainer._amp_loss_scaler = LossScaler()
     trainer._amp_original_scale = trainer._scale
+    # float16 weights need f32 master math (reference: AMP forces
+    # multi_precision optimizers); harmless when weights are f32/bf16.
+    # States created before the flip keep working: the self-describing
+    # {"master", "base"} layout lets update_multi_precision adopt a plain
+    # state as the base (momentum preserved) and re-derive the master.
+    if amp_dtype() == "float16":
+        trainer._optimizer.multi_precision = True
 
 
 @contextlib.contextmanager

@@ -151,8 +151,38 @@ def _flatten_nds(out):
     return raws, rebuild
 
 
+def _resolve_remat_policy(remat):
+    """Normalize a ``hybridize(remat=...)`` value to a jax.checkpoint policy.
+
+    ``True``/``'full'`` → save nothing (recompute everything in backward);
+    a string names a ``jax.checkpoint_policies`` member (``'dots_saveable'``,
+    ``'nothing_saveable'``, ``'dots_with_no_batch_dims_saveable'``, ...);
+    a callable passes through as a custom policy.
+    """
+    if remat is True or remat == "full":
+        return None  # jax.checkpoint default: save nothing
+    if callable(remat):
+        return remat
+    if isinstance(remat, str):
+        pol = getattr(jax.checkpoint_policies, remat, None)
+        if pol is None:
+            avail = [n for n in dir(jax.checkpoint_policies)
+                     if not n.startswith("_")]
+            raise ValueError(f"unknown remat policy {remat!r}; available: "
+                             f"'full', {avail}")
+        return pol
+    raise ValueError(f"remat= must be True, 'full', a jax.checkpoint_policies "
+                     f"name, or a callable policy, got {remat!r}")
+
+
 class Block:
     """Base container: parameter registration + eager forward."""
+
+    # classes that form a rematerialization unit under ``hybridize(remat=)``
+    # (one jax.checkpoint per instance): the transformer/GPT-2/BERT layer
+    # stacks set this True so long-context training trades flops for peak
+    # activation memory deliberately (docs/PERFORMANCE.md "Mixed precision")
+    _remat_unit = False
 
     def __init__(self, prefix=None, params=None):
         self._empty_init_done = True
@@ -162,6 +192,7 @@ class Block:
         self._reg_params = OrderedDict()
         self._forward_hooks = []
         self._forward_pre_hooks = []
+        self._remat = None
 
     def _alias(self):
         return self.__class__.__name__.lower()
@@ -293,6 +324,13 @@ class Block:
         raise NotImplementedError
 
     def hybridize(self, active=True, **kwargs):
+        # remat threads recursively: every block stores the policy, but only
+        # ``_remat_unit`` classes actually wrap their forward in
+        # jax.checkpoint (one unit per layer, no nesting in the model zoos).
+        # remat=False clears; remat=None (absent) leaves the setting alone.
+        r = kwargs.get("remat", None)
+        if r is not None:
+            self._remat = None if r is False else r
         for child in self._children.values():
             child.hybridize(active, **kwargs)
 
@@ -352,11 +390,23 @@ class HybridBlock(Block):
         self._static_alloc = False
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
-                  inline_limit=2, forward_bulk_size=None, backward_bulk_size=None):
+                  inline_limit=2, forward_bulk_size=None, backward_bulk_size=None,
+                  remat=None):
+        """``remat=`` installs an activation-rematerialization policy on this
+        block and its children: ``True``/``'full'`` (recompute everything),
+        a ``jax.checkpoint_policies`` name such as ``'dots_saveable'``, or a
+        callable; ``False`` clears it. Applied as ``jax.checkpoint`` around
+        each ``_remat_unit`` layer when the forward is staged (TrainStep or
+        a hybridized jit) — set it BEFORE building a TrainStep, whose
+        program cache does not watch this flag."""
         self._active = active
         self._static_alloc = static_alloc  # maps to buffer donation (future)
+        if remat is not None:
+            if remat is not False:
+                _resolve_remat_policy(remat)  # validate eagerly
+            self._remat = None if remat is False else remat
         self._jit_cache.clear()
-        super().hybridize(active)
+        super().hybridize(active, remat=remat)
 
     def infer_shape(self, *args):
         """Hook for deferred-init shape inference; layers override."""
@@ -391,10 +441,52 @@ class HybridBlock(Block):
 
     # -- staged call --------------------------------------------------------
     def __call__(self, *args, **kwargs):
+        if (self._remat is not None and type(self)._remat_unit
+                and _TRACE.active and not _TRACE.force_eager
+                and not _TRACE.symbolic):
+            # inside a staged trace (TrainStep loss or a hybridized jit):
+            # wrap this layer in jax.checkpoint so its activations are
+            # recomputed, not saved, during backward
+            return self._call_remat(args, kwargs)
         if (not self._active or _TRACE.active or _TRACE.force_eager
                 or _TRACE.symbolic or kwargs):
             return super().__call__(*args, **kwargs)
         return self._call_cached(*args)
+
+    def _call_remat(self, args, kwargs):
+        """Run this block's forward under ``jax.checkpoint`` with the
+        installed policy. Parameters and NDArray arguments enter as explicit
+        checkpoint inputs (differentiation-correct); non-array arguments
+        (None masks, python flags) ride the closure. Blocks that record
+        state updates (BatchNorm) must not be remat units — the state tape
+        would leak tracers out of the checkpointed trace."""
+        policy = _resolve_remat_policy(self._remat)
+        plist = [p for _, p in sorted(self.collect_params().items())]
+        if any(p._nd is None for p in plist):
+            return Block.__call__(self, *args, **kwargs)  # deferred init
+        param_raws = tuple(p._nd._data for p in plist)
+        nd_idx = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+        arg_raws = tuple(args[i]._data for i in nd_idx)
+        cell = {}
+
+        def fn(praws, araws):
+            saved = [p._nd._data for p in plist]
+            for p, r in zip(plist, praws):
+                p._nd._data = r
+            try:
+                call_args = list(args)
+                for i, r in zip(nd_idx, araws):
+                    call_args[i] = NDArray(r)
+                out = Block.__call__(self, *call_args, **kwargs)
+            finally:
+                for p, s in zip(plist, saved):
+                    p._nd._data = s
+            raws, rebuild = _flatten_nds(out)
+            cell["rebuild"] = rebuild
+            return tuple(raws)
+
+        out_raws = jax.checkpoint(fn, policy=policy)(param_raws, arg_raws)
+        return cell["rebuild"]([NDArray(r) for r in out_raws])
 
     def _call_cached(self, *args):
         plist = [p for _, p in sorted(self.collect_params().items())]

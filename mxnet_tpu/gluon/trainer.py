@@ -58,6 +58,12 @@ class Trainer:
         self._obs_steps = 0
         # fused multi-step path (run()): lazily-built TrainStep, cached per net
         self._fused = None
+        # cumulative compiled-f16-policy overflow skips across EVERY fused
+        # TrainStep this trainer ever built: num_update counts attempted
+        # steps, so applied = num_update - this. Kept here (not on the
+        # TrainStep) so a fused-cache miss doesn't forget historical skips
+        # and inflate the next step's Adam t
+        self._amp_compiled_skips = 0
 
     @property
     def optimizer(self):
@@ -73,7 +79,10 @@ class Trainer:
     def _ensure_states(self):
         for i, p in enumerate(self._params):
             if not self._states_created[i]:
-                self._states[i] = self._optimizer.create_state(i, p.data())
+                # multi_precision optimizers get an fp32 master copy in the
+                # state when the stored weight is f16/bf16 (reference AMP)
+                self._states[i] = self._optimizer.create_state_multi_precision(
+                    i, p.data())
                 self._states_created[i] = True
 
     def allreduce_grads(self):
@@ -169,7 +178,7 @@ class Trainer:
 
     # -- fused multi-step training (docs/PERFORMANCE.md) ---------------------
     def run(self, net, loss_fn, data_iter, steps=None, window=None,
-            accum=None, mesh=None, rules=None, n_model_inputs=1):
+            accum=None, mesh=None, rules=None, n_model_inputs=1, amp="auto"):
         """Compiled k-step training windows over this trainer's optimizer.
 
         Builds (and caches) a :class:`~mxnet_tpu.parallel.TrainStep` for
@@ -188,15 +197,21 @@ class Trainer:
 
         from ..parallel.train_step import TrainStep
 
+        from ..contrib.amp import resolve_policy
+
         ts = None
-        sig = (net, loss_fn, mesh, rules, n_model_inputs)
-        if self._fused is not None and all(
-                a is b for a, b in zip(self._fused[0], sig)):
+        # resolve the amp policy up front so the cache key distinguishes
+        # "auto" resolved under different global amp.init states
+        policy = resolve_policy(amp)
+        sig = (net, loss_fn, mesh, rules, n_model_inputs, policy)
+        if self._fused is not None and len(self._fused[0]) == len(sig) and all(
+                a is b or a == b for a, b in zip(self._fused[0], sig)):
             ts = self._fused[1]
         if ts is None:
             self._ensure_states()
             ts = TrainStep(net, loss_fn, self._optimizer, mesh=mesh,
-                           rules=rules, n_model_inputs=n_model_inputs)
+                           rules=rules, n_model_inputs=n_model_inputs,
+                           amp=policy)
             self._fused = (sig, ts)
         # re-seed the fused side from the imperative state EVERY call:
         # interleaved step()s replace p._nd._data and self._states, and a
@@ -210,9 +225,26 @@ class Trainer:
         for i, p in enumerate(self._params):
             if self._states_created[i] and p.name in ts.opt_state \
                     and self._states[i] is not None:
+                st = self._states[i]
+                # multi-precision states carry {"master": f32, "base": ...};
+                # the fused step trains the stored weights directly, so seed
+                # it with the base only (master re-derived on sync-back)
+                if isinstance(st, dict) and "master" in st:
+                    st = st["base"]
                 ts.opt_state[p.name] = jax.tree_util.tree_map(
-                    jnp.asarray, self._states[i])
-        ts.step_count = jnp.asarray(self._optimizer.num_update, jnp.int32)
+                    jnp.asarray, st)
+        # Seed Adam's t with APPLIED steps. num_update counts ATTEMPTED
+        # steps, and the compiled f16 policy holds t back on overflow-
+        # skipped ones; _index_update_count tracks applied steps in BOTH
+        # paths (imperative _update_count, and the finally block below),
+        # so its max is the authoritative applied clock — num_update minus
+        # the trainer's cumulative skips covers states restored without
+        # index counts (e.g. a TrainStep.restore that only set num_update)
+        skipped = ts.amp_skipped_steps if ts.amp_state is not None else 0
+        counts = self._optimizer._index_update_count
+        applied = max(max(counts.values(), default=0),
+                      self._optimizer.num_update - self._amp_compiled_skips)
+        ts.step_count = jnp.asarray(applied, jnp.int32)
         before = self._optimizer.num_update
         try:
             losses = ts.run(data_iter, steps, window=window, accum=accum)
@@ -222,10 +254,16 @@ class Trainer:
             # the post-window params back — its old buffers were donated to
             # the window program — and the counters must stay consistent
             ts.sync()
-            # advance the per-index counters by the steps actually run: a
-            # later imperative step() reads its Adam/schedule t from
-            # _index_update_count, and num_update is the max() over them
+            # advance the per-index counters by the steps actually APPLIED:
+            # a later imperative step() reads its Adam/schedule t from
+            # _index_update_count, and the compiled f16 policy holds t back
+            # on overflow-skipped steps — mirroring attempted steps here
+            # would inflate the imperative t by one per compiled skip
             ran = self._optimizer.num_update - before
+            if ts.amp_state is not None:
+                new_skips = ts.amp_skipped_steps - skipped
+                self._amp_compiled_skips += new_skips
+                ran -= new_skips
             for i in range(len(self._params)):
                 self._optimizer._index_update_count[i] = \
                     self._optimizer._index_update_count.get(i, 0) + ran
@@ -233,6 +271,13 @@ class Trainer:
             for name, st in ts.opt_state.items():
                 i = name2idx.get(name)
                 if i is not None:
+                    p = self._params[i]
+                    if self._optimizer._needs_master(p.data()._data):
+                        # rebuild the multi-precision layout from the synced
+                        # low-precision weight (master extra bits reset at
+                        # the fused/imperative boundary)
+                        st = {"master": p.data()._data.astype(jnp.float32),
+                              "base": st}
                     self._states[i] = st
                     self._states_created[i] = True
         self._check_preemption()
@@ -250,6 +295,16 @@ class Trainer:
                     continue
                 raise MXNetError(f"Parameter {p.name} has no gradient; call "
                                  "attach_grad via initialize + record/backward")
+            # fp32-master path for low-precision stored weights: per-param
+            # (the (master, base) state tuple does not fit the fused
+            # multi-tensor program NOR the row-sparse lazy gather, so it
+            # must be checked FIRST — a low-precision row_sparse param
+            # takes the dense master update and drops laziness)
+            if self._optimizer._needs_master(d._data):
+                p._sparse_rows = None
+                self._states[i] = self._optimizer.update_multi_precision(
+                    i, d, d._grad, self._states[i])
+                continue
             # row-sparse gradient path (reference lazy_update): compact the
             # cotangent to the rows recorded by the layer and run the
             # rows-only optimizer update; state math never touches untouched

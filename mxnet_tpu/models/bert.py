@@ -58,6 +58,12 @@ class BERTAttention(HybridBlock):
 
 
 class BERTEncoderLayer(HybridBlock):
+    # remat unit under ``net.hybridize(remat=...)``: the post-LN encoder
+    # layer's activations are recomputed in backward instead of saved —
+    # the deliberate flops-for-memory trade, replacing GSPMD's involuntary
+    # full remat fallback (docs/PERFORMANCE.md "Mixed precision")
+    _remat_unit = True
+
     def __init__(self, units, hidden_size, num_heads, dropout=0.1, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():

@@ -35,6 +35,12 @@ gpt2_configs = {
 
 
 class GPT2Block(HybridBlock):
+    # one pre-LN decoder block = one rematerialization unit under
+    # ``net.hybridize(remat=...)``: long-context training recomputes the
+    # block's activations (attention scores included) during backward
+    # instead of saving them (docs/PERFORMANCE.md "Mixed precision")
+    _remat_unit = True
+
     def __init__(self, units, num_heads, dropout=0.1, **kwargs):
         super().__init__(**kwargs)
         self._heads = num_heads

@@ -88,6 +88,9 @@ class _FFN(HybridBlock):
 
 
 class EncoderLayer(HybridBlock):
+    # remat unit under ``net.hybridize(remat=...)`` — see gpt2.GPT2Block
+    _remat_unit = True
+
     def __init__(self, units, hidden_size, num_heads, dropout=0.1, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
@@ -102,6 +105,9 @@ class EncoderLayer(HybridBlock):
 
 
 class DecoderLayer(HybridBlock):
+    # remat unit under ``net.hybridize(remat=...)`` — see gpt2.GPT2Block
+    _remat_unit = True
+
     def __init__(self, units, hidden_size, num_heads, dropout=0.1, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():

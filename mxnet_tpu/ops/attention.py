@@ -173,6 +173,13 @@ def multi_head_attention(q, k, v, mask=None, causal=False, use_flash="auto",
     ``use_flash='auto'`` picks the Pallas flash kernel on TPU backends when
     shapes are tile-friendly, otherwise the XLA einsum path.
 
+    Dtype policy: every path (flash kernel, einsum reference, chunked, and
+    the cached decode path below) computes scores, the softmax, and its
+    normalizer in float32 regardless of the input dtype, and returns the
+    caller's dtype — so a compiled bf16/f16 AMP policy
+    (``parallel.TrainStep(amp=...)``) changes ONLY the q/k/v and
+    att-times-v matmul precision, never the softmax numerics.
+
     ``cache=(k_buf, v_buf), position=`` switches to the autoregressive
     cached path (docs/INFERENCE.md): k/v carry only the *new* positions,
     the buffers hold the whole static max-length history, and the call

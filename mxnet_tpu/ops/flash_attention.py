@@ -64,6 +64,11 @@ def flash_supported(q, k, v, mask=None) -> bool:
     # inside _flash_fwd (zeros in the contraction dim leave scores exact,
     # padded v columns are sliced off). d % 64 == 0 bounds the pad waste at
     # 2x and admits BERT/GPT's d=64 heads (round-2 verdict weak #4)
+    # dtype gate: f32/bf16 only — the MXU's native pair, and the kernel's
+    # scratch accumulators are f32 either way. A float16 AMP policy
+    # (TrainStep(amp='float16')) deliberately falls back to the XLA paths,
+    # whose softmax also runs f32 (see multi_head_attention's dtype policy);
+    # f16 buys nothing on TPU over bf16 and would need its own Mosaic tiling
     return (tq % 128 == 0 and tk % 128 == 0 and d % 64 == 0
             and (max(tq, tk) >= _FLASH_MIN_SEQ
                  or b * h * tq * tk * 4 >= _FLASH_MEM_BYTES)

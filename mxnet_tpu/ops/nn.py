@@ -221,6 +221,13 @@ def softmax(data, axis=-1, temperature=None, length=None):
         shape = [1] * data.ndim
         shape[0], shape[axis] = mask.shape[0], mask.shape[1]
         data = jnp.where(mask.reshape(shape), data, -jnp.inf)
+    # dtype-aware f32 softmax: softmax is an _F32_OPS member of the AMP
+    # policy — low-precision scores (bf16/f16 under the compiled policy)
+    # normalize in f32 and return in the caller's dtype, matching the f32
+    # accumulation the fused attention paths already do internally
+    if data.dtype in (jnp.float16, jnp.bfloat16):
+        return jax.nn.softmax(data.astype(jnp.float32),
+                              axis=int(axis)).astype(data.dtype)
     return jax.nn.softmax(data, axis=int(axis))
 
 
@@ -228,6 +235,11 @@ def softmax(data, axis=-1, temperature=None, length=None):
 def log_softmax(data, axis=-1, temperature=None):
     if temperature is not None and temperature != 1.0:
         data = data / temperature
+    # same f32 policy as softmax: log_softmax feeds cross-entropy losses,
+    # where bf16 log-probabilities would visibly bias the loss trajectory
+    if data.dtype in (jnp.float16, jnp.bfloat16):
+        return jax.nn.log_softmax(data.astype(jnp.float32),
+                                  axis=int(axis)).astype(data.dtype)
     return jax.nn.log_softmax(data, axis=int(axis))
 
 

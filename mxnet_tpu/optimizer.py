@@ -107,6 +107,63 @@ class Optimizer:
         changes never retrigger XLA compilation."""
         raise NotImplementedError
 
+    # -- fp32 master weights (reference: multi_precision optimizers) ---------
+    def _needs_master(self, raw):
+        return self.multi_precision and raw.dtype in (jnp.float16, jnp.bfloat16)
+
+    def create_state_multi_precision(self, index, weight):
+        """Like ``create_state``, but when ``multi_precision`` is set and the
+        weight is stored low-precision, the state carries an fp32 master
+        copy: ``{"master": f32, "base": base_state_of_master}``. The dict
+        layout is deliberately self-describing — no optimizer's plain state
+        is a dict, so a plain-layout state (created or checkpoint-restored
+        before ``multi_precision`` was flipped) can never be misread as a
+        master tuple; :meth:`update_multi_precision` ADOPTS such states as
+        the base and re-derives the master from the current weight. (The
+        compiled ``TrainStep`` path never needs any of this: its stored
+        params ARE the fp32 masters and the policy casts at compute time.)"""
+        raw = weight._data if hasattr(weight, "_data") else weight
+        if self._needs_master(raw):
+            master = raw.astype(jnp.float32)
+            return {"master": master, "base": self.create_state(index, master)}
+        return self.create_state(index, weight)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        """Update against the fp32 master (grad upcast, math f32), then cast
+        the result back into the stored low-precision weight."""
+        from .ndarray import NDArray
+        from .ndarray.sparse import RowSparseNDArray
+
+        raw = weight._data if hasattr(weight, "_data") else weight
+        if not self._needs_master(raw):
+            return self.update(index, weight, grad, state)
+        if isinstance(state, dict) and "master" in state:
+            master, base = state["master"], state["base"]
+        else:
+            # plain-layout state from before the multi_precision flip
+            # (in-process init_trainer, or Trainer.load_states /
+            # Updater.set_states restoring an old checkpoint): keep it as
+            # the base — momentum survives — and re-derive the master
+            master, base = raw.astype(jnp.float32), state
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        if isinstance(grad, RowSparseNDArray):
+            # lazy rows-only update, run against the f32 master (values
+            # upcast like the dense branch)
+            g32 = RowSparseNDArray(grad._data.astype(jnp.float32),
+                                   grad._aux, tuple(grad.shape))
+            master_nd = NDArray(master)
+            new_base = self._update_lazy(master_nd, g32, base, lr, wd, t)
+            new_master = master_nd._data
+        else:
+            graw = grad._data if hasattr(grad, "_data") else grad
+            new_master, new_base = self.update_raw(
+                master, graw.astype(jnp.float32), base,
+                jnp.float32(lr), jnp.float32(wd), jnp.int32(t))
+        weight._data = new_master.astype(raw.dtype)
+        return {"master": new_master, "base": new_base}
+
     # -- imperative protocol (Trainer / KVStore updater) ---------------------
     def update(self, index, weight, grad, state):
         from .ndarray.sparse import RowSparseNDArray
@@ -346,9 +403,14 @@ class Updater:
         self.states: Dict = {}
 
     def __call__(self, index, grad, weight):
+        # multi-precision aware (reference Updater dispatch): f16/bf16
+        # weights under a multi_precision optimizer get the fp32-master
+        # state and update, same as Trainer._update
         if index not in self.states:
-            self.states[index] = self.optimizer.create_state(index, weight)
-        self.states[index] = self.optimizer.update(index, weight, grad, self.states[index])
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+        self.states[index] = self.optimizer.update_multi_precision(
+            index, weight, grad, self.states[index])
 
     def get_states(self, dump_optimizer=False):
         import pickle

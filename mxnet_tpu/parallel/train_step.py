@@ -42,11 +42,26 @@ class TrainStep:
     batch_spec : PartitionSpec for each batch input (default shard dim0 on
         'dp' when the mesh has that axis).
     donate : donate param/opt-state buffers (default True).
+    amp : compiled-in mixed-precision policy — ``"auto"`` (default)
+        inherits the global ``contrib.amp.init`` dtype, ``"bfloat16"`` /
+        ``"float16"`` / a ``contrib.amp.Policy`` force one, ``None``
+        disables. Float32 params and model inputs are cast to the compute
+        dtype INSIDE the jitted program (XLA fuses the casts away; every
+        matmul lowers to a low-precision dot) while the stored params — the
+        fp32 master weights — and the optimizer update stay float32. Under
+        ``float16`` the dynamic loss scale rides the compiled carry:
+        overflow is a compiled isfinite-all-reduce feeding a ``lax.cond``
+        skip-update, no host sync, window-compatible. ``num_update`` counts
+        attempted steps; the compiled ``step_count`` (Adam's t) advances
+        only on applied ones.
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh: Optional[Mesh] = None,
                  rules: Optional[ShardingRules] = None, batch_spec=None,
-                 donate: bool = True, n_model_inputs: int = 1):
+                 donate: bool = True, n_model_inputs: int = 1, amp="auto"):
+        from ..contrib.amp import resolve_policy
+
+        self.amp_policy = resolve_policy(amp)
         self.net = net
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -66,6 +81,17 @@ class TrainStep:
             for i, p in enumerate(self._plist) if self._trainable[i]
         }
         self.step_count = jnp.zeros((), jnp.int32)
+        # fp16 dynamic loss scaling: compiled carry (docs/PERFORMANCE.md).
+        # bf16 shares f32's exponent range, so only float16 gets a scale.
+        if self.amp_policy is not None and self.amp_policy.dynamic_scaling:
+            self.amp_state = {
+                "scale": jnp.float32(self.amp_policy.loss_scale),
+                "good": jnp.int32(0),
+                "skipped": jnp.int32(0),
+            }
+        else:
+            self.amp_state = None
+        self._amp_skipped_seen = 0  # host mirror for the telemetry counter
         self._compute_specs = {}
         if mesh is not None:
             specs = self.rules.tree_specs(self.params, mesh)
@@ -160,6 +186,23 @@ class TrainStep:
             wd_mult[p.name] = wm * float(opt.wd_mult.get(p.name, 1.0))
         return lr_mult, wd_mult
 
+    def _amp_cast(self, params, batch):
+        """Cast f32 params + f32 MODEL inputs (not labels) to the policy's
+        compute dtype — called inside the traced loss, so the casts fuse
+        into the surrounding ops and grads flow back f32 to the masters."""
+        pol = self.amp_policy
+        if pol is None:
+            return params, batch
+        cd = pol.jnp_compute_dtype
+        params = {k: (v.astype(cd) if v.dtype == jnp.float32 else v)
+                  for k, v in params.items()}
+        n = self.n_model_inputs
+        batch = tuple(
+            b.astype(cd) if (i < n and hasattr(b, "dtype")
+                             and b.dtype == jnp.float32) else b
+            for i, b in enumerate(batch))
+        return params, batch
+
     def _grad_fn(self):
         """``value_and_grad`` of the ZeRO-aware loss, shared by the
         single-step and window programs.
@@ -169,13 +212,23 @@ class TrainStep:
         constraint's transpose reduce-scatters the grads back to the
         storage layout. Without this GSPMD may instead compute weight grads
         in the storage layout, forcing an involuntary full remat of the
-        activation cotangent (round-3 MULTICHIP tail warning)."""
-        def lossf(p, batch, key):
+        activation cotangent (round-3 MULTICHIP tail warning).
+
+        With an AMP policy the f32 masters are cast to the compute dtype
+        here, INSIDE the differentiated function: grads come back f32 (the
+        cast's transpose) while every model matmul runs low-precision.
+        ``scale`` (float16 dynamic loss scaling) multiplies the f32 loss —
+        the caller unscales grads and loss by 1/scale."""
+        def lossf(p, batch, key, scale=None):
             cp = dict(p)
             for name, cspec in self._compute_specs.items():
                 cp[name] = jax.lax.with_sharding_constraint(
                     p[name], NamedSharding(self.mesh, cspec))
-            return self._loss_of(cp, batch, key)
+            cp, batch = self._amp_cast(cp, batch)
+            loss = self._loss_of(cp, batch, key)
+            if scale is not None:
+                loss = loss * scale
+            return loss
 
         return jax.value_and_grad(lossf)
 
@@ -199,9 +252,61 @@ class TrainStep:
             k: jax.tree_util.tree_map(lambda _: self.param_sharding[k], v)
             for k, v in self.opt_state.items()}
 
+    def _next_amp_state(self, amp_state, finite):
+        """Compiled dynamic-loss-scale transition (reference LossScaler
+        semantics, in-graph): overflow halves the scale (floor 1.0) and
+        resets the good-step run; ``scale_window`` consecutive good steps
+        double it."""
+        pol = self.amp_policy
+        scale = amp_state["scale"]
+        good = jnp.where(finite, amp_state["good"] + 1, 0)
+        grow = good >= pol.scale_window
+        new_scale = jnp.where(
+            finite,
+            jnp.where(grow, scale * pol.scale_factor, scale),
+            jnp.maximum(scale / pol.scale_factor, 1.0))
+        return {"scale": new_scale.astype(jnp.float32),
+                "good": jnp.where(grow, jnp.int32(0), good).astype(jnp.int32),
+                "skipped": amp_state["skipped"]
+                + jnp.logical_not(finite).astype(jnp.int32)}
+
+    @staticmethod
+    def _finite_all(grads, names):
+        """One fused finiteness reduction over every trainable grad — the
+        compiled replacement for LossScaler.has_overflow's per-param loop."""
+        ok = jnp.asarray(True)
+        for n in names:
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(grads[n])))
+        return ok
+
+    def _scaled_update(self, params, opt_state, step_count, amp_state, grads,
+                      sloss, lr, wd, lr_mult, wd_mult):
+        """Unscale grads, gate the optimizer update on finiteness via
+        ``lax.cond`` (skip = identity carry, Adam's t frozen), advance the
+        amp carry. Shared by the single-step and window programs."""
+        inv = 1.0 / amp_state["scale"]
+        grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+        loss = sloss * inv
+        finite = self._finite_all(grads, list(opt_state))
+        t2 = step_count + 1
+
+        def _apply(_):
+            np_, ns = self._apply_update(params, opt_state, t2, grads, lr,
+                                         wd, lr_mult, wd_mult)
+            return np_, ns, t2
+
+        def _skip(_):
+            return dict(params), dict(opt_state), step_count
+
+        new_params, new_state, new_t = jax.lax.cond(finite, _apply, _skip,
+                                                    None)
+        return (new_params, new_state, new_t,
+                self._next_amp_state(amp_state, finite), grads, loss)
+
     def _make_step(self, n_batch, with_gnorm=False):
         lr_mult, wd_mult = self._resolve_mults()
         grad_fn = self._grad_fn()
+        scaling = self.amp_state is not None
 
         def step(params, opt_state, step_count, batch, key, lr, wd):
             loss, grads = grad_fn(params, batch, key)
@@ -216,17 +321,32 @@ class TrainStep:
                 return new_params, new_state, t, loss, jnp.sqrt(gsq)
             return new_params, new_state, t, loss
 
+        def step_scaled(params, opt_state, step_count, amp_state, batch, key,
+                        lr, wd):
+            sloss, grads = grad_fn(params, batch, key, amp_state["scale"])
+            (new_params, new_state, new_t, new_amp, grads,
+             loss) = self._scaled_update(params, opt_state, step_count,
+                                         amp_state, grads, sloss, lr, wd,
+                                         lr_mult, wd_mult)
+            if with_gnorm:
+                gsq = sum(jnp.sum(jnp.square(grads[n].astype(jnp.float32)))
+                          for n in opt_state)
+                return (new_params, new_state, new_t, new_amp, loss,
+                        jnp.sqrt(gsq))
+            return new_params, new_state, new_t, new_amp, loss
+
+        fn = step_scaled if scaling else step
         donate = (0, 1) if self.donate else ()
         if self.mesh is not None:
             opt_shardings = self._opt_shardings()
+            rep = NamedSharding(self.mesh, P())
             in_shardings = (
                 self.param_sharding,
                 opt_shardings,
-                NamedSharding(self.mesh, P()),
+                rep,
+            ) + ((rep,) if scaling else ()) + (
                 tuple(self.batch_sharding for _ in range(n_batch)),
-                NamedSharding(self.mesh, P()),
-                NamedSharding(self.mesh, P()),
-                NamedSharding(self.mesh, P()),
+                rep, rep, rep,
             )
             # pin outputs to the storage layout: without this the ZeRO
             # compute-gather lets GSPMD return some updated params gathered,
@@ -234,15 +354,14 @@ class TrainStep:
             out_shardings = (
                 self.param_sharding,
                 opt_shardings,
-                NamedSharding(self.mesh, P()),
-                NamedSharding(self.mesh, P()),
-            )
+                rep,
+            ) + ((rep,) if scaling else ()) + (rep,)
             if with_gnorm:
-                out_shardings = out_shardings + (NamedSharding(self.mesh, P()),)
-            return jax.jit(step, donate_argnums=donate,
+                out_shardings = out_shardings + (rep,)
+            return jax.jit(fn, donate_argnums=donate,
                            in_shardings=in_shardings,
                            out_shardings=out_shardings)
-        return jax.jit(step, donate_argnums=donate)
+        return jax.jit(fn, donate_argnums=donate)
 
     def window_batch_sharding(self, accum: int = 1):
         """Sharding for a window-stacked batch array: the per-step batch
@@ -264,9 +383,43 @@ class TrainStep:
         With ``accum`` > 1 each scan step consumes ``accum`` stacked
         microbatches: gradients are accumulated in the fsdp *storage*
         layout (Xu et al. 2020 — accumulate sharded, never gathered) and
-        the optimizer applies the mean once per step."""
+        the optimizer applies the mean once per step.
+
+        Under a float16 AMP policy the dynamic loss scale rides the scan
+        carry: each in-window step scales its loss, checks finiteness, and
+        conditionally skips its update — no host sync anywhere in the
+        window, the contract the host-side LossScaler could never meet."""
         lr_mult, wd_mult = self._resolve_mults()
         grad_fn = self._grad_fn()
+        scaling = self.amp_state is not None
+
+        def _grads_of(p, batch, key, scale):
+            """(loss, grads) for one step — single batch or accum stack."""
+            if accum == 1:
+                return grad_fn(p, batch, key, scale)
+
+            def constrain(g):
+                if self.mesh is None:
+                    return g
+                return {k: (jax.lax.with_sharding_constraint(
+                                v, self.param_sharding[k])
+                            if k in self.param_sharding else v)
+                        for k, v in g.items()}
+
+            def micro(acc, mxs):
+                mb, midx = mxs
+                l, g = grad_fn(p, mb, jax.random.fold_in(key, midx), scale)
+                return (acc[0] + l,
+                        jax.tree_util.tree_map(
+                            jnp.add, acc[1], constrain(g))), None
+
+            zeros = constrain(
+                {k: jnp.zeros(v.shape, v.dtype) for k, v in p.items()})
+            (lsum, gsum), _ = jax.lax.scan(
+                micro, (jnp.float32(0.0), zeros),
+                (batch, jnp.arange(accum)))
+            return lsum / accum, jax.tree_util.tree_map(
+                lambda x: x / accum, gsum)
 
         def window_fn(params, opt_state, step_count, batches, keys, lrs, wd):
             # lrs is a [window] vector scanned alongside the batches: with
@@ -275,32 +428,7 @@ class TrainStep:
             def body(carry, xs):
                 p, s, t = carry
                 batch, key, lr = xs
-                if accum == 1:
-                    loss, grads = grad_fn(p, batch, key)
-                else:
-                    def constrain(g):
-                        if self.mesh is None:
-                            return g
-                        return {k: (jax.lax.with_sharding_constraint(
-                                        v, self.param_sharding[k])
-                                    if k in self.param_sharding else v)
-                                for k, v in g.items()}
-
-                    def micro(acc, mxs):
-                        mb, midx = mxs
-                        l, g = grad_fn(p, mb, jax.random.fold_in(key, midx))
-                        return (acc[0] + l,
-                                jax.tree_util.tree_map(
-                                    jnp.add, acc[1], constrain(g))), None
-
-                    zeros = constrain(
-                        {k: jnp.zeros(v.shape, v.dtype)
-                         for k, v in p.items()})
-                    (lsum, gsum), _ = jax.lax.scan(
-                        micro, (jnp.float32(0.0), zeros),
-                        (batch, jnp.arange(accum)))
-                    loss = lsum / accum
-                    grads = jax.tree_util.tree_map(lambda x: x / accum, gsum)
+                loss, grads = _grads_of(p, batch, key, None)
                 t2 = t + 1
                 np_, ns = self._apply_update(p, s, t2, grads, lr, wd,
                                              lr_mult, wd_mult)
@@ -319,6 +447,31 @@ class TrainStep:
                 return params, opt_state, t, losses, gnorms
             return params, opt_state, t, ys
 
+        def window_scaled(params, opt_state, step_count, amp_state, batches,
+                          keys, lrs, wd):
+            def body(carry, xs):
+                p, s, t, a = carry
+                batch, key, lr = xs
+                sloss, grads = _grads_of(p, batch, key, a["scale"])
+                (np_, ns, t2, a2, grads,
+                 loss) = self._scaled_update(p, s, t, a, grads, sloss, lr,
+                                             wd, lr_mult, wd_mult)
+                if with_gnorm:
+                    gsq = sum(jnp.sum(jnp.square(grads[n].astype(jnp.float32)))
+                              for n in s)
+                    return (np_, ns, t2, a2), (loss, jnp.sqrt(gsq))
+                return (np_, ns, t2, a2), loss
+
+            carry, ys = jax.lax.scan(
+                body, (params, opt_state, step_count, amp_state),
+                (tuple(batches), keys, lrs))
+            params, opt_state, t, amp_state = carry
+            if with_gnorm:
+                losses, gnorms = ys
+                return params, opt_state, t, amp_state, losses, gnorms
+            return params, opt_state, t, amp_state, ys
+
+        fn = window_scaled if scaling else window_fn
         donate = (0, 1) if self.donate else ()
         if self.mesh is not None:
             opt_shardings = self._opt_shardings()
@@ -326,16 +479,18 @@ class TrainStep:
             rep = NamedSharding(self.mesh, P())
             in_shardings = (
                 self.param_sharding, opt_shardings, rep,
+            ) + ((rep,) if scaling else ()) + (
                 tuple(wsharding for _ in range(n_batch)),
                 rep, rep, rep,
             )
-            out_shardings = (self.param_sharding, opt_shardings, rep, rep)
+            out_shardings = (self.param_sharding, opt_shardings, rep) \
+                + ((rep,) if scaling else ()) + (rep,)
             if with_gnorm:
                 out_shardings = out_shardings + (rep,)
-            return jax.jit(window_fn, donate_argnums=donate,
+            return jax.jit(fn, donate_argnums=donate,
                            in_shardings=in_shardings,
                            out_shardings=out_shardings)
-        return jax.jit(window_fn, donate_argnums=donate)
+        return jax.jit(fn, donate_argnums=donate)
 
     # -- public API ----------------------------------------------------------
     def __call__(self, *batch):
@@ -370,7 +525,17 @@ class TrainStep:
         lr = jnp.float32(self.optimizer.learning_rate)
         wd = jnp.float32(self.optimizer.wd)
         gnorm = None
-        if obs_on:
+        if self.amp_state is not None:
+            if obs_on:
+                (self.params, self.opt_state, self.step_count, self.amp_state,
+                 loss, gnorm) = step(self.params, self.opt_state,
+                                     self.step_count, self.amp_state, raws,
+                                     key, lr, wd)
+            else:
+                (self.params, self.opt_state, self.step_count, self.amp_state,
+                 loss) = step(self.params, self.opt_state, self.step_count,
+                              self.amp_state, raws, key, lr, wd)
+        elif obs_on:
             (self.params, self.opt_state, self.step_count, loss,
              gnorm) = step(self.params, self.opt_state, self.step_count,
                            raws, key, lr, wd)
@@ -503,7 +668,17 @@ class TrainStep:
             lrs = jnp.full((window,), opt.learning_rate, jnp.float32)
         wd = jnp.float32(opt.wd)
         gnorms = None
-        if obs_on:
+        if self.amp_state is not None:
+            if obs_on:
+                (self.params, self.opt_state, self.step_count, self.amp_state,
+                 losses, gnorms) = fn(self.params, self.opt_state,
+                                      self.step_count, self.amp_state,
+                                      batches, keys, lrs, wd)
+            else:
+                (self.params, self.opt_state, self.step_count, self.amp_state,
+                 losses) = fn(self.params, self.opt_state, self.step_count,
+                              self.amp_state, batches, keys, lrs, wd)
+        elif obs_on:
             (self.params, self.opt_state, self.step_count, losses,
              gnorms) = fn(self.params, self.opt_state, self.step_count,
                           batches, keys, lrs, wd)
@@ -546,11 +721,20 @@ class TrainStep:
                   shapes=[list(r.shape) for r in raws],
                   dtypes=[str(r.dtype) for r in raws])
 
+    def _amp_fetchable(self):
+        """(scale, skipped) device scalars to ride the telemetry fetch, or
+        None — so the amp gauges never cost a second host sync."""
+        if self.amp_state is None:
+            return None
+        return (self.amp_state["scale"], self.amp_state["skipped"])
+
     def _record_step(self, t0, raws, loss, gnorm):
         # reading loss/gnorm blocks on the device — when telemetry is on,
         # step time is the real wall-clock of the whole step, not dispatch
-        loss_f = float(jax.device_get(loss))
-        gnorm_f = float(jax.device_get(gnorm)) if gnorm is not None else None
+        loss_h, gnorm_h, amp_h = jax.device_get(
+            (loss, gnorm, self._amp_fetchable()))
+        loss_f = float(loss_h)
+        gnorm_f = float(gnorm_h) if gnorm_h is not None else None
         dt = time.perf_counter() - t0
         step_no = int(self.optimizer.num_update)
         _obs.set_step(step_no)
@@ -566,14 +750,31 @@ class TrainStep:
         _obs.gauge("train_loss").set(loss_f)
         if gnorm_f is not None:
             _obs.gauge("train_grad_norm").set(gnorm_f)
+        self._record_amp(amp_h)
         _obs.emit("train_step", loss=loss_f, grad_norm=gnorm_f,
                   step_seconds=round(dt, 6), samples=samples, tokens=tokens,
                   tokens_per_sec=round(tokens / dt, 3) if dt > 0 else 0.0)
 
+    def _record_amp(self, amp_h):
+        """Loss-scale gauge + skipped-step counter from the already-fetched
+        ``(scale, skipped)`` host pair (float16 policy only) — part of the
+        step/window's single telemetry sync, never a second device_get."""
+        if amp_h is None:
+            return
+        scale_f, skipped = amp_h
+        _obs.gauge("train_loss_scale",
+                   "current AMP dynamic loss scale").set(float(scale_f))
+        d = int(skipped) - self._amp_skipped_seen
+        if d > 0:
+            _obs.counter("train_amp_skipped_steps_total",
+                         "steps dropped by AMP overflow handling").inc(d)
+        self._amp_skipped_seen = int(skipped)
+
     def _record_window(self, t0, batches, losses, gnorms, window, accum):
-        # ONE device sync for the whole window: losses+gnorms fetched
-        # together, so window time is true wall clock of K fused steps
-        loss_h, gnorm_h = jax.device_get((losses, gnorms))
+        # ONE device sync for the whole window: losses+gnorms+amp carry
+        # fetched together, so window time is true wall clock of K fused steps
+        loss_h, gnorm_h, amp_h = jax.device_get(
+            (losses, gnorms, self._amp_fetchable()))
         dt = time.perf_counter() - t0
         _obs.set_step(int(self.optimizer.num_update))
         b0 = batches[0] if batches else None
@@ -591,6 +792,7 @@ class TrainStep:
         _obs.gauge("train_loss").set(float(loss_h[-1]))
         if gnorm_h is not None:
             _obs.gauge("train_grad_norm").set(float(gnorm_h[-1]))
+        self._record_amp(amp_h)
         _obs.emit("train_window", window=window, accum=accum,
                   loss=float(loss_h[-1]),
                   loss_mean=float(sum(float(x) for x in loss_h) / len(loss_h)),
@@ -649,6 +851,23 @@ class TrainStep:
         if self._preempt_exit:
             raise Preempted(g.signum)
 
+    # -- amp policy introspection (docs/PERFORMANCE.md) ----------------------
+    @property
+    def loss_scale(self):
+        """Current dynamic loss scale (host float; syncs). None unless the
+        policy is float16."""
+        if self.amp_state is None:
+            return None
+        return float(jax.device_get(self.amp_state["scale"]))
+
+    @property
+    def amp_skipped_steps(self):
+        """Total steps dropped by in-graph overflow handling (host int;
+        syncs). 0 unless the policy is float16."""
+        if self.amp_state is None:
+            return 0
+        return int(jax.device_get(self.amp_state["skipped"]))
+
     def sync(self):
         """Write compiled-side params back into the Gluon block."""
         for p in self._plist:
@@ -658,10 +877,24 @@ class TrainStep:
     def save(self, directory):
         from ..checkpoint import save_train_state
 
+        # the checkpoint step is num_update (ATTEMPTED steps, the schedule
+        # clock); the meta extras carry what differs from it under the f16
+        # policy: the APPLIED count (Adam's t, held back on skips) and the
+        # dynamic-loss-scale carry — without them a preemption restart
+        # would inflate t and reset the scale to its 2^16 init
+        extra = {"applied_step": int(jax.device_get(self.step_count))}
+        if self.amp_state is not None:
+            a = jax.device_get(self.amp_state)
+            extra["amp_state"] = {"scale": float(a["scale"]),
+                                  "good": int(a["good"]),
+                                  "skipped": int(a["skipped"])}
         return save_train_state(directory, int(self.optimizer.num_update),
-                                self.params, self.opt_state)
+                                self.params, self.opt_state, extra=extra)
 
     def restore(self, directory):
+        import json
+        import os
+
         from ..checkpoint import latest_checkpoint, load_train_state
 
         path = latest_checkpoint(directory)
@@ -671,10 +904,23 @@ class TrainStep:
             path, like=(self.params, self.opt_state))
         import jax.numpy as jnp
 
+        meta = {}
+        try:
+            with open(os.path.join(path, "meta.json")) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            pass  # pre-extra checkpoints: fall back to step for everything
         self.params = {k: jnp.asarray(v) for k, v in params.items()}
         self.opt_state = jax.tree_util.tree_map(jnp.asarray, opt_state)
-        self.step_count = jnp.asarray(step, jnp.int32)
+        self.step_count = jnp.asarray(int(meta.get("applied_step", step)),
+                                      jnp.int32)
         self.optimizer.num_update = step
+        if self.amp_state is not None and "amp_state" in meta:
+            a = meta["amp_state"]
+            self.amp_state = {"scale": jnp.float32(a["scale"]),
+                              "good": jnp.int32(a["good"]),
+                              "skipped": jnp.int32(a["skipped"])}
+            self._amp_skipped_seen = int(a["skipped"])
         if self.param_sharding is not None:
             self.params = {k: jax.device_put(v, self.param_sharding[k])
                            for k, v in self.params.items()}
@@ -702,6 +948,10 @@ class TrainStep:
             step = self._compiled[cache_key] = self._make_step(
                 len(raws), with_gnorm=obs_on)
         key = _rng.next_key()
-        return step.lower(self.params, self.opt_state, self.step_count, raws, key,
-                          jnp.float32(self.optimizer.learning_rate),
-                          jnp.float32(self.optimizer.wd))
+        lr = jnp.float32(self.optimizer.learning_rate)
+        wd = jnp.float32(self.optimizer.wd)
+        if self.amp_state is not None:
+            return step.lower(self.params, self.opt_state, self.step_count,
+                              self.amp_state, raws, key, lr, wd)
+        return step.lower(self.params, self.opt_state, self.step_count, raws,
+                          key, lr, wd)

@@ -333,3 +333,25 @@ class TestModulePredict:
         assert pred.shape == (8, 5)
         np.testing.assert_allclose(pred.asnumpy(), data @ w_np.T + b_np,
                                    rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# bf16 KV cache (ISSUE 5 satellite): cache_dtype='bfloat16' halves decode
+# cache HBM; greedy decoding must be token-identical to the fp32 cache on
+# the tiny GPT-2 config
+# ---------------------------------------------------------------------------
+class TestBf16KVCache:
+    def test_cache_dtype_threads_to_buffers(self):
+        net = _gpt2()
+        eng = _engine(net, cache_dtype="bfloat16")
+        for k_buf, v_buf in eng.cache:
+            assert k_buf.dtype == jnp.bfloat16 and v_buf.dtype == jnp.bfloat16
+
+    def test_greedy_tokens_identical_to_fp32_cache(self):
+        net = _gpt2(seed=3)
+        prompts = [_prompt(5, 31), _prompt(9, 32), _prompt(3, 33)]
+        ref = _engine(net, cache_dtype="float32").generate(
+            prompts, max_new_tokens=12)
+        bf16 = _engine(net, cache_dtype="bfloat16").generate(
+            prompts, max_new_tokens=12)
+        assert bf16 == ref

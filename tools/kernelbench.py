@@ -274,21 +274,29 @@ def main():
                           + (r.stderr or "")[-200:]))
 
     def _transient(case):
-        err = str(case.get("error", ""))
-        return any(s in err for s in ("remote_compile", "DEADLINE",
-                                      "UNAVAILABLE", "Socket closed"))
+        # both case-level "error" AND per-timing-label errors count:
+        # KERNELBENCH_r03 seq=4096 lost its flash timing to a
+        # 'flash_error: "read body: response body closed ..."' remote-compile
+        # RPC drop while the rest of the case succeeded — that's an infra
+        # failure, not a kernel result, and deserves one retry too
+        errs = [str(v) for k, v in case.items()
+                if k == "error" or k.endswith("_error")]
+        pats = ("remote_compile", "DEADLINE", "UNAVAILABLE", "Socket closed",
+                "read body", "response body closed")
+        return next((e for e in errs if any(p in e for p in pats)), None)
 
     n_bad = 0
     for spec in specs:
         try:
             case = _run_spec(spec)
-            if "error" in case and _transient(case):
+            first_err = _transient(case)
+            if first_err is not None:
                 # transient tunnel/compile-service failure: retry once after
                 # a pause instead of recording an infra error as a result
-                # (round-3 verdict weak #3)
+                # (round-3 verdict weak #3; ISSUE 5 extends to per-label)
                 time.sleep(20)
                 retry = _run_spec(spec)
-                retry["retried_after"] = case["error"][:120]
+                retry["retried_after"] = first_err[:120]
                 case = retry
         except subprocess.TimeoutExpired:
             case = dict(spec, error=f"timeout {args.timeout}s")
