@@ -1,11 +1,18 @@
 # One-command CI (reference: ci/build.py + ci/docker/runtime_functions.sh —
 # the function registry every CI stage called). Stages:
-#   sanity  - syntax/compile sweep over the package + tools (no linters in
-#             the image, so compileall is the lint floor)
+#   sanity  - syntax/compile sweep over the package + tools (the parse
+#             floor; semantic hazards are the `lint` stage's job)
+#   lint    - jit-hazard linter (tools/lint.py, docs/ANALYSIS.md): host
+#             syncs in compiled hot paths, trace-time branches,
+#             nondeterminism in op code, mutable defaults, unlocked
+#             global-registry mutation
+#   audit   - structural HLO audit (tools/audit.py): zero f64 in bf16
+#             programs, 100% donation coverage on the TrainStep and
+#             decode-cache carries, shape recompiles logged with a cause
 #   native  - build libmxtpu.so (C++ runtime: recordio/jpeg/runtime/c_api)
 #   fast    - pytest without @slow (target < 10 min on 8 virtual CPU devs)
 #   slow    - the @slow remainder (model compiles, 4-process launches)
-#   ci      - sanity + native + fast (the pre-merge gate)
+#   ci      - sanity + lint + native + fast + audit (the pre-merge gate)
 #   test    - full suite (ci + slow), what the driver effectively runs
 
 PY ?= python
@@ -16,12 +23,23 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity native fast slow test chaos obs perfwin genbench ampbench bench clean
+.PHONY: ci sanity lint audit native fast slow test chaos obs perfwin genbench ampbench bench clean
 
-ci: sanity native fast
+ci: sanity lint native fast audit
 
 sanity:
 	$(PY) -m compileall -q mxnet_tpu tools tests examples bench.py __graft_entry__.py
+
+# jit-hazard lint (docs/ANALYSIS.md): AST rules over the package + tools.
+# `python tools/lint.py --changed` is the fast pre-commit variant.
+lint:
+	$(PY) tools/lint.py
+
+# structural program audit (docs/ANALYSIS.md): lowers the bf16 step/window
+# and decode programs on CPU and asserts dtype purity, donation coverage,
+# and explained recompile causes
+audit:
+	$(PY) tools/audit.py
 
 native:
 	$(MAKE) -C native

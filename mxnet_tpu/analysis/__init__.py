@@ -1,0 +1,47 @@
+"""Static-analysis subsystem (docs/ANALYSIS.md).
+
+Two passes over two different artifacts:
+
+  - :mod:`~mxnet_tpu.analysis.hlo_audit` — structural analysis of the
+    *programs* XLA lowers/compiles: op/dtype census, dot-precision
+    coverage, collective inventory with replica-group spans, donation/
+    aliasing coverage, host-transfer + custom-call inventory, and program
+    fingerprints whose diff explains recompiles (:class:`RecompileGuard`).
+  - :mod:`~mxnet_tpu.analysis.astlint` — jit-hazard lint of the *source*:
+    host syncs inside compiled hot paths, Python branches on traced
+    values, nondeterminism in op code, mutable default args, unlocked
+    mutation of process-global registries (``tools/lint.py`` CLI,
+    ``make lint``).
+
+Everything that used to be a regex over ``as_text()`` output queries a
+:class:`ProgramReport` instead.
+"""
+from .hlo_audit import (  # noqa: F401
+    Collective,
+    DonationReport,
+    Fingerprint,
+    Op,
+    ProgramAudit,
+    ProgramReport,
+    RecompileGuard,
+    audit_compiled,
+    audit_lowered,
+    audit_text,
+    fingerprint_diff,
+)
+from .astlint import (  # noqa: F401
+    LintRule,
+    Violation,
+    lint_file,
+    lint_paths,
+    lint_source,
+    list_rules,
+)
+
+__all__ = [
+    "Op", "Collective", "DonationReport", "ProgramReport", "ProgramAudit",
+    "audit_text", "audit_lowered", "audit_compiled",
+    "Fingerprint", "fingerprint_diff", "RecompileGuard",
+    "LintRule", "Violation", "lint_source", "lint_file", "lint_paths",
+    "list_rules",
+]

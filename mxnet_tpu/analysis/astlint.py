@@ -1,0 +1,602 @@
+"""jit-hazard linter: custom AST rules over the package source.
+
+``compileall`` (the Makefile's old "lint floor") only proves the source
+parses. The hazards that actually burn this codebase are *semantic*:
+a ``float()`` host sync hiding inside a compiled hot path, a Python
+``if`` on a traced value, wall-clock or global-RNG nondeterminism in op
+code, a mutable default argument, an unlocked mutation of a process-global
+registry that DataLoader worker threads also touch. Each is an AST
+pattern, so each is a rule here.
+
+Rules (docs/ANALYSIS.md has the full catalog with examples):
+
+  JH001 host-sync-in-hot-path   ``.item()``/``.asnumpy()``/``.tolist()``,
+                                ``float()/int()/bool()``, ``np.asarray``/
+                                ``np.array``, ``jax.device_get`` inside a
+                                compiled hot path.
+  JH002 traced-branch           Python ``if``/``while`` testing a traced
+                                function argument inside a hot path
+                                (trace-time branching; use ``lax.cond``/
+                                ``jnp.where``).
+  JH003 nondeterminism          ``time.time``/``datetime.now``/global
+                                ``np.random.*``/stdlib ``random.*`` in op
+                                modules or hot paths.
+  JH004 mutable-default-arg     ``def f(x=[], y={}, z=set())``.
+  JH005 unlocked-global-mutation  mutating a module-global dict/list/set
+                                outside any ``with <lock>:`` block.
+
+**Hot paths** are found two ways: structurally — any function passed to
+(or decorated with) ``jax.jit``/``pmap``/``checkpoint``/``shard_map``,
+including everything lexically nested inside it — and by registration
+(:data:`EXTRA_HOT_PATHS` names the helpers those jitted closures call,
+e.g. ``TrainStep._loss_of``, which tracing reaches interprocedurally).
+
+**Suppressions** are per-rule and inline::
+
+    x = float(y)  # lint: disable=JH001  -- TTFT sync point, documented
+
+on the flagged line (or the line above). A comment on a ``def`` line
+suppresses the rule for the whole function body. File-level:
+``# lint: disable-file=JH005`` anywhere in the file. Suppressing takes a
+rule list (``disable=JH001,JH004``) or ``all``.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["LintRule", "Violation", "lint_source", "lint_file",
+           "lint_paths", "list_rules", "RULES", "EXTRA_HOT_PATHS"]
+
+
+RULES: Dict[str, str] = {
+    "JH001": "host-sync-in-hot-path: host transfer/sync call inside a "
+             "compiled hot path (device round-trip per step)",
+    "JH002": "traced-branch: Python if/while on a traced argument inside a "
+             "compiled hot path (trace-time constant or ConcretizationError"
+             " — use lax.cond/jnp.where)",
+    "JH003": "nondeterminism: wall clock or global RNG in op/compiled code "
+             "(breaks replay, fingerprints and the compile cache)",
+    "JH004": "mutable-default-arg: shared mutable state across calls",
+    "JH005": "unlocked-global-mutation: module-global registry mutated "
+             "outside a lock (loader/dispatch threads also import/mutate)",
+}
+
+#: helpers reached by tracing but not lexically inside a jitted closure —
+#: registered hot paths, keyed by a path suffix. Extend when adding a new
+#: compiled subsystem (docs/ANALYSIS.md "Registering hot paths").
+EXTRA_HOT_PATHS: Dict[str, Tuple[str, ...]] = {
+    "parallel/train_step.py": (
+        "TrainStep._loss_of", "TrainStep._grad_fn", "TrainStep._amp_cast",
+        "TrainStep._apply_update", "TrainStep._scaled_update",
+        "TrainStep._next_amp_state", "TrainStep._finite_all",
+    ),
+    "inference/engine.py": (
+        "GenerationEngine._prefill_fn", "GenerationEngine._decode_fn",
+        "GenerationEngine._sample",
+    ),
+}
+
+# function names that wrap a python callable into a compiled/traced one
+_JIT_WRAPPERS = frozenset({
+    "jit", "pjit", "pmap", "checkpoint", "remat", "shard_map", "vmap",
+    "grad", "value_and_grad", "custom_vjp", "custom_jvp", "scan",
+    "while_loop", "fori_loop", "cond", "switch",
+})
+
+# JH001: attribute calls that synchronize/copy to host
+_SYNC_ATTRS = frozenset({"item", "asnumpy", "tolist", "__array__"})
+# JH001: numpy namespace calls that materialize on host
+_NP_HOST_FNS = frozenset({"asarray", "array", "asnumpy", "ascontiguousarray"})
+_BUILTIN_SYNCS = frozenset({"float", "int", "bool"})
+
+# JH003: nondeterminism sources
+_TIME_FNS = frozenset({"time", "time_ns", "monotonic", "perf_counter",
+                       "perf_counter_ns", "monotonic_ns"})
+_NP_RANDOM_FNS = frozenset({
+    "rand", "randn", "randint", "random", "random_sample", "sample",
+    "normal", "uniform", "choice", "shuffle", "permutation", "seed",
+    "standard_normal", "beta", "binomial", "poisson", "exponential",
+})
+_MUTATING_METHODS = frozenset({
+    "update", "append", "add", "pop", "popitem", "clear", "extend",
+    "remove", "discard", "insert", "setdefault", "__setitem__",
+})
+
+_DISABLE = re.compile(r"#\s*lint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+_DISABLE_FILE = re.compile(r"#\s*lint:\s*disable-file=([A-Za-z0-9_,\s]+|all)")
+
+
+@dataclasses.dataclass
+class Violation:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class LintRule:
+    rule_id: str
+    summary: str
+
+
+def list_rules() -> List[LintRule]:
+    return [LintRule(k, v) for k, v in sorted(RULES.items())]
+
+
+# -- suppression parsing -----------------------------------------------------
+def _suppressions(source: str):
+    """(line -> set of rules disabled on that line, file-wide set).
+
+    Directives are honored only in real COMMENT tokens — a docstring or
+    string literal that merely *documents* the syntax (this module's own
+    docstring quotes ``disable-file``) must not activate it."""
+    per_line: Dict[int, Set[str]] = {}
+    file_wide: Set[str] = set()
+
+    def rules_of(spec: str) -> Set[str]:
+        spec = spec.strip()
+        if spec == "all":
+            return set(RULES)
+        return {r.strip().upper() for r in spec.split(",") if r.strip()}
+
+    import io
+    import tokenize
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DISABLE.search(tok.string)
+            if m:
+                per_line.setdefault(tok.start[0], set()).update(
+                    rules_of(m.group(1)))
+            m = _DISABLE_FILE.search(tok.string)
+            if m:
+                file_wide.update(rules_of(m.group(1)))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # source already parsed via ast before this runs, so tokenize
+        # failures are effectively unreachable; fail open (no suppressions)
+        pass
+    return per_line, file_wide
+
+
+# -- hot-path discovery ------------------------------------------------------
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute/Name chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _callee_names(node: ast.AST, assignments: Dict[str, List[ast.AST]],
+                  depth: int = 0) -> Set[str]:
+    """Resolve an expression to the local function names it may denote:
+    handles Name, `a if c else b`, and one level of local reassignment
+    (`fn = step_scaled if scaling else step; jax.jit(fn)`)."""
+    out: Set[str] = set()
+    if depth > 4:
+        return out
+    if isinstance(node, ast.Name):
+        out.add(node.id)
+        for rhs in assignments.get(node.id, []):
+            out |= _callee_names(rhs, assignments, depth + 1)
+    elif isinstance(node, ast.IfExp):
+        out |= _callee_names(node.body, assignments, depth + 1)
+        out |= _callee_names(node.orelse, assignments, depth + 1)
+    elif isinstance(node, ast.Attribute):
+        # jax.jit(self._decode_fn) -> method name in the enclosing class
+        out.add(node.attr)
+    elif isinstance(node, ast.Call):
+        # functools.partial(fn, ...) / jax.checkpoint(fn) wrappers
+        if node.args:
+            out |= _callee_names(node.args[0], assignments, depth + 1)
+    return out
+
+
+class _HotPathFinder(ast.NodeVisitor):
+    """Mark FunctionDef nodes that become compiled/traced code: decorated
+    with a jit wrapper, or referenced (possibly through a local alias or
+    ``functools.partial``) as the function argument of one."""
+
+    def __init__(self, extra_qualnames: Sequence[str]):
+        self.extra = set(extra_qualnames)
+        self.hot: Set[ast.AST] = set()
+        self._scope: List[ast.AST] = []
+        self._qualname: List[str] = []
+        self._defs: Dict[str, List[ast.AST]] = {}  # name -> def nodes (any scope)
+        self._assigns: Dict[str, List[ast.AST]] = {}
+
+    # pass 1: collect defs/assigns + decorator-marked hot roots
+    def visit_FunctionDef(self, node):
+        qual = ".".join(self._qualname + [node.name])
+        self._defs.setdefault(node.name, []).append(node)
+        node._lint_qualname = qual
+        if qual in self.extra or node.name in self.extra:
+            self.hot.add(node)
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            name = _dotted(target)
+            if name.rsplit(".", 1)[-1] in _JIT_WRAPPERS:
+                self.hot.add(node)
+        self._qualname.append(node.name)
+        self.generic_visit(node)
+        self._qualname.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._qualname.append(node.name)
+        self.generic_visit(node)
+        self._qualname.pop()
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                self._assigns.setdefault(t.id, []).append(node.value)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        name = _dotted(node.func).rsplit(".", 1)[-1]
+        if name in _JIT_WRAPPERS and node.args:
+            for fname in _callee_names(node.args[0], self._assigns):
+                for d in self._defs.get(fname, []):
+                    self.hot.add(d)
+            # donate/static kwargs forms: jax.jit(fn=...) not used here
+        self.generic_visit(node)
+
+    def resolve(self, tree: ast.AST) -> Set[ast.AST]:
+        """Two passes so a ``jax.jit(self._decode_fn)`` in ``__init__`` can
+        mark a method defined later in the class."""
+        self.visit(tree)
+        self._scope = []
+        self._qualname = []
+        # second sweep: Call sites were visited before some defs existed
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func).rsplit(".", 1)[-1]
+                if name in _JIT_WRAPPERS and node.args:
+                    for fname in _callee_names(node.args[0], self._assigns):
+                        for d in self._defs.get(fname, []):
+                            self.hot.add(d)
+        return self.hot
+
+
+# -- the rule engine ---------------------------------------------------------
+class _Linter(ast.NodeVisitor):
+    def __init__(self, path: str, source: str, is_op_module: bool,
+                 hot_defs: Set[ast.AST]):
+        self.path = path
+        self.lines = source.splitlines()
+        self.is_op_module = is_op_module
+        self.hot_defs = hot_defs
+        self.violations: List[Violation] = []
+        self._fn_stack: List[ast.AST] = []   # enclosing FunctionDefs
+        self._hot_stack: List[bool] = []
+        self._hot_args: List[Set[str]] = []  # traced arg names per hot fn
+        self._with_lock_depth = 0
+        self._module_globals: Set[str] = set()
+        self._suppressed_fn_lines: List[int] = []
+
+    # -- context helpers ---------------------------------------------------
+    @property
+    def in_hot(self) -> bool:
+        return bool(self._hot_stack and self._hot_stack[-1])
+
+    def _traced_args(self) -> Set[str]:
+        return self._hot_args[-1] if self._hot_args else set()
+
+    def report(self, rule: str, node: ast.AST, msg: str):
+        self.violations.append(Violation(
+            self.path, getattr(node, "lineno", 0),
+            getattr(node, "col_offset", 0), rule, msg))
+
+    # -- module prep --------------------------------------------------------
+    def visit_Module(self, node):
+        for stmt in node.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = [t for t in stmt.targets if isinstance(t, ast.Name)]
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name) and stmt.value:
+                targets = [stmt.target]
+            if not targets:
+                continue
+            value = stmt.value
+            if isinstance(value, (ast.Dict, ast.List, ast.Set)) or (
+                    isinstance(value, ast.Call)
+                    and _dotted(value.func) in
+                    ("dict", "list", "set", "collections.OrderedDict",
+                     "collections.defaultdict", "OrderedDict",
+                     "defaultdict")):
+                for t in targets:
+                    self._module_globals.add(t.id)
+        self.generic_visit(node)
+
+    # -- function scope ------------------------------------------------------
+    def visit_FunctionDef(self, node):
+        self._check_defaults(node)
+        hot = (node in self.hot_defs) or self.in_hot
+        self._fn_stack.append(node)
+        self._hot_stack.append(hot)
+        args = node.args
+        names = {a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)} - {"self", "cls"}
+        if args.vararg:
+            names.add(args.vararg.arg)
+        # nested hot fns see enclosing traced names too (closures)
+        if hot:
+            names |= self._traced_args()
+        self._hot_args.append(names if hot else set())
+        # a def inside `with lock:` does NOT run under that lock — it runs
+        # whenever the callback is invoked, on whatever thread — so JH005
+        # must not inherit the enclosing lock depth into the body
+        saved_lock_depth = self._with_lock_depth
+        self._with_lock_depth = 0
+        self.generic_visit(node)
+        self._with_lock_depth = saved_lock_depth
+        self._hot_args.pop()
+        self._hot_stack.pop()
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _check_defaults(self, node):
+        args = node.args
+        for d in list(args.defaults) + [d for d in args.kw_defaults if d]:
+            bad = isinstance(d, (ast.Dict, ast.List, ast.Set)) or (
+                isinstance(d, ast.Call)
+                and _dotted(d.func) in ("dict", "list", "set"))
+            if bad:
+                self.report("JH004", d,
+                            f"mutable default argument in {node.name}()")
+
+    # -- JH001 / JH003: calls ------------------------------------------------
+    def visit_Call(self, node):
+        dotted = _dotted(node.func)
+        leaf = dotted.rsplit(".", 1)[-1]
+        if self.in_hot:
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SYNC_ATTRS:
+                self.report("JH001", node,
+                            f".{node.func.attr}() forces a device->host "
+                            "sync inside a compiled hot path")
+            elif dotted in ("jax.device_get", "device_get"):
+                self.report("JH001", node,
+                            "jax.device_get inside a compiled hot path")
+            elif dotted.startswith(("np.", "numpy.")) and \
+                    leaf in _NP_HOST_FNS:
+                self.report("JH001", node,
+                            f"{dotted} materializes a host array inside a "
+                            "compiled hot path (use jnp)")
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in _BUILTIN_SYNCS and node.args and \
+                    self._mentions_traced(node.args[0]):
+                # only when a traced argument feeds the cast: float(topk)
+                # on a static op param is legal trace-time specialization
+                self.report("JH001", node,
+                            f"{node.func.id}() on a traced value is a host "
+                            "sync inside a compiled hot path")
+        # JH005 fires on the call wherever it sits — bare statement,
+        # assignment RHS (`h = _REG.setdefault(k, [])`), return value —
+        # the mutation happens regardless of what the result feeds
+        self._visit_mutating_call(node)
+        if self.in_hot or self.is_op_module:
+            if dotted.startswith("time.") and leaf in _TIME_FNS:
+                self.report("JH003", node,
+                            f"{dotted}() wall clock in op/compiled code")
+            elif leaf == "now" and "datetime" in dotted:
+                self.report("JH003", node,
+                            f"{dotted}() wall clock in op/compiled code")
+            elif (dotted.startswith(("np.random.", "numpy.random."))
+                  and leaf in _NP_RANDOM_FNS):
+                self.report("JH003", node,
+                            f"{dotted}() draws from the process-global "
+                            "numpy RNG (pass an explicit key/RandomState)")
+            elif dotted.startswith("random.") and dotted.count(".") == 1 \
+                    and leaf != "RandomState":
+                self.report("JH003", node,
+                            f"stdlib {dotted}() global RNG in op/compiled "
+                            "code")
+        self.generic_visit(node)
+
+    def _mentions_traced(self, expr: ast.AST) -> Optional[str]:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in self._traced_args():
+                return n.id
+        return None
+
+    # -- JH002: trace-time branches ------------------------------------------
+    def _test_on_traced(self, test: ast.AST) -> Optional[str]:
+        """Traced name used in a branch test — minus the two *structural*
+        comparison idioms that are static under tracing: ``x is (not)
+        None`` (a tracer is never None) and ``name (not) in container``
+        membership over a pytree container's keys."""
+        structural: Set[int] = set()
+        for n in ast.walk(test):
+            if not isinstance(n, ast.Compare):
+                continue
+            ops = n.ops
+            comparators = n.comparators
+            if all(isinstance(o, (ast.Is, ast.IsNot)) for o in ops) and all(
+                    isinstance(c, ast.Constant) and c.value is None
+                    for c in comparators):
+                structural.update(id(x) for x in ast.walk(n))
+            elif all(isinstance(o, (ast.In, ast.NotIn)) for o in ops):
+                for c in comparators:  # the container side only
+                    structural.update(id(x) for x in ast.walk(c))
+        for n in ast.walk(test):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in self._traced_args() \
+                    and id(n) not in structural:
+                return n.id
+        return None
+
+    def visit_If(self, node):
+        if self.in_hot:
+            name = self._test_on_traced(node.test)
+            if name:
+                self.report("JH002", node,
+                            f"Python `if` on traced argument {name!r} "
+                            "(trace-time constant; use lax.cond/jnp.where)")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self.in_hot:
+            name = self._test_on_traced(node.test)
+            if name:
+                self.report("JH002", node,
+                            f"Python `while` on traced argument {name!r} "
+                            "(use lax.while_loop)")
+        self.generic_visit(node)
+
+    # -- JH005: global registry mutation -------------------------------------
+    def visit_With(self, node):
+        is_lock = any(
+            "lock" in _dotted(item.context_expr.func
+                              if isinstance(item.context_expr, ast.Call)
+                              else item.context_expr).lower()
+            for item in node.items)
+        self._with_lock_depth += 1 if is_lock else 0
+        self.generic_visit(node)
+        self._with_lock_depth -= 1 if is_lock else 0
+
+    def _global_mutation(self, target_expr: ast.AST) -> Optional[str]:
+        base = target_expr
+        while isinstance(base, (ast.Subscript, ast.Attribute)):
+            base = base.value
+        if isinstance(base, ast.Name) and base.id in self._module_globals:
+            return base.id
+        return None
+
+    def visit_Assign(self, node):
+        if self._fn_stack and not self._with_lock_depth:
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    name = self._global_mutation(t)
+                    if name:
+                        self.report("JH005", node,
+                                    f"unlocked write to module-global "
+                                    f"{name!r} (guard with a threading.Lock"
+                                    " or suppress if import-time only)")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node):
+        if self._fn_stack and not self._with_lock_depth:
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    name = self._global_mutation(t)
+                    if name:
+                        self.report("JH005", node,
+                                    f"unlocked del on module-global {name!r}")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if self._fn_stack and not self._with_lock_depth and \
+                isinstance(node.target, ast.Subscript):
+            name = self._global_mutation(node.target)
+            if name:
+                self.report("JH005", node,
+                            f"unlocked augmented write to module-global "
+                            f"{name!r} (read-modify-write race)")
+        self.generic_visit(node)
+
+    def _visit_mutating_call(self, node):
+        if not (self._fn_stack and not self._with_lock_depth):
+            return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATING_METHODS:
+            name = self._global_mutation(node.func.value)
+            if name:
+                self.report("JH005", node,
+                            f"unlocked .{node.func.attr}() on module-global "
+                            f"{name!r}")
+
+
+def _function_spans(tree: ast.AST) -> List[Tuple[int, int, int]]:
+    """(def-line, body-start, body-end) for suppression-on-def semantics."""
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            spans.append((node.lineno, node.lineno, end))
+    return spans
+
+
+def lint_source(source: str, path: str = "<string>") -> List[Violation]:
+    """Lint one file's source; returns unsuppressed violations sorted by
+    line. ``path`` decides op-module scope (JH003) and registered hot
+    paths (JH001/2)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 0, 0, "JH000",
+                          f"syntax error: {e.msg}")]
+    posix = path.replace(os.sep, "/")
+    extra: List[str] = []
+    for suffix, quals in EXTRA_HOT_PATHS.items():
+        if posix.endswith(suffix):
+            extra.extend(quals)
+    hot = _HotPathFinder(extra).resolve(tree)
+    is_op_module = "/ops/" in posix or posix.endswith("random.py")
+    linter = _Linter(path, source, is_op_module, hot)
+    linter.visit(tree)
+
+    per_line, file_wide = _suppressions(source)
+    spans = _function_spans(tree)
+
+    def suppressed(v: Violation) -> bool:
+        if v.rule in file_wide:
+            return True
+        for line in (v.line, v.line - 1):
+            if v.rule in per_line.get(line, set()):
+                return True
+        # a suppression on the `def` line covers the whole function body
+        for def_line, lo, hi in spans:
+            if lo <= v.line <= hi and v.rule in per_line.get(def_line, set()):
+                return True
+        return False
+
+    return sorted((v for v in linter.violations if not suppressed(v)),
+                  key=lambda v: (v.line, v.col, v.rule))
+
+
+def lint_file(path: str) -> List[Violation]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def lint_paths(paths: Iterable[str],
+               exclude: Sequence[str] = ()) -> List[Violation]:
+    """Lint every ``.py`` under each path (file or directory tree)."""
+    out: List[Violation] = []
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                out.extend(lint_file(path))
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs
+                       if d != "__pycache__" and not d.startswith(".")]
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                full = os.path.join(root, name)
+                if any(x in full.replace(os.sep, "/") for x in exclude):
+                    continue
+                out.extend(lint_file(full))
+    return out

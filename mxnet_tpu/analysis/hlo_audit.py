@@ -1,0 +1,654 @@
+"""Structural analysis of lowered StableHLO / compiled HLO programs.
+
+The framework's correctness story rests on *structural* properties of the
+programs XLA is asked to run — bf16 dots under the AMP policy, f32 master
+updates, donated carries, exactly-(buckets+1) serving programs. Before this
+module those were checked by ad-hoc regexes scattered over the test suite;
+here the program text is parsed ONCE into a :class:`ProgramReport` that
+every test, tool and gate queries structurally.
+
+Two text dialects are understood, matching the two stages a jitted program
+passes through:
+
+  - **stablehlo** — ``jax.jit(f).lower(...).as_text()``: MLIR, one
+    ``stablehlo.<op>`` per line, donation as ``tf.aliasing_output`` arg
+    attributes. This is *the program XLA is asked to run* — dtype
+    assertions (bf16 dots, no f64 leaks) belong here, because the CPU
+    backend legalizes low-precision GEMMs back to f32 at compile time.
+  - **hlo** — ``...compile().as_text()``: post-optimization HLO, donation
+    in the ``input_output_alias`` module header, GSPMD-inserted collectives
+    (``all-reduce`` et al. with ``replica_groups``). Collective/fusion/
+    memory structure belongs here.
+
+Also here: the :class:`Fingerprint` of a program's input signature
+(shapes, dtypes, static args) and the :class:`RecompileGuard` that diffs
+fingerprints to explain *why* a recompile happened — the cause ("shape" /
+"dtype" / static args) lands in the observability event log and a
+``reason``-labelled counter, not just a bare count.
+
+See docs/ANALYSIS.md for the schema and a how-to.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from collections import Counter as _Counter
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Op", "Collective", "DonationReport", "ProgramReport",
+           "ProgramAudit", "audit_text", "audit_lowered", "audit_compiled",
+           "Fingerprint", "fingerprint_diff", "RecompileGuard"]
+
+# ops that move data between host and device (either dialect's spelling,
+# normalized): the serving/training hot loops must never contain one
+HOST_TRANSFER_OPS = frozenset({
+    "infeed", "outfeed", "send", "send_done", "recv", "recv_done",
+    "copy_to_host", "copy_from_host",
+})
+
+# collective ops (normalized names)
+COLLECTIVE_OPS = frozenset({
+    "all_reduce", "all_gather", "reduce_scatter", "collective_permute",
+    "all_to_all", "collective_broadcast",
+})
+
+# dot-like ops: everything that lands on the MXU
+DOT_OPS = frozenset({"dot", "dot_general", "convolution"})
+
+_FLOAT_DTYPES = ("f64", "f32", "f16", "bf16", "f8e4m3fn", "f8e5m2")
+
+
+# the -done half of an async collective pair: dropped by the parsers so
+# one start/done pair counts as ONE collective (send/recv keep their done
+# ops — they are distinct host-transfer instructions)
+_ASYNC_DONE = frozenset({
+    "all_reduce_done", "all_gather_done", "collective_permute_done",
+    "all_to_all_done", "copy_done",
+})
+
+
+def _normalize_op(name: str) -> str:
+    """Canonical op name across dialects: ``stablehlo.dot_general`` /
+    ``mhlo.dot_general`` / HLO ``all-reduce-start`` all collapse to a bare
+    underscore form (``dot_general``, ``all_reduce``)."""
+    name = name.rsplit(".", 1)[-1].replace("-", "_")
+    # async pairs count as the base op once: -start carries the payload
+    # (replica groups included) and becomes the base op; -done is dropped
+    # at parse time (_ASYNC_DONE)
+    if name.endswith("_start") and name[:-6] in {
+            "all_reduce", "all_gather", "collective_permute",
+            "all_to_all", "copy"}:
+        return name[:-6]
+    return name
+
+
+@dataclasses.dataclass
+class Op:
+    """One program instruction: normalized name, result dtype/shape, and
+    every dtype mentioned on its line (operands included)."""
+
+    name: str
+    dtype: Optional[str]  # result element dtype ("f32", "bf16", ...)
+    shape: Tuple[int, ...]  # result shape ( () for scalars/unknown )
+    dtypes: Tuple[str, ...]  # all dtypes on the line, operands included
+    line: int
+    shapes: Tuple[Tuple[int, ...], ...] = ()  # shapes paired with `dtypes`
+
+    def __repr__(self):
+        dims = "x".join(map(str, self.shape)) or "scalar"
+        return f"Op({self.name}: {self.dtype}[{dims}] @L{self.line})"
+
+
+@dataclasses.dataclass
+class Collective(Op):
+    """A collective op plus its replica grouping. ``groups`` is the
+    normalized tuple-of-tuples of device ids, or None when the grouping
+    could not be parsed (``raw_groups`` always keeps the source text)."""
+
+    raw_groups: str = ""
+    groups: Optional[Tuple[Tuple[int, ...], ...]] = None
+
+    @property
+    def group_size(self) -> Optional[int]:
+        """Devices per replica group — the axis span of this collective."""
+        if self.groups:
+            return len(self.groups[0])
+        return None
+
+
+@dataclasses.dataclass
+class DonationReport:
+    """Which flat program inputs are aliased to outputs (donation made it
+    through to the executable)."""
+
+    n_inputs: int
+    aliased: Dict[int, str]  # flat input index -> "may-alias"|"must-alias"
+
+    @property
+    def n_aliased(self) -> int:
+        return len(self.aliased)
+
+    def coverage(self, indices: Optional[Sequence[int]] = None) -> float:
+        """Fraction of ``indices`` (default: all inputs) that are aliased —
+        1.0 means every donated carry buffer is updated in place."""
+        idx = range(self.n_inputs) if indices is None else list(indices)
+        n = len(idx)
+        if n == 0:
+            return 1.0
+        hit = sum(1 for i in idx if i in self.aliased)
+        return hit / n
+
+    def missing(self, indices: Sequence[int]) -> List[int]:
+        return [i for i in indices if i not in self.aliased]
+
+
+# -- text parsing ------------------------------------------------------------
+# stablehlo: `%2 = stablehlo.dot_general %0, %1, ...` or `"stablehlo.case"(`
+_MLIR_OP = re.compile(r'"?(?:stablehlo|mhlo|chlo)\.([a-z0-9_]+)"?')
+# HLO: `%name.3 = bf16[4,2]{1,0} op-name(` — result type optional, and may
+# be a TUPLE `(f32[4]{0}, u32[], u32[])` (async collective starts, variadic
+# all-reduces) nesting one level (`((f32[4]{0}), token[])`, infeed)
+_HLO_OP = re.compile(
+    r"=\s*(?:\((?:[^()]|\([^()]*\))*\)\s+"
+    r"|[a-z0-9]+\[[^\]]*\][^ ]*\s+)?([a-z][a-z0-9-]*)\(")
+# tensor<4x8xbf16> / tensor<f32> / tensor<4x!quant...> (ignore non-builtin)
+_MLIR_TENSOR = re.compile(r"tensor<([0-9x]*)((?:[a-z][a-z0-9]*))>")
+# f32[4,8]{1,0} dtype[shape] tokens in HLO text
+_HLO_TENSOR = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_HLO_DTYPES = frozenset({"pred", "s4", "s8", "s16", "s32", "s64", "u4", "u8",
+                         "u16", "u32", "u64", "f8e4m3fn", "f8e5m2", "bf16",
+                         "f16", "f32", "f64", "c64", "c128", "token"})
+# donation, lowered: %arg0: tensor<...> {..., tf.aliasing_output = 0 : i32}
+# NB: the attr dict is scanned up to the NEXT %arg, not with a `[^}]*`
+# group — quoted attr values like `mhlo.sharding = "{replicated}"` contain
+# `}` and would truncate the capture before tf.aliasing_output
+_MLIR_ARG = re.compile(r"%arg(\d+):\s*tensor<([^>]*)>")
+_MLIR_ALIAS = re.compile(r"tf\.aliasing_output")
+# donation, compiled: input_output_alias={ {0}: (0, {}, may-alias), ... }
+_HLO_ALIAS_ENTRY = re.compile(r"\((\d+),\s*\{[^}]*\},\s*(may-alias|must-alias)\)")
+
+
+def _alias_header_body(line: str) -> str:
+    """The balanced-brace body of ``input_output_alias={...}`` (nested
+    braces — ``{0}: (0, {}, may-alias)`` — defeat a non-greedy regex)."""
+    start = line.find("input_output_alias={")
+    if start < 0:
+        return ""
+    i = line.index("{", start)
+    depth = 0
+    for j in range(i, len(line)):
+        if line[j] == "{":
+            depth += 1
+        elif line[j] == "}":
+            depth -= 1
+            if depth == 0:
+                return line[i + 1:j]
+    return line[i + 1:]
+# replica groups, compiled: [1,8]<=[8] (iota) or {{0,1},{2,3}} (explicit)
+_RG = re.compile(r"replica_groups=(\[[^\]]*\]<=\[[^\]]*\](?:T\([^)]*\))?"
+                 r"|\{\{[^=]*?\}\})")
+# replica groups, stablehlo: replica_groups = dense<[[0, 1, ..]]> : tensor<..>
+_RG_MLIR = re.compile(r"replica_groups\s*=\s*dense<(\[\[.*?\]\]|\d+)>")
+_IOTA_RG = re.compile(r"\[(\d+),(\d+)\]<=\[(\d+)\]$")
+
+
+def _parse_groups(raw: str) -> Optional[Tuple[Tuple[int, ...], ...]]:
+    """Normalize a replica-group spec to a tuple of device-id tuples.
+    Handles the explicit list form and the untransposed iota form
+    ``[g,s]<=[n]``; anything fancier keeps groups=None (raw preserved)."""
+    raw = raw.strip()
+    m = _IOTA_RG.match(raw)
+    if m:
+        g, s, n = map(int, m.groups())
+        if g * s == n:
+            return tuple(tuple(range(i * s, (i + 1) * s)) for i in range(g))
+        return None
+    if raw.startswith("{{") or raw.startswith("[["):
+        body = raw.strip("{}[]")
+        groups = []
+        for part in re.split(r"\}\s*,\s*\{|\]\s*,\s*\[", body):
+            ids = [int(t) for t in re.findall(r"-?\d+", part)]
+            if ids:
+                groups.append(tuple(ids))
+        return tuple(groups) or None
+    return None
+
+
+def _mlir_line_op(line: str) -> Optional[str]:
+    m = _MLIR_OP.search(line)
+    return m.group(1) if m else None
+
+
+def _mlir_tensors(line: str) -> List[Tuple[str, Tuple[int, ...]]]:
+    out = []
+    for dims, dt in _MLIR_TENSOR.findall(line):
+        shape = tuple(int(d) for d in dims.split("x") if d) if dims else ()
+        out.append((dt, shape))
+    return out
+
+
+def _hlo_tensors(line: str) -> List[Tuple[str, Tuple[int, ...]]]:
+    out = []
+    for dt, dims in _HLO_TENSOR.findall(line):
+        if dt not in _HLO_DTYPES:
+            continue
+        shape = tuple(int(d) for d in dims.split(",") if d) if dims else ()
+        out.append((dt, shape))
+    return out
+
+
+@dataclasses.dataclass
+class ProgramReport:
+    """Structured view of one lowered/compiled program (docs/ANALYSIS.md).
+
+    Query helpers, not raw text: ``count("dot_general")``,
+    ``dot_dtypes()["bf16"]``, ``ops_with_dtype("f64")``,
+    ``collective_counts()``, ``report.donation.coverage(range(18))``.
+    """
+
+    dialect: str  # "stablehlo" | "hlo"
+    ops: List[Op]
+    collectives: List[Collective]
+    custom_calls: List[str]  # call targets, in program order
+    donation: DonationReport
+    inputs: List[Tuple[str, Tuple[int, ...]]]  # (dtype, shape) per flat input
+    n_lines: int
+
+    # -- census --------------------------------------------------------------
+    def op_census(self) -> Dict[str, int]:
+        return dict(_Counter(o.name for o in self.ops))
+
+    def count(self, op: str) -> int:
+        op = _normalize_op(op)
+        return sum(1 for o in self.ops if o.name == op)
+
+    def has(self, op: str) -> bool:
+        return self.count(op) > 0
+
+    def dtype_census(self) -> Dict[str, int]:
+        """How many instructions *mention* each dtype (operands included) —
+        the f64-promotion-leak detector reads this."""
+        c: _Counter = _Counter()
+        for o in self.ops:
+            for dt in set(o.dtypes):
+                c[dt] += 1
+        return dict(c)
+
+    def ops_with_dtype(self, dtype: str) -> List[Op]:
+        return [o for o in self.ops if dtype in o.dtypes]
+
+    # -- dots (MXU coverage) -------------------------------------------------
+    def dots(self) -> List[Op]:
+        return [o for o in self.ops if o.name in DOT_OPS]
+
+    def dot_dtypes(self) -> Dict[str, int]:
+        """Result-dtype census of every dot-like op — the AMP coverage
+        check (`dot_dtypes()["bf16"] == len(dots())` means every matmul
+        lowered low-precision)."""
+        return dict(_Counter(o.dtype for o in self.dots() if o.dtype))
+
+    # -- collectives ---------------------------------------------------------
+    def collective_counts(self) -> Dict[str, int]:
+        return dict(_Counter(c.name for c in self.collectives))
+
+    def collectives_named(self, name: str) -> List[Collective]:
+        name = _normalize_op(name)
+        return [c for c in self.collectives if c.name == name]
+
+    def replica_group_specs(self) -> Dict[str, int]:
+        """Distinct raw replica-group spec -> number of collectives using
+        it. One entry = every collective spans the same device grouping."""
+        return dict(_Counter(c.raw_groups for c in self.collectives
+                             if c.raw_groups))
+
+    # -- host traffic --------------------------------------------------------
+    def host_transfers(self) -> List[Op]:
+        return [o for o in self.ops if o.name in HOST_TRANSFER_OPS]
+
+    # -- shape queries -------------------------------------------------------
+    def has_tensor(self, shape: Tuple[int, ...],
+                   dtype: Optional[str] = None,
+                   suffix: bool = False) -> bool:
+        """Does any instruction mention a tensor of exactly ``shape`` (or,
+        with ``suffix=True``, any tensor whose trailing dims equal it)?
+        The flash-attention memory contract check: no [.., L, L] buffer."""
+        shape = tuple(shape)
+        n = len(shape)
+        for o in self.ops:
+            for dt, s in zip(o.dtypes, o.shapes):
+                if dtype is not None and dt != dtype:
+                    continue
+                if s == shape or (suffix and len(s) >= n
+                                  and tuple(s[-n:]) == shape):
+                    return True
+        return False
+
+    def summary(self) -> dict:
+        """JSON-safe digest (tools/audit.py prints this)."""
+        return {
+            "dialect": self.dialect,
+            "n_ops": len(self.ops),
+            "op_census": self.op_census(),
+            "dtype_census": self.dtype_census(),
+            "dots": self.dot_dtypes(),
+            "collectives": self.collective_counts(),
+            "replica_groups": self.replica_group_specs(),
+            "custom_calls": list(self.custom_calls),
+            "host_transfers": [o.name for o in self.host_transfers()],
+            "donation": {"n_inputs": self.donation.n_inputs,
+                         "n_aliased": self.donation.n_aliased},
+        }
+
+
+def _parse_stablehlo(text: str) -> ProgramReport:
+    ops: List[Op] = []
+    collectives: List[Collective] = []
+    custom_calls: List[str] = []
+    inputs: List[Tuple[str, Tuple[int, ...]]] = []
+    aliased: Dict[int, str] = {}
+    lines = text.splitlines()
+    in_main_sig = False
+    sig_buf = []
+    for i, line in enumerate(lines, 1):
+        s = line.strip()
+        # the @main signature may span lines; buffer until the body opens
+        if "func.func" in s and "@main" in s:
+            in_main_sig = True
+        if in_main_sig:
+            sig_buf.append(s)
+            if s.endswith("{"):
+                in_main_sig = False
+            continue
+        if not s or s.startswith(("module", "func.func", "return", "}", "^")):
+            continue
+        name = _mlir_line_op(s)
+        if name is None:
+            continue
+        name = _normalize_op(name)
+        if name in _ASYNC_DONE:
+            continue
+        tensors = _mlir_tensors(s)
+        # result type: MLIR puts it last (`-> tensor<..>` or `: tensor<..>`)
+        rdt, rshape = (tensors[-1] if tensors else (None, ()))
+        dtypes = tuple(dt for dt, _ in tensors)
+        shapes = tuple(sh for _, sh in tensors)
+        if name == "custom_call":
+            m = re.search(r'call_target_name\s*=\s*"([^"]+)"', s)
+            custom_calls.append(m.group(1) if m else "?")
+        if name in COLLECTIVE_OPS:
+            m = _RG_MLIR.search(s)
+            raw = m.group(1) if m else ""
+            c = Collective(name, rdt, rshape, dtypes, i, shapes=shapes,
+                           raw_groups=raw,
+                           groups=_parse_groups(raw) if raw else None)
+            collectives.append(c)
+            ops.append(c)
+            continue
+        ops.append(Op(name, rdt, rshape, dtypes, i, shapes=shapes))
+    sig = " ".join(sig_buf)
+    matches = list(_MLIR_ARG.finditer(sig))
+    for k, m in enumerate(matches):
+        idx = int(m.group(1))
+        tdesc = m.group(2)
+        tm = re.match(r"([0-9x]*)((?:[a-z][a-z0-9]*))$", tdesc)
+        if tm:
+            dims, dt = tm.groups()
+            shape = tuple(int(d) for d in dims.split("x") if d) if dims else ()
+        else:
+            dt, shape = "?", ()
+        while len(inputs) <= idx:
+            inputs.append(("?", ()))
+        inputs[idx] = (dt, shape)
+        # this arg's attrs: everything up to the next %arg (or the body
+        # opening) — quoted values (mhlo.sharding = "{replicated}") hold
+        # braces, so a brace-bounded capture would truncate before
+        # tf.aliasing_output
+        end = matches[k + 1].start() if k + 1 < len(matches) else len(sig)
+        if _MLIR_ALIAS.search(sig, m.end(), end):
+            aliased[idx] = "may-alias"
+    return ProgramReport(
+        dialect="stablehlo", ops=ops, collectives=collectives,
+        custom_calls=custom_calls,
+        donation=DonationReport(n_inputs=len(inputs), aliased=aliased),
+        inputs=inputs, n_lines=len(lines))
+
+
+def _parse_hlo(text: str) -> ProgramReport:
+    ops: List[Op] = []
+    collectives: List[Collective] = []
+    custom_calls: List[str] = []
+    inputs: List[Tuple[str, Tuple[int, ...]]] = []
+    aliased: Dict[int, str] = {}
+    lines = text.splitlines()
+    entry_params: Dict[int, Tuple[str, Tuple[int, ...]]] = {}
+    in_entry = False
+    for i, line in enumerate(lines, 1):
+        s = line.strip()
+        if s.startswith("HloModule"):
+            for pnum, kind in _HLO_ALIAS_ENTRY.findall(_alias_header_body(s)):
+                aliased[int(pnum)] = kind
+            continue
+        if s.startswith("ENTRY"):
+            in_entry = True
+        if not s or s.startswith(("//", "#")):
+            continue
+        m = _HLO_OP.search(s)
+        if m is None:
+            continue
+        name = m.group(1)
+        if name in ("parameter",):
+            tensors = _hlo_tensors(s)
+            if in_entry and tensors:
+                pm = re.search(r"parameter\((\d+)\)", s)
+                if pm:
+                    entry_params[int(pm.group(1))] = tensors[0]
+            continue
+        name = _normalize_op(name)
+        if name in ("constant", "tuple", "get_tuple_element", "bitcast",
+                    "copy"):
+            # structural noise: layout/plumbing ops drown the census —
+            # filtered AFTER normalization so an async copy-start is
+            # dropped exactly like the sync copy spelling
+            continue
+        if name in _ASYNC_DONE:
+            continue
+        tensors = _hlo_tensors(s)
+        # result type: HLO puts it first (`%x = f32[4,8]{1,0} op(...)`)
+        rdt, rshape = (tensors[0] if tensors else (None, ()))
+        dtypes = tuple(dt for dt, _ in tensors)
+        shapes = tuple(sh for _, sh in tensors)
+        if name == "custom_call":
+            cm = re.search(r'custom_call_target="([^"]+)"', s)
+            custom_calls.append(cm.group(1) if cm else "?")
+        if name in COLLECTIVE_OPS:
+            gm = _RG.search(s)
+            raw = gm.group(1) if gm else ""
+            c = Collective(name, rdt, rshape, dtypes, i, shapes=shapes,
+                           raw_groups=raw,
+                           groups=_parse_groups(raw) if raw else None)
+            collectives.append(c)
+            ops.append(c)
+            continue
+        ops.append(Op(name, rdt, rshape, dtypes, i, shapes=shapes))
+    n_inputs = (max(entry_params) + 1) if entry_params else 0
+    for idx in range(n_inputs):
+        inputs.append(entry_params.get(idx, ("?", ())))
+    return ProgramReport(
+        dialect="hlo", ops=ops, collectives=collectives,
+        custom_calls=custom_calls,
+        donation=DonationReport(n_inputs=n_inputs, aliased=aliased),
+        inputs=inputs, n_lines=len(lines))
+
+
+@dataclasses.dataclass
+class ProgramAudit:
+    """Paired reports over one program: the *lowered* StableHLO (dtype
+    truth — what XLA is asked to run) and the *compiled* HLO (collective/
+    donation truth — what the backend will run), plus the flat input
+    indices of the donated carry so coverage is a one-call check.
+    Returned by ``TrainStep.audit()`` / ``GenerationEngine.audit()``."""
+
+    lowered: ProgramReport
+    compiled: Optional[ProgramReport]
+    carry_indices: Tuple[int, ...] = ()
+
+    def carry_donation(self) -> float:
+        """Donation coverage of the carry (params/opt-state for TrainStep,
+        KV buffers for the decode engine): 1.0 = every carry buffer is
+        updated in place. Reads the compiled executable when available."""
+        rep = self.compiled if self.compiled is not None else self.lowered
+        return rep.donation.coverage(self.carry_indices)
+
+    def carry_missing(self) -> List[int]:
+        rep = self.compiled if self.compiled is not None else self.lowered
+        return rep.donation.missing(self.carry_indices)
+
+    def summary(self) -> dict:
+        out = {"lowered": self.lowered.summary(),
+               "carry": {"n": len(self.carry_indices),
+                         "donation_coverage": self.carry_donation(),
+                         "missing": self.carry_missing()}}
+        if self.compiled is not None:
+            out["compiled"] = self.compiled.summary()
+        return out
+
+
+def audit_text(text: str) -> ProgramReport:
+    """Parse program text in either dialect (auto-detected)."""
+    if "stablehlo." in text or "func.func" in text or "mhlo." in text:
+        return _parse_stablehlo(text)
+    return _parse_hlo(text)
+
+
+def audit_lowered(lowered) -> ProgramReport:
+    """``jax.jit(f).lower(...)`` -> report over the *requested* program
+    (dtype assertions live here: CPU legalizes bf16 away at compile)."""
+    return audit_text(lowered.as_text())
+
+
+def audit_compiled(compiled) -> ProgramReport:
+    """``lowered.compile()`` (or anything with ``as_text``) -> report over
+    the optimized executable (collectives, fusion, donation live here)."""
+    return audit_text(compiled.as_text())
+
+
+# -- program fingerprints & the recompile guard ------------------------------
+@dataclasses.dataclass(frozen=True)
+class Fingerprint:
+    """Stable identity of one program signature: per-array shapes/dtypes +
+    the static arguments folded into the compiled program as constants.
+    Two equal fingerprints hit the same executable; the *diff* between two
+    unequal ones is the recompile cause."""
+
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[str, ...]
+    static: Tuple[Tuple[str, str], ...]  # sorted (name, repr) pairs
+
+    @classmethod
+    def of(cls, arrays: Sequence, **static) -> "Fingerprint":
+        shapes, dtypes = [], []
+        for a in arrays:
+            shapes.append(tuple(getattr(a, "shape", ())))
+            dtypes.append(str(getattr(a, "dtype", type(a).__name__)))
+        return cls(tuple(shapes), tuple(dtypes),
+                   tuple(sorted((str(k), repr(v)) for k, v in static.items())))
+
+    def describe(self) -> dict:
+        return {"shapes": [list(s) for s in self.shapes],
+                "dtypes": list(self.dtypes),
+                "static": {k: v for k, v in self.static}}
+
+
+def fingerprint_diff(old: Fingerprint, new: Fingerprint):
+    """Explain ``old -> new``: returns ``(cause, detail)`` where cause is
+    ``"shape"`` | ``"dtype"`` | ``"static"`` | ``"arity"`` (first
+    difference wins in that order of specificity) and detail is a short
+    human string naming exactly what changed."""
+    if len(old.shapes) != len(new.shapes):
+        return "arity", (f"{len(old.shapes)} -> {len(new.shapes)} "
+                         "batch arrays")
+    for i, (a, b) in enumerate(zip(old.shapes, new.shapes)):
+        if a != b:
+            return "shape", f"arg{i}: {list(a)} -> {list(b)}"
+    for i, (a, b) in enumerate(zip(old.dtypes, new.dtypes)):
+        if a != b:
+            return "dtype", f"arg{i}: {a} -> {b}"
+    do, dn = dict(old.static), dict(new.static)
+    for k in sorted(set(do) | set(dn)):
+        if do.get(k) != dn.get(k):
+            return "static", f"{k}: {do.get(k)} -> {dn.get(k)}"
+    return "identical", ""
+
+
+class RecompileGuard:
+    """Fingerprint-keyed recompile detector with *causes*.
+
+    ``observe(fp)`` returns None for a signature already seen; for a new
+    one it diffs against the closest previous fingerprint, increments
+    ``<counter>{reason=<cause>}`` and writes a ``recompile`` event whose
+    ``cause``/``detail`` fields say exactly what changed (the fingerprint
+    diff) — a shape-change recompile is *explained*, not just counted.
+
+    ``label_map`` renames causes for the counter label (TrainStep maps
+    ``static`` -> its historical ``hyperparams`` label); ``reason=``
+    overrides the diffed cause entirely (the window/prefill paths have
+    fixed labels by contract).
+    """
+
+    def __init__(self, counter_name: str, help: str = "",
+                 label_map: Optional[Dict[str, str]] = None,
+                 event: str = "recompile"):
+        self.counter_name = counter_name
+        self.help = help
+        self.label_map = label_map or {}
+        self.event = event
+        self._seen: List[Tuple[Optional[str], Fingerprint]] = []
+        self._seen_set = set()
+
+    def __len__(self):
+        return len(self._seen)
+
+    def seen(self, fp: Fingerprint, group: Optional[str] = None) -> bool:
+        return (group, fp) in self._seen_set
+
+    def diff_cause(self, fp: Fingerprint, group: Optional[str] = None):
+        """(cause, detail) of ``fp`` vs the closest seen fingerprint of
+        the same ``group`` (program family: step vs window vs decode) —
+        closest = the candidate reachable by the smallest class of edit
+        (static-args-only beats dtype-only beats shape beats arity), so
+        the reported cause is the minimal change that forced the
+        recompile. Cross-family diffs would manufacture phantom causes
+        (a step batch vs a window's stacked batch 'differ in shape'
+        without any input ever changing), hence the grouping."""
+        candidates = [f for g, f in self._seen if g == group]
+        if not candidates:
+            return "first", ""
+        best = None
+        # closest = smallest change: a candidate differing only in static
+        # args beats one differing in dtypes, which beats shapes, which
+        # beats arity — so the reported cause is the minimal edit that
+        # forced the recompile
+        rank = {"static": 0, "dtype": 1, "shape": 2, "arity": 3}
+        for prev in candidates:
+            cause, detail = fingerprint_diff(prev, fp)
+            r = rank.get(cause, 4)
+            if best is None or r < best[0]:
+                best = (r, cause, detail)
+        return best[1], best[2]
+
+    def observe(self, fp: Fingerprint, reason: Optional[str] = None,
+                group: Optional[str] = None,
+                **event_fields) -> Optional[str]:
+        if (group, fp) in self._seen_set:
+            return None
+        cause, detail = self.diff_cause(fp, group)
+        self._seen_set.add((group, fp))
+        self._seen.append((group, fp))
+        label = reason if reason is not None else \
+            self.label_map.get(cause, cause)
+        from .. import observability as _obs
+
+        _obs.counter(self.counter_name, self.help).inc(reason=label)
+        _obs.emit(self.event, reason=label, cause=cause, detail=detail,
+                  **{**fp.describe(), **event_fields})
+        return label

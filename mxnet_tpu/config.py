@@ -9,6 +9,7 @@ to their closest analog or a no-op, so reference launch scripts run.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Any, Dict
 
 __all__ = ["get", "set", "knobs", "describe", "apply_compile_cache"]
@@ -92,6 +93,8 @@ _KNOBS: Dict[str, tuple] = {
 }
 
 _values: Dict[str, Any] = {}
+# set() may be called while loader/telemetry threads resolve knobs (JH005)
+_values_lock = threading.Lock()
 
 
 def _coerce(typ, raw):
@@ -112,7 +115,8 @@ def get(name: str):
 
 def set(name: str, value) -> None:
     typ, _d, _e, _doc = _KNOBS[name]
-    _values[name] = _coerce(typ, value)
+    with _values_lock:
+        _values[name] = _coerce(typ, value)
 
 
 def knobs():

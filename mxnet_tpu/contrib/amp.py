@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import threading
 
 import jax.numpy as jnp
 
@@ -39,6 +40,9 @@ __all__ = ["init", "init_trainer", "scale_loss", "convert_model", "LossScaler",
 # worker threads (resolve_policy("auto")) must see it — a thread-local
 # here silently degraded those to f32
 _STATE = {"dtype": None}
+# deliberately process-global, not thread-local: worker-thread TrainSteps
+# and loader threads must see amp.init(). Guard the transitions (JH005).
+_STATE_LOCK = threading.Lock()
 
 
 def amp_dtype():
@@ -132,7 +136,8 @@ def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
     """Enable AMP globally. On TPU target_dtype defaults to bfloat16."""
     assert target_dtype in ("bfloat16", "float16")
-    _STATE["dtype"] = target_dtype
+    with _STATE_LOCK:
+        _STATE["dtype"] = target_dtype
     # invalidate jit programs traced under the previous policy — otherwise a
     # hybridized net keeps replaying its f32 dots and AMP silently no-ops
     from ..gluon import block as _block
@@ -176,7 +181,8 @@ def list_widest_type_cast_ops(target_dtype="bfloat16"):
 
 def _reset():
     """Disable AMP (test hook)."""
-    _STATE["dtype"] = None
+    with _STATE_LOCK:
+        _STATE["dtype"] = None
     # invalidate jit caches traced under a different amp policy
     from ..gluon import block as _block
 

@@ -73,20 +73,27 @@ class _BlockScope:
 
 
 _GLOBAL_COUNT = {}
+_NAME_LOCK = threading.Lock()
 
 # global-policy epoch folded into every jit-cache signature: bumped when a
 # process-wide compile-affecting policy flips (e.g. amp.init), so programs
 # traced under the old policy are not replayed under the new one
 _CACHE_EPOCH = [0]
+_EPOCH_LOCK = threading.Lock()
 
 
 def bump_global_cache_epoch():
-    _CACHE_EPOCH[0] += 1
+    # amp.init/_reset may flip the policy from a worker thread while other
+    # threads read the epoch into jit-cache keys (JH005)
+    with _EPOCH_LOCK:
+        _CACHE_EPOCH[0] += 1
 
 
 def _global_count(hint):
-    n = _GLOBAL_COUNT.get(hint, 0)
-    _GLOBAL_COUNT[hint] = n + 1
+    # blocks may be constructed from loader/serving threads (JH005)
+    with _NAME_LOCK:
+        n = _GLOBAL_COUNT.get(hint, 0)
+        _GLOBAL_COUNT[hint] = n + 1
     return f"{hint}{n}_"
 
 

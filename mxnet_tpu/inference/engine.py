@@ -125,9 +125,15 @@ class GenerationEngine:
         self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(1,),
                                     static_argnums=())
         self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(1,))
-        # lowered-program signatures seen (cf. TrainStep._note_recompile):
-        # a miss means XLA compiles a new executable
-        self._program_sigs: set = set()
+        # lowered-program fingerprints seen (cf. TrainStep._note_recompile):
+        # a miss means XLA compiles a new executable. Reasons are fixed by
+        # contract ("prefill_bucket"/"decode") — the guard supplies the
+        # event plumbing and the program count (docs/ANALYSIS.md).
+        from ..analysis import RecompileGuard
+
+        self._recompile_guard = RecompileGuard(
+            "gen_recompiles_total",
+            "generation program lowerings (cache misses)")
         self._key = None  # lazily created PRNG key for stochastic sampling
         self._fixed_key = None
 
@@ -136,16 +142,14 @@ class GenerationEngine:
     def compiled_programs(self) -> int:
         """How many XLA executables this engine has lowered (prefill buckets
         actually used + the decode step)."""
-        return len(self._program_sigs)
+        return len(self._recompile_guard)
 
     def _note_program(self, sig, reason):
-        if sig in self._program_sigs:
-            return
-        self._program_sigs.add(sig)
-        _obs.counter("gen_recompiles_total",
-                     "generation program lowerings (cache misses)").inc(
-                         reason=reason)
-        _obs.emit("recompile", reason=reason, sig=list(map(str, sig)))
+        from ..analysis import Fingerprint
+
+        self._recompile_guard.observe(Fingerprint.of((), sig=sig),
+                                      reason=reason, group=reason,
+                                      sig=list(map(str, sig)))
 
     # -- sampling (compiled into both programs) ------------------------------
     def _sample(self, logits2d, key):
@@ -282,6 +286,40 @@ class GenerationEngine:
                            "one compiled decode step wall clock",
                            unit="s").observe(dt)
         return tok, done, logits
+
+    def audit(self, bucket: Optional[int] = None, compile: bool = True):
+        """Structural :class:`~mxnet_tpu.analysis.ProgramAudit` of a
+        serving program (docs/ANALYSIS.md). Default: the decode step —
+        ``carry_indices`` are the flat positions of the KV-cache buffers
+        (the donated carry), so ``audit().carry_donation() == 1.0`` is the
+        in-place-cache-update check. With ``bucket=`` the prefill program
+        for that bucket length is audited instead (same donated cache)."""
+        from .. import analysis as _analysis
+
+        params = self._params()
+        n_params = len(jax.tree_util.tree_leaves(params))
+        n_cache = len(jax.tree_util.tree_leaves(self.cache))
+        # constant dummy key: lower() never runs the program, and drawing
+        # from _next_key() would advance the stochastic-sampling stream —
+        # an audit() between decode steps must not change the tokens
+        key = jax.random.key(0)
+        if bucket is None:
+            lowered = self._decode_jit.lower(
+                params, self.cache, jnp.asarray(self.last_tokens),
+                jnp.asarray(self.positions), jnp.asarray(self.done), key)
+        else:
+            bucket = self.bucket_for(bucket)
+            tokens = jnp.full((1, bucket), self.pad_id, jnp.int32)
+            lowered = self._prefill_jit.lower(
+                params, self.cache, tokens, jnp.asarray(0, jnp.int32),
+                jnp.asarray(bucket, jnp.int32), key)
+        # flat arg order: params leaves, then the cache leaves (argnum 1,
+        # the donated carry)
+        return _analysis.ProgramAudit(
+            lowered=_analysis.audit_lowered(lowered),
+            compiled=(_analysis.audit_compiled(lowered.compile())
+                      if compile else None),
+            carry_indices=tuple(range(n_params, n_params + n_cache)))
 
     def release_slot(self, slot: int) -> None:
         """Mark a row free (emits pad, frontier frozen) — the next prefill

@@ -11,6 +11,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from typing import Optional
 
 __all__ = ["lib", "available", "ensure_built", "NativeRecordReader",
@@ -388,6 +389,8 @@ def image_crop(src, y0, x0, ch, cw):
 
 
 _STAGING: dict = {}
+# train + val PrefetchingIter threads hit the pool concurrently (JH005)
+_staging_lock = threading.Lock()
 
 
 def _staging_f32(shape, owner=None):
@@ -404,26 +407,28 @@ def _staging_f32(shape, owner=None):
     import numpy as np
 
     key = (owner, tuple(shape))
-    if key not in _STAGING:
-        L = _require_lib()
-        nbytes = int(np.prod(shape)) * 4
-        ptr = L.MXTPUStorageAlloc(nbytes)
-        if not ptr:
-            return np.empty(shape, np.float32)
-        buf = np.ctypeslib.as_array(
-            ctypes.cast(ptr, ctypes.POINTER(ctypes.c_float)),
-            shape=(int(np.prod(shape)),)).reshape(shape)
-        _STAGING[key] = buf
-    return _STAGING[key]
+    with _staging_lock:
+        if key not in _STAGING:
+            L = _require_lib()
+            nbytes = int(np.prod(shape)) * 4
+            ptr = L.MXTPUStorageAlloc(nbytes)
+            if not ptr:
+                return np.empty(shape, np.float32)
+            buf = np.ctypeslib.as_array(
+                ctypes.cast(ptr, ctypes.POINTER(ctypes.c_float)),
+                shape=(int(np.prod(shape)),)).reshape(shape)
+            _STAGING[key] = buf
+        return _STAGING[key]
 
 
 def release_staging(owner):
     """Drop all staging buffers owned by ``owner`` back to the pool."""
     L = lib()
-    for key in [k for k in _STAGING if k[0] == owner]:
-        buf = _STAGING.pop(key)
-        if L is not None:
-            L.MXTPUStorageFree(buf.ctypes.data_as(ctypes.c_void_p))
+    with _staging_lock:
+        for key in [k for k in _STAGING if k[0] == owner]:
+            buf = _STAGING.pop(key)
+            if L is not None:
+                L.MXTPUStorageFree(buf.ctypes.data_as(ctypes.c_void_p))
 
 
 def batch_to_chw_float(batch_hwc_u8, mean=None, std=None, nthreads=4,

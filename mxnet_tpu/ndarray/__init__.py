@@ -16,6 +16,7 @@ registry, mirroring the reference's import-time codegen
 from __future__ import annotations
 
 import sys
+import threading as _threading
 import types
 
 import jax
@@ -368,6 +369,7 @@ def _raw_index(key):
 # op invocation (the analog of MXImperativeInvokeEx)
 # --------------------------------------------------------------------------
 _DENSIFY_WARNED: set = set()
+_DENSIFY_LOCK = _threading.Lock()  # op dispatch can be multi-threaded
 
 
 def invoke(opdef, args, kwargs):
@@ -388,8 +390,10 @@ def invoke(opdef, args, kwargs):
             import warnings
 
             name = getattr(opdef, "name", "?")
-            if name not in _DENSIFY_WARNED:  # once per op, like the reference
-                _DENSIFY_WARNED.add(name)
+            with _DENSIFY_LOCK:
+                first = name not in _DENSIFY_WARNED
+                _DENSIFY_WARNED.add(name)  # once per op, like the reference
+            if first:
                 warnings.warn(
                     f"op {name!r}: sparse input densified at the op boundary "
                     "(storage type fallback). Use nd.sparse.{dot,add,retain} "

@@ -83,7 +83,9 @@ def register(reg_name):
     def deco(prop_cls):
         if not issubclass(prop_cls, CustomOpProp):
             raise MXNetError(f"{prop_cls} must subclass CustomOpProp")
-        _CUSTOM_PROPS[reg_name] = prop_cls
+        # module-import-time registration (the reference's C API contract);
+        # no worker thread registers custom ops
+        _CUSTOM_PROPS[reg_name] = prop_cls  # lint: disable=JH005
         return prop_cls
 
     return deco

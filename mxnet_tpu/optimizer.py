@@ -24,7 +24,8 @@ _OPT_REGISTRY: Dict[str, type] = {}
 
 
 def register(cls):
-    _OPT_REGISTRY[cls.__name__.lower()] = cls
+    # import-time decorator on the class definitions below (JH005-exempt)
+    _OPT_REGISTRY[cls.__name__.lower()] = cls  # lint: disable=JH005
     return cls
 
 

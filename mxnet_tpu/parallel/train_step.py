@@ -138,11 +138,21 @@ class TrainStep:
         # the program as constants, and telemetry adds a grad-norm output —
         # any of them changing needs its own jitted program
         self._compiled: Dict[tuple, Callable] = {}
-        # recompile detection (observability): every (program key, batch
-        # shapes/dtypes) signature seen so far — a miss means XLA is about to
-        # lower+compile a new executable, which fused execution otherwise
-        # hides completely
-        self._program_sigs: set = set()
+        # recompile detection (observability + analysis subsystems): every
+        # program fingerprint (shapes, dtypes, static args) seen so far — a
+        # miss means XLA is about to lower+compile a new executable, which
+        # fused execution otherwise hides completely. The guard diffs the
+        # new fingerprint against the closest seen one, so the event log
+        # carries the recompile *cause* ("shape"/"dtype"/"hyperparams"),
+        # not just a count (docs/ANALYSIS.md).
+        from ..analysis import RecompileGuard
+
+        self._recompile_guard = RecompileGuard(
+            "train_recompiles_total",
+            "TrainStep program lowerings (cache misses)",
+            # historical label names: static-arg changes (lr/wd multiplier
+            # edits, batch arity) have always counted as "hyperparams"
+            label_map={"static": "hyperparams", "arity": "hyperparams"})
         self._monitors: list = []
         # attached DevicePrefetcher (io.prefetch): batches arrive already
         # device-resident + sharded, so __call__/run skip the per-call
@@ -302,6 +312,24 @@ class TrainStep:
                                                     None)
         return (new_params, new_state, new_t,
                 self._next_amp_state(amp_state, finite), grads, loss)
+
+    def _step_cache_key(self, n_raws, obs_on):
+        """Jit-cache key of the single-step program: everything folded into
+        the compiled program as a constant (batch arity, lr/wd multiplier
+        snapshots, the telemetry grad-norm output). ONE constructor —
+        ``__call__`` and ``lower_hlo``/``audit()`` must build the identical
+        key, or audits would inspect a different program than the one
+        production dispatches."""
+        lr_mult, wd_mult = self._resolve_mults()
+        return (n_raws, tuple(sorted(lr_mult.items())),
+                tuple(sorted(wd_mult.items())), obs_on)
+
+    def _window_cache_key(self, window, accum, n_raws, obs_on):
+        """Jit-cache key of the fused k-step window program — shared by
+        ``_run_window`` and ``lower_window_hlo`` for the same reason as
+        :meth:`_step_cache_key`."""
+        n, lr_t, wd_t, o = self._step_cache_key(n_raws, obs_on)
+        return ("window", window, accum, n, lr_t, wd_t, o)
 
     def _make_step(self, n_batch, with_gnorm=False):
         lr_mult, wd_mult = self._resolve_mults()
@@ -507,11 +535,7 @@ class TrainStep:
         # constants, so the cache key carries them: opt.set_lr_mult /
         # param_dict edits after the first step trigger a recompile instead
         # of being silently frozen (round-3 advisor finding)
-        lr_mult, wd_mult = self._resolve_mults()
-        cache_key = (len(raws),
-                     tuple(sorted(lr_mult.items())),
-                     tuple(sorted(wd_mult.items())),
-                     obs_on)
+        cache_key = self._step_cache_key(len(raws), obs_on)
         if obs_on:
             # signatures seen while telemetry was off DO recompile once it
             # flips on (the gnorm output changes the program), so counting
@@ -643,10 +667,8 @@ class TrainStep:
         on — one host sync for the whole window."""
         obs_on = _obs.enabled()
         t0 = time.perf_counter() if obs_on else 0.0
-        lr_mult, wd_mult = self._resolve_mults()
-        cache_key = ("window", window, accum, len(batches),
-                     tuple(sorted(lr_mult.items())),
-                     tuple(sorted(wd_mult.items())), obs_on)
+        cache_key = self._window_cache_key(window, accum, len(batches),
+                                           obs_on)
         if obs_on:
             self._note_recompile(cache_key, batches, kind="window")
         fn = self._compiled.get(cache_key)
@@ -696,30 +718,25 @@ class TrainStep:
 
     # -- telemetry (docs/OBSERVABILITY.md) -----------------------------------
     def _note_recompile(self, cache_key, raws, kind="step"):
-        """Count lowered-program cache misses: jax.jit recompiles silently
-        on any new (arity, shape, dtype, folded-constant) signature; under
-        fusion that cost is invisible without this counter. Window-path
-        misses (a new (window, accum, shapes) signature lowering) count
-        under ``reason="window"``."""
-        sig = (cache_key[:-1],  # the program key minus the telemetry flag
-               tuple((tuple(r.shape), str(r.dtype)) for r in raws))
-        if sig in self._program_sigs:
-            return
-        if kind == "window":
-            reason = "window"
-        elif not self._program_sigs:
-            reason = "first"
-        elif any(s[0] == sig[0] for s in self._program_sigs):
-            reason = "shape"
-        else:
-            reason = "hyperparams"
-        self._program_sigs.add(sig)
-        _obs.counter("train_recompiles_total",
-                     "TrainStep program lowerings (cache misses)").inc(
-                         reason=reason)
-        _obs.emit("recompile", reason=reason,
-                  shapes=[list(r.shape) for r in raws],
-                  dtypes=[str(r.dtype) for r in raws])
+        """Count lowered-program cache misses WITH their cause: jax.jit
+        recompiles silently on any new (arity, shape, dtype,
+        folded-constant) signature; under fusion that cost is invisible
+        without this counter, and without the fingerprint diff the
+        *reason* is guesswork. The guard diffs the new fingerprint against
+        the closest seen program — the emitted ``recompile`` event carries
+        ``cause`` + ``detail`` (e.g. ``arg0: [2, 3] -> [6, 3]``). Window-
+        path misses (a new (window, accum, shapes) signature) keep their
+        contractual ``reason="window"`` label."""
+        from ..analysis import Fingerprint
+
+        # the program key minus the telemetry flag: obs flipping on/off
+        # changes the jit program (gnorm output) but not its identity
+        fp = Fingerprint.of(raws, key=cache_key[:-1])
+        reason = "window" if kind == "window" else None
+        # group by program family: a step fingerprint diffed against a
+        # window's stacked-batch fingerprint would report a phantom
+        # shape change no input ever underwent
+        self._recompile_guard.observe(fp, reason=reason, group=kind)
 
     def _amp_fetchable(self):
         """(scale, skipped) device scalars to ride the telemetry fetch, or
@@ -938,16 +955,16 @@ class TrainStep:
         raws = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b) for b in batch)
         if self.batch_sharding is not None and self._prefetcher is None:
             raws = tuple(jax.device_put(r, self.batch_sharding) for r in raws)
-        lr_mult, wd_mult = self._resolve_mults()
-        cache_key = (len(raws),
-                     tuple(sorted(lr_mult.items())),
-                     tuple(sorted(wd_mult.items())),
-                     obs_on)
+        cache_key = self._step_cache_key(len(raws), obs_on)
         step = self._compiled.get(cache_key)
         if step is None:
             step = self._compiled[cache_key] = self._make_step(
                 len(raws), with_gnorm=obs_on)
-        key = _rng.next_key()
+        # a CONSTANT dummy key: lower() never executes the program, only
+        # shape/dtype matter — drawing from the live stream would make an
+        # audit()/lower_hlo() call mid-run perturb every later step's
+        # dropout, breaking fixed-seed reproducibility
+        key = jax.random.key(0)
         lr = jnp.float32(self.optimizer.learning_rate)
         wd = jnp.float32(self.optimizer.wd)
         if self.amp_state is not None:
@@ -955,3 +972,60 @@ class TrainStep:
                               self.amp_state, raws, key, lr, wd)
         return step.lower(self.params, self.opt_state, self.step_count, raws,
                           key, lr, wd)
+
+    def lower_window_hlo(self, *batch, window: int = 2, accum: int = 1):
+        """Lower (don't run) the fused k-step window program ``run()``
+        would execute for this per-step batch signature — the batch is
+        tiled to the stacked ``[window, (accum,) ...]`` layout and the
+        window jit cache is shared, exactly like :meth:`lower_hlo` shares
+        the step cache."""
+        obs_on = _obs.enabled()
+        raws = tuple(b._data if isinstance(b, NDArray) else jnp.asarray(b)
+                     for b in batch)
+        lead = (window,) if accum == 1 else (window, accum)
+        stacked = tuple(jnp.broadcast_to(r, lead + r.shape) for r in raws)
+        if self.batch_sharding is not None:
+            ws = self.window_batch_sharding(accum)
+            stacked = tuple(jax.device_put(s, ws) for s in stacked)
+        cache_key = self._window_cache_key(window, accum, len(raws), obs_on)
+        fn = self._compiled.get(cache_key)
+        if fn is None:
+            fn = self._compiled[cache_key] = self._make_window(
+                len(raws), window, accum, with_gnorm=obs_on)
+        # constant dummy keys, same reason as lower_hlo: lowering must not
+        # consume the live training key stream
+        keys = jax.random.split(jax.random.key(0), window)
+        lrs = jnp.full((window,), self.optimizer.learning_rate, jnp.float32)
+        wd = jnp.float32(self.optimizer.wd)
+        if self.amp_state is not None:
+            return fn.lower(self.params, self.opt_state, self.step_count,
+                            self.amp_state, stacked, keys, lrs, wd)
+        return fn.lower(self.params, self.opt_state, self.step_count,
+                        stacked, keys, lrs, wd)
+
+    def audit(self, *batch, window: Optional[int] = None, accum: int = 1,
+              compile: bool = True):
+        """Structural :class:`~mxnet_tpu.analysis.ProgramAudit` of the
+        program this batch signature runs (docs/ANALYSIS.md): the lowered
+        StableHLO report (dtype census — assert bf16 dots / no f64 leaks
+        here), the compiled HLO report (collectives, donation aliases),
+        and the flat input indices of the donated params/opt-state carry
+        so ``audit(...).carry_donation() == 1.0`` is the whole no-copy
+        update check. ``window=`` audits the fused k-step scan program
+        instead of the single step."""
+        from .. import analysis as _analysis
+
+        if window:
+            lowered = self.lower_window_hlo(*batch, window=window,
+                                            accum=accum)
+        else:
+            lowered = self.lower_hlo(*batch)
+        # flat arg order is tree_flatten order: params dict leaves first,
+        # then opt-state leaves — exactly the donated (0, 1) argnums
+        n_carry = len(jax.tree_util.tree_leaves((self.params,
+                                                 self.opt_state)))
+        return _analysis.ProgramAudit(
+            lowered=_analysis.audit_lowered(lowered),
+            compiled=(_analysis.audit_compiled(lowered.compile())
+                      if compile else None),
+            carry_indices=tuple(range(n_carry)))

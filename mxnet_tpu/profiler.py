@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from contextlib import contextmanager
 
@@ -20,6 +21,9 @@ __all__ = ["set_config", "set_state", "dump", "dumps", "pause", "resume", "scope
 logger = logging.getLogger("mxnet_tpu.profiler")
 
 _state = {"running": False, "dir": "/tmp/mxnet_tpu_profile", "ever_ran": False}
+# set_state/pause/resume may be driven from a monitor thread while the step
+# loop reads `running` — serialize the start/stop transitions (JH005)
+_state_lock = threading.RLock()
 
 # python-side scope() aggregates live in the observability metrics registry
 # (one source of numeric truth — docs/OBSERVABILITY.md); this is the metric
@@ -30,9 +34,10 @@ _SCOPE_METRIC = "profiler_scope_seconds"
 def set_config(filename=None, profile_all=False, profile_symbolic=True,
                profile_imperative=True, profile_memory=True, profile_api=True,
                aggregate_stats=False, **kwargs):
-    if filename:
-        _state["dir"] = os.path.dirname(os.path.abspath(filename)) or "."
-    _state["aggregate_stats"] = aggregate_stats
+    with _state_lock:
+        if filename:
+            _state["dir"] = os.path.dirname(os.path.abspath(filename)) or "."
+        _state["aggregate_stats"] = aggregate_stats
 
 
 def set_state(state="stop", profile_process="worker"):
@@ -42,26 +47,29 @@ def set_state(state="stop", profile_process="worker"):
     our matching ``stop`` then closes it rather than leaking it. Any other
     start failure (unwritable dir, ...) propagates."""
     if state == "run":
-        if _state["running"]:
-            return
-        try:
-            jax.profiler.start_trace(_state["dir"])
-        except Exception as e:
-            if "already" not in str(e).lower():
-                raise
-            # a live session we lost track of: adopt it
-            logger.warning("start_trace: %s; adopting the active session", e)
-        _state["running"] = True
-        _state["ever_ran"] = True
-        _state["t0"] = time.time()
+        with _state_lock:
+            if _state["running"]:
+                return
+            try:
+                jax.profiler.start_trace(_state["dir"])
+            except Exception as e:
+                if "already" not in str(e).lower():
+                    raise
+                # a live session we lost track of: adopt it
+                logger.warning("start_trace: %s; adopting the active session",
+                               e)
+            _state["running"] = True
+            _state["ever_ran"] = True
+            _state["t0"] = time.time()
     elif state == "stop":
-        if not _state["running"]:
-            return
-        try:
-            jax.profiler.stop_trace()
-        except Exception as e:  # session already closed elsewhere: just untrack
-            logger.warning("stop_trace failed (%s); marking stopped", e)
-        _state["running"] = False
+        with _state_lock:
+            if not _state["running"]:
+                return
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # session closed elsewhere: just untrack
+                logger.warning("stop_trace failed (%s); marking stopped", e)
+            _state["running"] = False
 
 
 def pause(profile_process="worker"):

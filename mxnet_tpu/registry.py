@@ -55,7 +55,9 @@ def register(name, *, nout=1, aliases=(), stochastic=False):
         for n in (name, *aliases):
             if n in _REGISTRY:
                 raise ValueError(f"operator {n!r} registered twice")
-            _REGISTRY[n] = op
+            # import-time only: ops register as modules load, before any
+            # worker thread exists (docs/ANALYSIS.md "Suppressions")
+            _REGISTRY[n] = op  # lint: disable=JH005
         return fn
 
     return deco
@@ -64,7 +66,8 @@ def register(name, *, nout=1, aliases=(), stochastic=False):
 def alias(existing: str, *names: str) -> None:
     op = _REGISTRY[existing]
     for n in names:
-        _REGISTRY[n] = op
+        # import-time only, same as register() above
+        _REGISTRY[n] = op  # lint: disable=JH005
 
 
 # --------------------------------------------------------------------------
@@ -83,7 +86,8 @@ def register_sparse(name: str):
     NDArray/sparse NDArray, or NotImplemented to fall back to densify."""
 
     def deco(fn):
-        _SPARSE_FNS[name] = fn
+        # import-time only, same as register() above
+        _SPARSE_FNS[name] = fn  # lint: disable=JH005
         return fn
 
     return deco

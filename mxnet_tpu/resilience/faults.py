@@ -48,6 +48,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import random as _random
+import threading
 from typing import Dict, List, Optional
 
 __all__ = ["InjectedFault", "InjectedCrash", "arm", "disarm", "reset",
@@ -118,6 +119,11 @@ _triggers: Dict[str, List[_Trigger]] = {}
 _counts: Dict[str, int] = {}
 _active = False
 _env_loaded = False
+# chaos runs fire() from DataLoader/prefetcher worker threads while the
+# test thread arms/disarms — one lock covers both registries (JH005)
+_lock = threading.Lock()
+# guards the one-shot env-spec load (see _ensure_env)
+_env_lock = threading.Lock()
 
 
 def _recompute_active() -> None:
@@ -135,28 +141,31 @@ def arm(site: str, on: Optional[int] = None, every: Optional[int] = None,
         p: Optional[float] = None, times: Optional[int] = None,
         crash: bool = False, seed: int = 0) -> None:
     """Arm ``site`` to fail. See module docstring for trigger semantics."""
-    _triggers.setdefault(site, []).append(
-        _Trigger(on=on, every=every, p=p, times=times, crash=crash,
-                 seed=seed, site=site))
-    _recompute_active()
+    with _lock:
+        _triggers.setdefault(site, []).append(
+            _Trigger(on=on, every=every, p=p, times=times, crash=crash,
+                     seed=seed, site=site))
+        _recompute_active()
     logger.info("fault armed: site=%s on=%s every=%s p=%s times=%s crash=%s",
                 site, on, every, p, times, crash)
 
 
 def disarm(site: Optional[str] = None) -> None:
     """Remove triggers for ``site`` (all sites when None); counters stay."""
-    if site is None:
-        _triggers.clear()
-    else:
-        _triggers.pop(site, None)
-    _recompute_active()
+    with _lock:
+        if site is None:
+            _triggers.clear()
+        else:
+            _triggers.pop(site, None)
+        _recompute_active()
 
 
 def reset() -> None:
     """Disarm everything and zero all invocation counters."""
-    _triggers.clear()
-    _counts.clear()
-    _recompute_active()
+    with _lock:
+        _triggers.clear()
+        _counts.clear()
+        _recompute_active()
 
 
 def count(site: str) -> int:
@@ -173,14 +182,23 @@ def fire(site: str) -> None:
     _ensure_env()
     if not _active:
         return
-    n = _counts.get(site, 0) + 1
-    _counts[site] = n
-    for trig in _triggers.get(site, ()):
-        if trig.matches(n):
-            exc = InjectedCrash(site, n) if trig.crash else InjectedFault(site, n)
-            logger.warning("fault fired: site=%s invocation=%d kind=%s",
-                           site, n, type(exc).__name__)
-            raise exc
+    fired = None
+    with _lock:
+        n = _counts.get(site, 0) + 1
+        _counts[site] = n
+        # matches() mutates trigger state (times countdown, RNG draw), so
+        # it must run under the same lock as the registries — two threads
+        # racing a times=1 trigger would otherwise both see times==1 and
+        # fire it twice
+        for trig in _triggers.get(site, ()):
+            if trig.matches(n):
+                fired = trig
+                break
+    if fired is not None:
+        exc = InjectedCrash(site, n) if fired.crash else InjectedFault(site, n)
+        logger.warning("fault fired: site=%s invocation=%d kind=%s",
+                       site, n, type(exc).__name__)
+        raise exc
 
 
 @contextlib.contextmanager
@@ -192,11 +210,12 @@ def inject(site: str, **kwargs):
     try:
         yield
     finally:
-        if prev:
-            _triggers[site] = prev
-        else:
-            _triggers.pop(site, None)
-        _recompute_active()
+        with _lock:
+            if prev:
+                _triggers[site] = prev
+            else:
+                _triggers.pop(site, None)
+            _recompute_active()
 
 
 def load_spec(spec: str) -> None:
@@ -231,14 +250,31 @@ def load_spec(spec: str) -> None:
 
 def _ensure_env() -> None:
     global _env_loaded
+    # double-checked under its own lock: two worker threads racing the
+    # first fire() must not both load the env spec and arm every trigger
+    # twice (a times=1 trigger would fire twice, breaking the fixed-seed
+    # chaos schedule). A separate lock because load_spec -> arm() takes
+    # _lock; the second thread blocks here until the triggers are armed.
     if _env_loaded:
         return
-    _env_loaded = True
-    from .. import config
+    with _env_lock:
+        if _env_loaded:
+            return
+        # flag flips in the `finally`, AFTER the load: the unlocked
+        # fast-path above may only skip the lock once the triggers are
+        # fully armed (otherwise an early fire() escapes the fixed-seed
+        # schedule); racing threads block on _env_lock until then. The
+        # `finally` also makes the load strictly one-shot — a malformed
+        # tail entry must not leave the valid head re-armed on every
+        # later fire()
+        try:
+            from .. import config
 
-    spec = config.get("faults")
-    if spec:
-        load_spec(spec)
+            spec = config.get("faults")
+            if spec:
+                load_spec(spec)
+        finally:
+            _env_loaded = True
 
 
 def reload_from_env() -> None:

@@ -17,6 +17,7 @@ Defaults come from ``mxnet_tpu.config`` (``MXNET_TPU_RETRY_*`` env knobs).
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -85,25 +86,31 @@ class RetryPolicy:
 # ("delay" = backoff slept AFTER a failed attempt; None on the last one)
 _history: Dict[str, List[dict]] = {}
 _HISTORY_CAP = 1000  # per site — chaos runs fire thousands of attempts
+# retried sites run inside loader/prefetch threads under chaos — guard the
+# shared attempt log (JH005)
+_history_lock = threading.Lock()
 
 
 def attempt_log(site: str) -> List[dict]:
     """The recorded attempts for ``site`` (most recent last)."""
-    return list(_history.get(site, ()))
+    with _history_lock:
+        return list(_history.get(site, ()))
 
 
 def clear_log(site: Optional[str] = None) -> None:
-    if site is None:
-        _history.clear()
-    else:
-        _history.pop(site, None)
+    with _history_lock:
+        if site is None:
+            _history.clear()
+        else:
+            _history.pop(site, None)
 
 
 def _record(site: str, rec: dict) -> None:
-    h = _history.setdefault(site, [])
-    h.append(rec)
-    if len(h) > _HISTORY_CAP:
-        del h[:-_HISTORY_CAP]
+    with _history_lock:
+        h = _history.setdefault(site, [])
+        h.append(rec)
+        if len(h) > _HISTORY_CAP:
+            del h[:-_HISTORY_CAP]
     # observability bridge: every attempt also lands in the process-wide
     # metrics registry (labels: site, ok), so per-site retry counters are
     # aggregated alongside step/comm/ckpt metrics instead of living only in

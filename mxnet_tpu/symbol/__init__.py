@@ -14,6 +14,7 @@ reference's ``symbol.json`` (nodes with op/name/inputs).
 from __future__ import annotations
 
 import json
+import threading as _threading
 from typing import Dict, List, Optional
 
 import jax
@@ -427,11 +428,14 @@ def _infer_shapes_partial(head, known):
 
 
 _NAME_COUNT: Dict[str, int] = {}
+_NAME_LOCK = _threading.Lock()
 
 
 def _auto_name(op):
-    n = _NAME_COUNT.get(op, 0)
-    _NAME_COUNT[op] = n + 1
+    # symbol graphs may be composed from more than one thread (JH005)
+    with _NAME_LOCK:
+        n = _NAME_COUNT.get(op, 0)
+        _NAME_COUNT[op] = n + 1
     return f"{op.lower().strip('_')}{n}"
 
 

@@ -16,6 +16,18 @@ import numpy as np
 import pytest
 
 
+def natkey(item):
+    """Natural-sort key over a (param_name, value) item: block-name
+    counters are process-global, so two identically-built nets get
+    different numeric prefixes — plain lexicographic sort flips order once
+    a counter hits two digits ("dense10" < "dense9"), pairing weights
+    against biases."""
+    import re
+
+    return [int(t) if t.isdigit() else t
+            for t in re.split(r"(\d+)", item[0])]
+
+
 def pytest_configure(config):
     # chaos marker (resilience subsystem): tests that *arm* fault injection
     # themselves, as opposed to the `make chaos` pass which arms

@@ -1,0 +1,548 @@
+"""Static-analysis subsystem (ISSUE 6, docs/ANALYSIS.md): the HLO auditor
+(ProgramReport parsing over both text dialects, donation coverage, program
+fingerprints + recompile causes) and the AST jit-hazard linter (rule
+engine, suppressions, and the package-is-clean regression that backs
+``make lint``).
+"""
+import os
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import analysis, nd, optimizer as opt
+from mxnet_tpu import observability as obs
+from mxnet_tpu.analysis import astlint
+from mxnet_tpu.analysis.hlo_audit import Fingerprint, fingerprint_diff
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import TrainStep
+
+PKG_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "mxnet_tpu")
+
+
+# -- ProgramReport parsing ---------------------------------------------------
+def _bf16_cond_program():
+    def f(p, x):
+        y = (p["w"].astype(jnp.bfloat16) @ x.astype(jnp.bfloat16)).astype(
+            jnp.float32)
+        z = jax.lax.cond(y.sum() > 0, lambda v: v + 1, lambda v: v - 1, y)
+        return {"w": p["w"] - 0.1 * z.sum()}, z.sum()
+
+    return jax.jit(f, donate_argnums=(0,)).lower(
+        {"w": jnp.ones((4, 8))}, jnp.ones((8, 2)))
+
+
+def test_stablehlo_report_census_dots_and_donation():
+    rep = analysis.audit_lowered(_bf16_cond_program())
+    assert rep.dialect == "stablehlo"
+    assert rep.dot_dtypes() == {"bf16": 1}
+    assert rep.count("case") == 1          # the lax.cond branch
+    assert rep.has("dot_general") and not rep.has("nonexistent_op")
+    assert not rep.ops_with_dtype("f64")   # no f64 promotion leak
+    assert "bf16" in rep.dtype_census() and "f32" in rep.dtype_census()
+    # donation: arg0 (the donated dict leaf) aliased, arg1 (batch) not
+    assert rep.donation.aliased == {0: "may-alias"}
+    assert rep.donation.n_inputs == 2
+    assert rep.donation.coverage([0]) == 1.0
+    assert rep.donation.coverage([0, 1]) == 0.5
+    assert rep.donation.missing([0, 1]) == [1]
+    assert rep.inputs[0] == ("f32", (4, 8))
+    assert not rep.host_transfers()
+
+
+def test_hlo_report_compiled_dialect_and_alias_header():
+    low = _bf16_cond_program()
+    rep = analysis.audit_compiled(low.compile())
+    assert rep.dialect == "hlo"
+    # nested-brace input_output_alias header parses (the regex trap)
+    assert rep.donation.aliased == {0: "may-alias"}
+    assert rep.count("fusion") >= 1 or rep.count("dot") >= 1
+
+
+def test_report_collectives_replica_groups():
+    """GSPMD-inserted collectives with both replica-group spellings."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=8))
+
+    def g(x):
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P())).sum() + x.mean()
+
+    jg = jax.jit(g, in_shardings=NamedSharding(mesh, P("dp")),
+                 out_shardings=NamedSharding(mesh, P()))
+    xs = jax.device_put(jnp.ones((8, 4)), NamedSharding(mesh, P("dp")))
+    rep = analysis.audit_compiled(jg.lower(xs).compile())
+    counts = rep.collective_counts()
+    assert counts.get("all_reduce", 0) >= 1
+    for c in rep.collectives:
+        assert c.groups is not None and c.group_size == 8, \
+            (c.name, c.raw_groups)
+    assert len(rep.replica_group_specs()) == 1
+
+
+def test_stablehlo_donation_survives_sharding_attrs():
+    """Arg attrs like ``mhlo.sharding = "{replicated}"`` hold a ``}``
+    inside a quoted value — the lowered-dialect alias scan must not stop
+    there and drop tf.aliasing_output (the compile=False audit path)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=8))
+
+    def f(p, x):
+        return p + x.sum()
+
+    lowered = jax.jit(f, donate_argnums=(0,),
+                      in_shardings=(NamedSharding(mesh, P()),
+                                    NamedSharding(mesh, P("dp"))),
+                      out_shardings=NamedSharding(mesh, P())).lower(
+        jnp.ones((4,)), jnp.ones((8, 4)))
+    rep = analysis.audit_lowered(lowered)
+    assert "mhlo.sharding" in lowered.as_text()  # the trap is present
+    assert rep.donation.aliased == {0: "may-alias"}
+    assert rep.donation.coverage([0]) == 1.0
+
+
+def test_async_collective_pair_counts_once():
+    """all-reduce-start/-done is ONE collective (TPU/GPU backends emit the
+    async pair — with a TUPLE result type on the start op — and combined
+    gradient all-reduces are variadic; the -done op carries no
+    replica_groups and must not dilute the spanning check)."""
+    text = textwrap.dedent("""\
+        HloModule m
+
+        ENTRY %main (p0: f32[4], p1: f32[2]) -> f32[4] {
+          %p0 = f32[4]{0} parameter(0)
+          %p1 = f32[2]{0} parameter(1)
+          %ars = (f32[4]{0}, u32[], u32[]) all-reduce-start(f32[4]{0} %p0), replica_groups={{0,1,2,3}}, to_apply=%add
+          %ard = f32[4]{0} all-reduce-done((f32[4]{0}, u32[], u32[]) %ars)
+          %var = (f32[4]{0}, f32[2]{0}) all-reduce(f32[4]{0} %ard, f32[2]{0} %p1), replica_groups={{0,1,2,3}}, to_apply=%add
+          %inf = ((f32[4]{0}), token[]) infeed(token[] %tok)
+          ROOT %r = f32[4]{0} add(f32[4]{0} %ard, f32[4]{0} %ard)
+        }
+        """)
+    rep = analysis.audit_text(text)
+    # the start/done pair counts once; the variadic (tuple-result)
+    # all-reduce is seen too
+    assert rep.collective_counts() == {"all_reduce": 2}
+    for ar in rep.collectives_named("all_reduce"):
+        assert ar.groups == ((0, 1, 2, 3),) and ar.group_size == 4
+    assert not rep.has("all_reduce_done")
+    # tuple-result host transfers are not invisible to the serving gate
+    assert [o.name for o in rep.host_transfers()] == ["infeed"]
+
+
+def test_audit_text_synthetic_hlo_inventories():
+    """Explicit-list replica groups, custom-call targets and host-transfer
+    ops — exercised on synthetic HLO so every branch of the parser is
+    pinned without needing a TPU-only lowering."""
+    text = textwrap.dedent("""\
+        HloModule m, input_output_alias={ {0}: (1, {}, must-alias) }
+
+        ENTRY %main (p0: f32[4], p1: f32[4]) -> f32[4] {
+          %p0 = f32[4]{0} parameter(0)
+          %p1 = f32[4]{0} parameter(1)
+          %ar = f32[4]{0} all-reduce(f32[4]{0} %p0), replica_groups={{0,1},{2,3}}, to_apply=%add
+          %cc = f32[4]{0} custom-call(f32[4]{0} %ar), custom_call_target="my_kernel"
+          %of = token[] outfeed(f32[4]{0} %cc)
+          ROOT %r = f32[4]{0} add(f32[4]{0} %cc, f32[4]{0} %p1)
+        }
+        """)
+    rep = analysis.audit_text(text)
+    assert rep.dialect == "hlo"
+    assert rep.donation.aliased == {1: "must-alias"}
+    (ar,) = rep.collectives_named("all-reduce")
+    assert ar.groups == ((0, 1), (2, 3)) and ar.group_size == 2
+    assert rep.custom_calls == ["my_kernel"]
+    assert [o.name for o in rep.host_transfers()] == ["outfeed"]
+    assert rep.has_tensor((4,), dtype="f32")
+    assert not rep.has_tensor((5,))
+
+
+# -- fingerprints & recompile causes -----------------------------------------
+def test_fingerprint_diff_distinct_causes():
+    """ISSUE 6 satellite: shape-change vs dtype-change vs static-arg-change
+    each produce a DISTINCT cause, with a detail naming the change."""
+    base = Fingerprint.of([jnp.ones((2, 3)), jnp.ones((2, 4))], lr=0.1)
+    shape = Fingerprint.of([jnp.ones((6, 3)), jnp.ones((2, 4))], lr=0.1)
+    dtype = Fingerprint.of([jnp.ones((2, 3), jnp.bfloat16),
+                            jnp.ones((2, 4))], lr=0.1)
+    static = Fingerprint.of([jnp.ones((2, 3)), jnp.ones((2, 4))], lr=0.5)
+    arity = Fingerprint.of([jnp.ones((2, 3))], lr=0.1)
+
+    assert fingerprint_diff(base, shape) == ("shape", "arg0: [2, 3] -> [6, 3]")
+    cause, detail = fingerprint_diff(base, dtype)
+    assert cause == "dtype" and "float32 -> bfloat16" in detail
+    cause, detail = fingerprint_diff(base, static)
+    assert cause == "static" and "lr" in detail
+    assert fingerprint_diff(base, arity)[0] == "arity"
+    assert fingerprint_diff(base, base) == ("identical", "")
+
+
+def test_recompile_guard_counts_and_explains(tmp_path):
+    obs.enable(str(tmp_path))
+    try:
+        guard = analysis.RecompileGuard(
+            "analysis_test_recompiles_total",
+            label_map={"static": "hyperparams"})
+        f1 = Fingerprint.of([jnp.ones((2, 3))], k=1)
+        f2 = Fingerprint.of([jnp.ones((6, 3))], k=1)
+        f3 = Fingerprint.of([jnp.ones((6, 3))], k=2)
+        assert guard.observe(f1) == "first"
+        assert guard.observe(f1) is None          # seen: no double count
+        assert guard.observe(f2) == "shape"
+        assert guard.observe(f3) == "hyperparams"  # label_map applied
+        assert guard.observe(f1, reason="forced") is None  # f1 already seen
+        assert len(guard) == 3
+        c = obs.REGISTRY.get("analysis_test_recompiles_total")
+        assert c.value(reason="first") == 1
+        assert c.value(reason="shape") == 1
+        assert c.value(reason="hyperparams") == 1
+        obs.shutdown()
+        recs = [e for e in obs.read_events(str(tmp_path))
+                if e["event"] == "recompile"]
+        assert len(recs) == 3
+        shape_ev = next(e for e in recs if e["reason"] == "shape")
+        assert shape_ev["cause"] == "shape"
+        assert "arg0" in shape_ev["detail"]        # explained, not counted
+        assert shape_ev["shapes"] == [[6, 3]]
+    finally:
+        obs.disable()
+        obs.REGISTRY.reset("analysis_test_recompiles_total")
+
+
+def test_recompile_guard_groups_diff_separately(tmp_path):
+    """Program families never cross-diff: the first step program after a
+    window run is cause 'first', NOT a phantom shape change vs the
+    window's stacked-batch fingerprint."""
+    obs.enable(str(tmp_path))
+    try:
+        guard = analysis.RecompileGuard("analysis_test_group_recompiles")
+        window_fp = Fingerprint.of([jnp.ones((4, 8, 16))], key="w")
+        step_fp = Fingerprint.of([jnp.ones((8, 16))], key="s")
+        assert guard.observe(window_fp, reason="window",
+                             group="window") == "window"
+        assert guard.observe(step_fp, group="step") == "first"
+        assert len(guard) == 2
+        # within a family the diff still explains
+        step2 = Fingerprint.of([jnp.ones((2, 16))], key="s")
+        assert guard.observe(step2, group="step") == "shape"
+    finally:
+        obs.disable()
+        obs.REGISTRY.reset("analysis_test_group_recompiles")
+
+
+def test_train_step_recompile_causes_shape_dtype_hyperparams(tmp_path):
+    """The live TrainStep path: a batch-shape change, a label-dtype change
+    and an lr-multiplier edit each land in the event log with their own
+    cause (acceptance: the shape recompile is *logged* with cause
+    "shape")."""
+    obs.enable(str(tmp_path))
+    try:
+        mx.random.seed(0)
+        net = nn.Dense(4, in_units=3)
+        net.initialize()
+        _ = net(nd.ones((2, 3)))
+        sgd = opt.SGD(learning_rate=0.1)
+        ts = TrainStep(net, lambda out, y: ((out - y) ** 2).mean(), sgd)
+        rc = obs.counter("train_recompiles_total")
+        base = {k: rc.value(reason=k)
+                for k in ("first", "shape", "dtype", "hyperparams")}
+        ts(nd.ones((2, 3)), nd.ones((2, 4)))                  # first
+        ts(nd.ones((6, 3)), nd.ones((6, 4)))                  # shape
+        ts(nd.ones((6, 3)), nd.ones((6, 4), dtype="int32"))   # dtype
+        w = net.weight.name
+        sgd.set_lr_mult({w: 0.5})
+        ts(nd.ones((6, 3)), nd.ones((6, 4), dtype="int32"))   # hyperparams
+        assert rc.value(reason="first") == base["first"] + 1
+        assert rc.value(reason="shape") == base["shape"] + 1
+        assert rc.value(reason="dtype") == base["dtype"] + 1
+        assert rc.value(reason="hyperparams") == base["hyperparams"] + 1
+        obs.shutdown()
+        recs = [e for e in obs.read_events(str(tmp_path))
+                if e["event"] == "recompile"]
+        by_reason = {e["reason"]: e for e in recs}
+        assert by_reason["shape"]["cause"] == "shape"
+        assert "[2, 3] -> [6, 3]" in by_reason["shape"]["detail"]
+        assert "float32 -> int32" in by_reason["dtype"]["detail"]
+    finally:
+        obs.disable()
+
+
+# -- audit(): donation coverage ----------------------------------------------
+def _tiny_mlp_step(amp=None, optimizer=None):
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = nd.ones((4, 6))
+    _ = net(x)
+    ts = TrainStep(net, lambda out, *l: ((out - l[0]) ** 2).mean(),
+                   optimizer or opt.Adam(learning_rate=1e-3), amp=amp)
+    return ts, (x, nd.zeros((4, 4)))
+
+
+def test_train_step_audit_step_carry_fully_donated():
+    ts, batch = _tiny_mlp_step(amp="bfloat16")
+    audit = ts.audit(*batch)
+    # 4 params + 8 adam slots ride the donated carry
+    assert len(audit.carry_indices) == 12
+    assert audit.carry_donation() == 1.0, audit.carry_missing()
+    # acceptance: zero f64 ops in the compiled bf16 program's lowering
+    assert not audit.lowered.ops_with_dtype("f64")
+    assert audit.lowered.dot_dtypes().get("bf16", 0) >= 2
+    assert audit.summary()["carry"]["donation_coverage"] == 1.0
+
+
+def test_train_step_audit_window_carry_fully_donated():
+    """ISSUE 6 satellite: 100% donation coverage for the k-step window
+    carry (params + opt state through the lax.scan program)."""
+    ts, batch = _tiny_mlp_step()
+    audit = ts.audit(*batch, window=3)
+    assert audit.lowered.count("while") >= 1   # the scan compiled in
+    assert audit.carry_donation() == 1.0, audit.carry_missing()
+
+
+@pytest.mark.slow
+def test_generation_engine_audit_cache_carry_fully_donated():
+    """ISSUE 6 satellite: 100% donation coverage for the decode-engine
+    KV-cache carry (and the prefill program's cache donation)."""
+    from mxnet_tpu.inference import GenerationEngine
+    from mxnet_tpu.models import gpt2
+
+    mx.random.seed(0)
+    net = gpt2.get_gpt2("gpt2_tiny", dropout=0.0, num_layers=2, units=32,
+                        num_heads=2, max_length=64, vocab_size=64)
+    net.initialize()
+    _ = net(nd.array(np.zeros((1, 4), np.int32)))
+    eng = GenerationEngine(net, batch_size=2, max_length=64,
+                           prefill_buckets=(8, 16))
+    audit = eng.audit()
+    assert len(audit.carry_indices) == 4       # 2 layers x (k_buf, v_buf)
+    assert audit.carry_donation() == 1.0, audit.carry_missing()
+    assert eng.audit(bucket=8).carry_donation() == 1.0
+
+
+def test_audit_does_not_consume_training_rng():
+    """lower()/audit() must not draw from the live key stream — an audit
+    mid-run would otherwise perturb every later step's dropout keys and
+    break fixed-seed reproducibility."""
+    from mxnet_tpu import random as mxrandom
+
+    ts, batch = _tiny_mlp_step()
+    mx.random.seed(42)
+    ref = np.asarray(jax.random.key_data(mxrandom.next_key()))
+    mx.random.seed(42)
+    ts.audit(*batch, compile=False)
+    ts.audit(*batch, window=2, compile=False)
+    got = np.asarray(jax.random.key_data(mxrandom.next_key()))
+    assert (ref == got).all(), "audit() advanced the global key stream"
+
+
+# -- astlint: rules ----------------------------------------------------------
+HOT_SRC = textwrap.dedent("""\
+    import time
+    import numpy as np
+    import jax
+
+    def make_step():
+        def step(params, batch):
+            if params > 0:                    # JH002
+                pass
+            x = float(batch)                  # JH001
+            v = np.asarray(batch)             # JH001
+            y = batch.item()                  # JH001
+            t = time.time()                   # JH003
+            return params
+        fn = step
+        return jax.jit(fn, donate_argnums=(0,))
+    """)
+
+
+def _rules(violations):
+    return sorted(v.rule for v in violations)
+
+
+def test_lint_hot_path_rules_fire_through_alias():
+    vs = astlint.lint_source(HOT_SRC, "mxnet_tpu/x.py")
+    assert _rules(vs) == ["JH001", "JH001", "JH001", "JH002", "JH003"]
+    lines = {v.rule + ":" + str(v.line) for v in vs}
+    assert "JH002:7" in lines and "JH003:12" in lines
+
+
+def test_lint_structural_idioms_not_flagged():
+    """`x is None` and `name in container` are static under tracing; casts
+    of static op params are trace-time specialization — none may fire."""
+    src = textwrap.dedent("""\
+        import jax
+
+        def make(topk):
+            def step(params, state):
+                if params is not None:        # structural: ok
+                    pass
+                for name in state:
+                    if name not in state:     # structural: ok
+                        pass
+                k = int(topk)                 # static param: ok
+                return params
+            return jax.jit(step)
+        """)
+    assert astlint.lint_source(src, "mxnet_tpu/x.py") == []
+
+
+def test_lint_decorated_and_method_hot_paths():
+    src = textwrap.dedent("""\
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def decorated(x):
+            return np.asarray(x)              # JH001
+
+        class Engine:
+            def __init__(self):
+                self._fn = jax.jit(self._decode)
+
+            def _decode(self, x):
+                return x.item()               # JH001 (method via self.)
+        """)
+    assert _rules(astlint.lint_source(src, "m.py")) == ["JH001", "JH001"]
+
+
+def test_lint_mutable_defaults_and_global_mutation():
+    src = textwrap.dedent("""\
+        import threading
+
+        _REG = {}
+        _lock = threading.Lock()
+
+        def bad(x=[], y={}):                  # JH004 x2
+            return x
+
+        def put(k, v):
+            _REG[k] = v                       # JH005
+
+        def put_locked(k, v):
+            with _lock:
+                _REG[k] = v                   # ok
+
+        def rhs_mutation(site):
+            h = _REG.setdefault(site, [])     # JH005: mutates via RHS
+            return h
+
+        def aug(k):
+            _REG[k] += 1                      # JH005: read-modify-write
+
+        def local_only(k, v):
+            reg = {}
+            reg[k] = v                        # ok: not module-global
+            return reg
+
+        def deferred(k, v):
+            with _lock:
+                def cb():
+                    _REG[k] = v               # JH005: cb runs later,
+                return cb                     # NOT under the lock
+        """)
+    assert _rules(astlint.lint_source(src, "m.py")) == \
+        ["JH004", "JH004", "JH005", "JH005", "JH005", "JH005"]
+
+
+def test_lint_nondeterminism_in_op_modules():
+    src = textwrap.dedent("""\
+        import numpy as np
+
+        def my_op(x):
+            noise = np.random.normal(size=x.shape)     # JH003
+            rs = np.random.RandomState(0)              # ok: explicit seed
+            return x + noise + rs.normal(size=x.shape)
+        """)
+    vs = astlint.lint_source(src, "mxnet_tpu/ops/myop.py")
+    assert _rules(vs) == ["JH003"]
+    # same source outside op scope and outside hot paths: clean
+    assert astlint.lint_source(src, "mxnet_tpu/io/loader.py") == []
+
+
+def test_lint_suppressions_inline_above_def_and_file():
+    src = textwrap.dedent("""\
+        import numpy as np
+        import jax
+
+        def make():
+            def step(p):
+                a = np.asarray(p)  # lint: disable=JH001
+                # lint: disable=JH001
+                b = np.asarray(p)
+                c = np.asarray(p)               # still flagged
+                return a, b, c
+            return jax.jit(step)
+
+        def make2():
+            def step2(p):  # lint: disable=all
+                return np.asarray(p)
+            return jax.jit(step2)
+        """)
+    vs = astlint.lint_source(src, "m.py")
+    assert len(vs) == 1 and vs[0].line == 9
+    assert astlint.lint_source(
+        "# lint: disable-file=JH004\ndef f(x=[]):\n    return x\n",
+        "m.py") == []
+
+
+def test_lint_suppression_in_string_literal_is_inert():
+    """A docstring that merely QUOTES the suppression syntax (as the rule
+    catalog and astlint's own module docstring do) must not activate it —
+    only real comment tokens count."""
+    src = textwrap.dedent('''\
+        """Docs quoting the syntax: # lint: disable-file=JH004"""
+
+        def f(x=[]):
+            return x
+        ''')
+    assert _rules(astlint.lint_source(src, "m.py")) == ["JH004"]
+
+
+def test_lint_registered_extra_hot_paths():
+    """EXTRA_HOT_PATHS reaches helpers called from jitted closures — the
+    registered TrainStep._loss_of is hot even with no jit call in sight."""
+    src = textwrap.dedent("""\
+        class TrainStep:
+            def _loss_of(self, params, batch, key):
+                return float(batch)           # JH001 via registration
+        """)
+    vs = astlint.lint_source(src, "mxnet_tpu/parallel/train_step.py")
+    assert _rules(vs) == ["JH001"]
+    assert astlint.lint_source(src, "mxnet_tpu/parallel/other.py") == []
+
+
+def test_package_is_lint_clean():
+    """The `make lint` contract, as a regression test: the package carries
+    no unsuppressed jit hazards. Any new violation fails here AND in CI."""
+    vs = astlint.lint_paths([PKG_DIR])
+    assert vs == [], "\n".join(str(v) for v in vs)
+
+
+def test_lint_cli_smoke(tmp_path):
+    import subprocess
+    import sys
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x=[]):\n    return x\n")
+    tools = os.path.join(os.path.dirname(PKG_DIR), "tools", "lint.py")
+    r = subprocess.run([sys.executable, tools, str(bad)],
+                       capture_output=True, text=True)
+    assert r.returncode == 1 and "JH004" in r.stdout
+    good = tmp_path / "good.py"
+    good.write_text("def f(x=()):\n    return x\n")
+    r = subprocess.run([sys.executable, tools, str(good)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run([sys.executable, tools, "--list-rules"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0 and "JH005" in r.stdout

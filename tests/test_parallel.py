@@ -62,15 +62,12 @@ def test_train_step_dp_matches_single_device():
         l2 = ts2(nd.array(X), nd.array(Y))
     np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
     # prefixes differ between builds (global name counters); compare by order
-    # with a NATURAL sort — lexicographic breaks when counters cross a digit
-    # boundary (dense10 < dense9)
-    import re
+    # with a NATURAL sort (conftest.natkey) — lexicographic breaks when
+    # counters cross a digit boundary (dense10 < dense9)
+    from conftest import natkey
 
-    def nat(kv):
-        return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", kv[0])]
-
-    for (k1, v1), (k2, v2) in zip(sorted(ts1.params.items(), key=nat),
-                                  sorted(ts2.params.items(), key=nat)):
+    for (k1, v1), (k2, v2) in zip(sorted(ts1.params.items(), key=natkey),
+                                  sorted(ts2.params.items(), key=natkey)):
         np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
                                    rtol=1e-4, atol=1e-6, err_msg=f"{k1} vs {k2}")
 

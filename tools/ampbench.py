@@ -28,7 +28,6 @@ import argparse
 import datetime
 import json
 import os
-import re
 import statistics
 import sys
 import time
@@ -73,25 +72,29 @@ def build_step(seq, layers, units, heads, vocab, batch, amp, remat=None):
 
 def hlo_section(fails):
     """bf16 dots + f32 master update + in-graph f16 scaling, asserted on a
-    small-seq GPT-2 step (fast to lower)."""
+    small-seq GPT-2 step through the structural auditor
+    (mxnet_tpu.analysis, docs/ANALYSIS.md) — same ProgramReport queries as
+    tests/test_hlo_assertions.py, no regexes over as_text()."""
     import jax
     import jax.numpy as jnp
 
     out = {}
     ts, args = build_step(seq=64, layers=2, units=64, heads=2, vocab=128,
                           batch=2, amp="bfloat16")
-    lowered = ts.lower_hlo(*args)
-    low = lowered.as_text()
-    out["bf16_dots"] = len(re.findall(r"dot_general.*bf16", low))
+    audit = ts.audit(*args)
+    out["bf16_dots"] = audit.lowered.dot_dtypes().get("bf16", 0)
     if out["bf16_dots"] < 3:
         fails.append(f"only {out['bf16_dots']} bf16 dots in the bf16-policy "
                      "program")
-    compiled = lowered.compile()
-    header = next((ln for ln in compiled.as_text().splitlines()
-                   if "input_output_alias" in ln), "")
-    out["donation_aliases"] = header.count("alias")
-    if out["donation_aliases"] < 4:
-        fails.append("master-weight donation aliases missing")
+    out["f64_ops"] = len(audit.lowered.ops_with_dtype("f64"))
+    if out["f64_ops"]:
+        fails.append(f"{out['f64_ops']} f64 ops leaked into the bf16 "
+                     "program")
+    out["donation_aliases"] = audit.compiled.donation.n_aliased
+    out["carry_donation"] = audit.carry_donation()
+    if out["donation_aliases"] < 4 or out["carry_donation"] < 1.0:
+        fails.append("master-weight donation aliases missing "
+                     f"(carry coverage {out['carry_donation']:.0%})")
     _ = ts(*args)
     out["masters_f32"] = all(v.dtype == jnp.float32
                              for v in ts.params.values())
@@ -106,13 +109,15 @@ def hlo_section(fails):
     ts16, args16 = build_step(seq=64, layers=2, units=64, heads=2, vocab=128,
                               batch=2,
                               amp=Policy("float16", loss_scale=128.0))
-    low16 = ts16.lower_hlo(*args16).as_text()
-    out["f16_dots"] = len(re.findall(r"dot_general.*f16(?!\d)", low16)) \
-        - len(re.findall(r"dot_general.*bf16", low16))
-    out["isfinite_in_graph"] = "is_finite" in low16
+    rep16 = ts16.audit(*args16, compile=False).lowered
+    dots16 = rep16.dot_dtypes()
+    out["f16_dots"] = dots16.get("f16", 0)
+    if dots16.get("bf16", 0):
+        fails.append(f"bf16 dots under the float16 policy: {dots16}")
+    out["isfinite_in_graph"] = rep16.has("is_finite")
     # a real branch (lax.cond -> stablehlo.case), not the jnp.where selects
     # of the scale arithmetic
-    out["conditional_update"] = "stablehlo.case" in low16
+    out["conditional_update"] = rep16.count("case") >= 1
     if out["f16_dots"] < 1:
         fails.append("no f16 dots in the float16-policy program")
     if not out["isfinite_in_graph"]:

@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Program-audit gate (``make audit``; docs/ANALYSIS.md, ISSUE 6).
+
+Runs the structural HLO auditor over the framework's two donated-carry
+program families on CPU and FAILS unless the structural contracts hold:
+
+  1. **bf16 purity** — the bf16-policy TrainStep's lowered program (single
+     step AND the fused k-step window) contains bf16 dots and ZERO f64
+     ops (an f64 promotion leak silently halves MXU throughput);
+  2. **donation coverage** — 100% of the TrainStep carry (params + opt
+     state, window included) and of the decode engine's KV-cache carry is
+     aliased input->output in the compiled executable (a lost alias means
+     a full buffer copy every step);
+  3. **recompile causes** — a recompile triggered by a batch-shape change
+     is *logged* with cause ``"shape"`` and an ``arg: old -> new`` detail
+     in the observability event log, not just counted.
+
+Everything here reads :class:`mxnet_tpu.analysis.ProgramReport` /
+``TrainStep.audit()`` / ``GenerationEngine.audit()`` — the same API the
+test suite uses, exercised as a standalone pre-merge gate.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def train_step_section(fails):
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, optimizer
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import TrainStep
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = nd.ones((8, 16))
+    _ = net(x)
+    ts = TrainStep(net, lambda out, *l: ((out - l[0]) ** 2).mean(),
+                   optimizer.Adam(learning_rate=1e-3), amp="bfloat16")
+    batch = (x, nd.zeros((8, 8)))
+
+    out = {}
+    for name, audit in (("step", ts.audit(*batch)),
+                        ("window", ts.audit(*batch, window=3))):
+        dots = audit.lowered.dot_dtypes()
+        f64 = audit.lowered.ops_with_dtype("f64")
+        cov = audit.carry_donation()
+        out[name] = {"dots": dots, "f64_ops": len(f64),
+                     "carry_n": len(audit.carry_indices),
+                     "donation_coverage": cov}
+        if dots.get("bf16", 0) < 2:
+            fails.append(f"{name}: bf16-policy program has no bf16 dots "
+                         f"({dots})")
+        if f64:
+            fails.append(f"{name}: {len(f64)} f64 ops leaked into the "
+                         f"compiled bf16 program: {f64[:3]}")
+        if cov < 1.0:
+            fails.append(f"{name}: carry donation {cov:.0%} < 100% — "
+                         f"missing flat inputs {audit.carry_missing()}")
+    return out
+
+
+def decode_engine_section(fails):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.inference import GenerationEngine
+    from mxnet_tpu.models import gpt2
+
+    mx.random.seed(0)
+    net = gpt2.get_gpt2("gpt2_tiny", dropout=0.0, num_layers=2, units=32,
+                        num_heads=2, max_length=64, vocab_size=64)
+    net.initialize()
+    _ = net(nd.array(np.zeros((1, 4), np.int32)))
+    eng = GenerationEngine(net, batch_size=2, max_length=64,
+                           prefill_buckets=(8, 16))
+    out = {}
+    for name, audit in (("decode", eng.audit()),
+                        ("prefill", eng.audit(bucket=8))):
+        cov = audit.carry_donation()
+        out[name] = {"carry_n": len(audit.carry_indices),
+                     "donation_coverage": cov,
+                     "host_transfers": [o.name for o in
+                                        audit.compiled.host_transfers()]}
+        if cov < 1.0:
+            fails.append(f"{name}: KV-cache carry donation {cov:.0%} < "
+                         f"100% — missing {audit.carry_missing()}")
+        if out[name]["host_transfers"]:
+            fails.append(f"{name}: host-transfer ops in the serving "
+                         f"program: {out[name]['host_transfers']}")
+    return out
+
+
+def recompile_cause_section(fails):
+    """A shape-change recompile must land in the event log with cause
+    "shape" and a detail naming the changed argument."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, observability as obs, optimizer
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import TrainStep
+
+    with tempfile.TemporaryDirectory() as tmp:
+        obs.enable(tmp)
+        try:
+            mx.random.seed(0)
+            net = nn.Dense(4, in_units=3)
+            net.initialize()
+            _ = net(nd.ones((2, 3)))
+            ts = TrainStep(net, lambda out, y: ((out - y) ** 2).mean(),
+                           optimizer.SGD(learning_rate=0.1))
+            ts(nd.ones((2, 3)), nd.ones((2, 4)))
+            ts(nd.ones((6, 3)), nd.ones((6, 4)))   # the shape change
+            obs.shutdown()
+            recs = [e for e in obs.read_events(tmp)
+                    if e["event"] == "recompile"]
+        finally:
+            obs.disable()
+    shape_evs = [e for e in recs if e.get("reason") == "shape"]
+    out = {"recompile_events": len(recs),
+           "shape_events": [{k: e.get(k) for k in
+                             ("reason", "cause", "detail")}
+                            for e in shape_evs]}
+    if not shape_evs:
+        fails.append(f"no recompile event with reason='shape' (got "
+                     f"{[e.get('reason') for e in recs]})")
+    elif not (shape_evs[0].get("cause") == "shape"
+              and "->" in shape_evs[0].get("detail", "")):
+        fails.append(f"shape recompile not explained: {shape_evs[0]}")
+    return out
+
+
+def main():
+    fails: list = []
+    row = {
+        "gate": "audit",
+        "train_step": train_step_section(fails),
+        "decode_engine": decode_engine_section(fails),
+        "recompile_cause": recompile_cause_section(fails),
+    }
+    row["ok"] = not fails
+    if fails:
+        row["failures"] = fails
+    print(json.dumps(row, indent=1))
+    if fails:
+        for msg in fails:
+            print(f"FAIL: {msg}")
+        return 1
+    ts = row["train_step"]
+    print(f"OK: bf16 step/window carry donation 100% "
+          f"({ts['step']['carry_n']}+{ts['window']['carry_n']} buffers), "
+          f"0 f64 ops, decode cache donation 100%, shape recompile "
+          f"explained in the event log")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""jit-hazard linter CLI (``make lint``; docs/ANALYSIS.md).
+
+Runs the :mod:`mxnet_tpu.analysis.astlint` rules — host syncs in compiled
+hot paths, trace-time branches, nondeterminism in op code, mutable default
+args, unlocked global-registry mutation — over the package source.
+
+Usage::
+
+    python tools/lint.py                  # lint mxnet_tpu/ + tools/
+    python tools/lint.py path [path ...]  # specific files/trees
+    python tools/lint.py --changed        # only files changed vs git HEAD
+                                          # (staged, unstaged + untracked)
+    python tools/lint.py --list-rules     # rule catalog
+
+Exit status: 0 clean, 1 violations, 2 usage/environment error. Suppression
+syntax (``# lint: disable=JH001``) is documented in docs/ANALYSIS.md.
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_PATHS = ["mxnet_tpu", "tools"]
+
+
+def _changed_files():
+    """Python files changed vs HEAD (staged + unstaged + untracked), kept
+    to the trees the full gate lints — --changed must be a strict subset
+    of `make lint`, never stricter (a jitted `.item()` oracle in tests/
+    is legitimate there and unlinted by CI)."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=REPO,
+            capture_output=True, text=True, check=True).stdout
+    except (OSError, subprocess.CalledProcessError) as e:
+        print(f"lint: --changed needs git ({e})", file=sys.stderr)
+        raise SystemExit(2)
+    files = []
+    for line in out.splitlines():
+        # porcelain: XY <path> (or `XY old -> new` for renames)
+        path = line[3:].split(" -> ")[-1].strip().strip('"')
+        if path.endswith(".py") \
+                and any(path.startswith(p + "/") for p in DEFAULT_PATHS) \
+                and os.path.exists(os.path.join(REPO, path)):
+            files.append(os.path.join(REPO, path))
+    return files
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help="files or trees "
+                    f"(default: {' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files changed vs git HEAD (pre-commit)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="summary line only")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.analysis import astlint
+
+    if args.list_rules:
+        for rule in astlint.list_rules():
+            print(f"{rule.rule_id}  {rule.summary}")
+        return 0
+
+    if args.changed:
+        paths = _changed_files()
+        if not paths:
+            print("lint: no changed python files")
+            return 0
+    else:
+        paths = args.paths or [os.path.join(REPO, p) for p in DEFAULT_PATHS]
+
+    violations = astlint.lint_paths(paths)
+    if not args.quiet:
+        for v in violations:
+            print(os.path.relpath(v.path, REPO) if os.path.isabs(v.path)
+                  else v.path, end="")
+            print(f":{v.line}:{v.col}: {v.rule} {v.message}")
+    n_files = sum(1 for _ in paths) if all(os.path.isfile(p) for p in paths) \
+        else None
+    scope = f"{len(paths)} file(s)" if n_files else ", ".join(
+        os.path.relpath(p, REPO) if os.path.isabs(p) else p for p in paths)
+    if violations:
+        print(f"lint: {len(violations)} violation(s) in {scope}")
+        return 1
+    print(f"lint: clean ({scope})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
