@@ -23,9 +23,9 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity lint audit native fast slow test chaos obs perfwin genbench ampbench bench clean
+.PHONY: ci sanity lint audit native fast slow test chaos chaos-elastic obs perfwin genbench ampbench bench clean
 
-ci: sanity lint native fast audit
+ci: sanity lint native fast audit chaos-elastic
 
 sanity:
 	$(PY) -m compileall -q mxnet_tpu tools tests examples bench.py __graft_entry__.py
@@ -59,6 +59,16 @@ chaos: native
 	MXNET_TPU_FAULTS="$(CHAOS_FAULTS)" MXNET_TPU_RETRY_BASE_DELAY=0.005 \
 		$(PY) -m pytest tests/ -q -m "not slow"
 	MXNET_TPU_RETRY_BASE_DELAY=0.005 $(PY) tools/obs_smoke.py --chaos-check
+
+# elastic chaos drill (docs/RESILIENCE.md "Elastic training"): a 4-process
+# launch is SIGKILLed mid-run; the supervisor re-forms the mesh (1:1
+# replacement, and separately scaled down to 3 under the shrink policy),
+# the job resumes from the latest valid manifest checkpoint, and final
+# params match the never-killed baseline within documented tolerance —
+# with mesh_reformations_total >= 1 and an elastic_restore event carrying
+# cause + old/new world size
+chaos-elastic: native
+	$(PY) -m pytest tests/test_launch_dist.py -q -k "elastic"
 
 # observability gate (docs/OBSERVABILITY.md): a 2-step LeNet train with
 # telemetry on must yield a non-empty obs_report summary covering step/

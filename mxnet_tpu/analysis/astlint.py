@@ -78,6 +78,13 @@ EXTRA_HOT_PATHS: Dict[str, Tuple[str, ...]] = {
         "GenerationEngine._prefill_fn", "GenerationEngine._decode_fn",
         "GenerationEngine._sample",
     ),
+    # step-boundary probes: called from inside the training loop every
+    # step, so host-sync/branch/determinism hazards apply even though
+    # nothing here is jit-traced
+    "resilience/elastic.py": (
+        "HeartbeatMonitor.check", "HeartbeatMonitor.stale_peers",
+        "HeartbeatMonitor.beat", "ElasticContext.check",
+    ),
 }
 
 # function names that wrap a python callable into a compiled/traced one

@@ -23,6 +23,20 @@ subsystem (docs/RESILIENCE.md):
   - reads and writes run under the retry policy and are fault-injection
     sites (``ckpt.save`` / ``ckpt.load``) so all of the above is exercised
     by tests and ``make chaos`` on CPU.
+
+World-size-agnostic checkpoints (the elastic-training contract,
+docs/RESILIENCE.md "Elastic training"): the manifest records each array's
+*global* shape, dtype and partition spec, and the ``npz-shards`` format
+additionally stores every shard with its index window — so a checkpoint
+written by a world of N reassembles at any world size M (scale-down to a
+smaller mesh, scale back up later), with the restore side re-applying the
+current mesh's layout (reshard-on-restore; the storage layout being
+reshaped is the cross-replica sharded weight-update layout of
+arXiv:2004.13336). Multi-host saves are *collective*: every host writes
+its addressable shards into the stage dir, a cross-host barrier confirms
+they all landed, and only then does rank 0 write the manifest and
+``meta.json`` (last) and commit — ``latest_checkpoint`` can never adopt a
+checkpoint another host only half-wrote.
 """
 from __future__ import annotations
 
@@ -58,34 +72,134 @@ def _orbax():
         return None
 
 
+def _barrier(name: str) -> None:
+    """Cross-host sync point for collective saves (no-op single-process).
+    Module-level so tests can observe/replace the barrier sequence."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def _spec_of(a):
+    """Serialized partition spec of a leaf (None for host-local arrays):
+    list entries are mesh-axis names, axis-name lists, or None — enough for
+    any world size to know how the array was cut when it reassembles."""
+    spec = getattr(getattr(a, "sharding", None), "spec", None)
+    if spec is None:
+        return None
+    return [list(e) if isinstance(e, tuple) else e for e in spec]
+
+
+def _norm_index(index, shape) -> list:
+    """A shard's index window as [[start, stop], ...] (JSON-friendly)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        out.append([sl.start or 0, dim if sl.stop is None else sl.stop])
+    return out
+
+
+def _local_shards(a, leader: bool, nproc: int):
+    """(host_data, index_window) pairs this process owns for leaf ``a``.
+
+    Globally-sharded jax Arrays contribute their addressable
+    ``replica_id == 0`` shards — exactly one process owns each index
+    window, however the array is sharded/replicated. A *fully-addressable*
+    leaf in a multi-process run is process-local state (every host holds
+    the same whole array — e.g. the KVStore data-parallel layout), so the
+    leader alone owns the single full window; in a single-process run a
+    fully-addressable leaf still records its per-device shard windows —
+    that IS the world-size-agnostic layout the elastic restore consumes.
+    """
+    fully_local = getattr(a, "is_fully_addressable", True)
+    if hasattr(a, "addressable_shards") and \
+            getattr(a, "sharding", None) is not None and \
+            (nproc == 1 or not fully_local):
+        out = []
+        for s in a.addressable_shards:
+            if s.replica_id != 0:
+                continue
+            out.append((np.asarray(s.data), _norm_index(s.index, a.shape)))
+        return out
+    if not leader:
+        return []
+    host = np.asarray(a)
+    return [(host, [[0, d] for d in host.shape])]
+
+
 def save_train_state(directory: str, step: int, params, opt_state,
                      extra: Optional[dict] = None,
-                     keep_last: Optional[int] = None) -> str:
+                     keep_last: Optional[int] = None,
+                     sharded: Optional[bool] = None) -> str:
     """Write checkpoint ``directory/ckpt-{step}``; returns the path.
 
     The write is crash-safe: all payload lands in ``ckpt-{step}.tmp`` and
     one ``os.replace`` publishes it. ``keep_last`` (default: the
     ``ckpt_keep_last`` config knob; 0 = keep all) prunes older committed
     checkpoints after a successful commit.
+
+    Format selection: orbax when opted in; else the world-size-agnostic
+    ``npz-shards`` layout when this is a multi-process run, any leaf is
+    not fully addressable, or ``sharded=True`` (/ the ``ckpt_sharded``
+    knob); else flat npz. In a multi-process run this call is
+    **collective** — every host must call it (hosts with no shards to
+    contribute still participate in the save barrier).
     """
     import jax
 
     from . import config
 
+    nproc = jax.process_count()
+    if sharded is None:
+        sharded = config.get("ckpt_sharded")
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, f"ckpt-{step}")
     tmp = path + ".tmp"
     ocp = _orbax()
     state = {"params": params, "opt_state": opt_state}
     flat, treedef = jax.tree_util.tree_flatten(state)
+    hashable = all(getattr(a, "is_fully_addressable", True) for a in flat)
+
+    t0 = time.perf_counter()
+    if ocp is None and (nproc > 1 or sharded or not hashable):
+        _save_sharded(path, tmp, step, flat, treedef, extra, nproc)
+    else:
+        _save_flat(path, tmp, step, state, flat, treedef, extra, ocp,
+                   hashable)
+    dt = time.perf_counter() - t0
+    # checkpoint IO is rare — record telemetry unconditionally so retention
+    # and duration trends exist even when full telemetry is off
+    nbytes = _dir_bytes(path)
+    _obs.histogram("ckpt_save_seconds", "checkpoint write+commit wall clock",
+                   unit="s").observe(dt)
+    _obs.counter("ckpt_saves_total").inc()
+    _obs.counter("ckpt_bytes_total", unit="bytes").inc(nbytes, op="save")
+    _obs.emit("checkpoint_save", path=path, ckpt_step=step,
+              seconds=round(dt, 6), bytes=nbytes)
+    # always sweep: keep=0 prunes nothing but still clears .tmp/.stale
+    # debris abandoned by earlier crashed saves. Leader-only when
+    # multi-process (concurrent rmtree of the same dirs races).
+    if jax.process_index() == 0:
+        keep = keep_last if keep_last is not None \
+            else config.get("ckpt_keep_last")
+        integrity.sweep_retention(directory, keep)
+    return path
+
+
+def _save_flat(path, tmp, step, state, flat, treedef, extra, ocp, hashable):
+    """Single-controller formats: orbax, or whole-array flat npz."""
+    import jax
 
     # per-array digests need the bytes on host: fine for the npz path (it
     # materializes anyway — do it once, reused for savez + manifest), but a
-    # multi-host sharded leaf can't be np.asarray'd; those checkpoints get a
-    # file-level manifest only and skip the array-hash tier
-    hashable = all(getattr(a, "is_fully_addressable", True) for a in flat)
+    # non-addressable sharded leaf can't be np.asarray'd; those checkpoints
+    # get a file-level manifest only and skip the array-hash tier
     host_flat = [np.asarray(a) for a in flat] if ocp is None else \
         (flat if hashable else [])
+    specs = [_spec_of(a) for a in flat]
 
     def _write():
         shutil.rmtree(tmp, ignore_errors=True)
@@ -107,31 +221,184 @@ def save_train_state(directory: str, step: int, params, opt_state,
         # manifest, no commit) — exactly the mid-save kill the recovery
         # tests simulate; latest_checkpoint never sees .tmp dirs
         faults.fire("ckpt.save")
-        manifest = integrity.build_manifest(host_flat, fmt, tmp, payload_files)
+        manifest = integrity.build_manifest(host_flat, fmt, tmp,
+                                            payload_files, specs=specs)
         integrity.write_manifest(tmp, manifest)
         with open(os.path.join(tmp, "meta.json"), "w") as f:
-            json.dump({"step": step, **(extra or {})}, f)
+            json.dump({"step": step, "world_size": jax.process_count(),
+                       **(extra or {})}, f)
             f.flush()
             os.fsync(f.fileno())
         integrity.commit_dir(tmp, path)
 
-    t0 = time.perf_counter()
     retry.retry_call(_write, site="ckpt.save")
-    dt = time.perf_counter() - t0
-    # checkpoint IO is rare — record telemetry unconditionally so retention
-    # and duration trends exist even when full telemetry is off
-    nbytes = _dir_bytes(path)
-    _obs.histogram("ckpt_save_seconds", "checkpoint write+commit wall clock",
-                   unit="s").observe(dt)
-    _obs.counter("ckpt_saves_total").inc()
-    _obs.counter("ckpt_bytes_total", unit="bytes").inc(nbytes, op="save")
-    _obs.emit("checkpoint_save", path=path, ckpt_step=step,
-              seconds=round(dt, 6), bytes=nbytes)
-    # always sweep: keep=0 prunes nothing but still clears .tmp/.stale
-    # debris abandoned by earlier crashed saves
-    keep = keep_last if keep_last is not None else config.get("ckpt_keep_last")
-    integrity.sweep_retention(directory, keep)
-    return path
+
+
+def _save_sharded(path, tmp, step, flat, treedef, extra, nproc):
+    """World-size-agnostic ``npz-shards`` save (collective when nproc>1).
+
+    Every host stages ``shards-h{pid}.npz`` (its ``replica_id==0`` shards)
+    plus a tiny JSON sidecar indexing them; after the all-shards barrier,
+    rank 0 merges the sidecars into the manifest, writes ``meta.json``
+    **last**, and commits — so a reader can never adopt a checkpoint some
+    host only half-wrote. A final barrier holds every host until the
+    commit is visible.
+    """
+    import jax
+
+    pid = jax.process_index()
+    leader = pid == 0
+    fname = f"shards-h{pid}.npz"
+
+    def _write():
+        if leader:
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp, exist_ok=True)
+        _barrier("ckpt.save.stage")
+        # chaos site: a crash past here leaves a torn .tmp (shards written,
+        # no manifest/meta, no commit) that is never a restore candidate
+        faults.fire("ckpt.save")
+        payload = {}
+        records = {}
+        for i, a in enumerate(flat):
+            entries = []
+            for j, (data, index) in enumerate(_local_shards(a, leader,
+                                                            nproc)):
+                key = f"{i}.{j}"
+                payload[key] = data
+                entries.append({"key": key, "file": fname, "index": index,
+                                "sha256": integrity.array_digest(data)})
+            dt = getattr(a, "dtype", None)
+            records[str(i)] = {
+                "global_shape": list(np.shape(a)),
+                # np.asarray as a getattr default would run eagerly — and a
+                # non-addressable leaf can't be np.asarray'd at all
+                "dtype": str(dt if dt is not None else np.asarray(a).dtype),
+                "spec": _spec_of(a),
+                "shards": entries,
+            }
+        if payload:
+            np.savez(os.path.join(tmp, fname), **payload)
+        with open(os.path.join(tmp, f"shards-h{pid}.json"), "w") as f:
+            json.dump({"arrays": records}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        if leader:
+            with open(os.path.join(tmp, "treedef.txt"), "w") as f:
+                f.write(str(treedef))
+        _barrier("ckpt.save.shards")  # every host's shards have landed
+        if leader:
+            manifest = _merge_shard_sidecars(tmp)
+            integrity.write_manifest(tmp, manifest)
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump({"step": step, "world_size": nproc,
+                           **(extra or {})}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            integrity.commit_dir(tmp, path)
+        _barrier("ckpt.save.commit")  # nobody resumes before the commit
+
+    if nproc > 1:
+        # collective write: a per-host retry would re-enter the barrier
+        # sequence on one side only and desync every host; a failed host
+        # dies and the elastic supervisor re-forms instead (RESILIENCE.md)
+        _write()
+    else:
+        retry.retry_call(_write, site="ckpt.save")
+
+
+def _merge_shard_sidecars(tmp: str) -> dict:
+    """Rank 0, post-barrier: union all hosts' shard indexes + hash the
+    payload files into the manifest ``files`` tier."""
+    manifest: dict = {"format": "npz-shards", "files": {}, "arrays": {}}
+    sidecars = sorted(n for n in os.listdir(tmp)
+                      if n.startswith("shards-h") and n.endswith(".json"))
+    for name in sidecars:
+        with open(os.path.join(tmp, name)) as f:
+            recs = json.load(f)["arrays"]
+        for idx, rec in recs.items():
+            tgt = manifest["arrays"].setdefault(
+                idx, {"global_shape": rec["global_shape"],
+                      "dtype": rec["dtype"], "spec": rec["spec"],
+                      "shards": []})
+            tgt["shards"].extend(rec["shards"])
+    for name in sorted(os.listdir(tmp)):
+        if name == integrity.MANIFEST_NAME or name == "meta.json":
+            continue
+        p = os.path.join(tmp, name)
+        manifest["files"][name] = {"sha256": integrity.file_digest(p),
+                                   "size": os.path.getsize(p)}
+    return manifest
+
+
+def _undo_npz_void(data, dtype):
+    """np.savez writes ml_dtypes leaves (bfloat16, float8_*) as raw void
+    records ('|V2') — the bytes are intact (per-shard sha256 still
+    matches), so reinterpret against the manifest-recorded dtype instead
+    of letting the window assignment die on 'no cast function'."""
+    if data.dtype != dtype and data.dtype.kind == "V" \
+            and data.dtype.itemsize == dtype.itemsize:
+        return data.view(dtype)
+    return data
+
+
+def _assemble_shards(path: str, manifest: dict):
+    """Reassemble host-global leaves from an ``npz-shards`` checkpoint —
+    at *any* world size: each shard is verified (sha256) and placed at its
+    recorded index window; coverage must tile the global shape exactly."""
+    arrays = manifest.get("arrays", {})
+    opened: dict = {}
+    problems = []
+    flat = []
+    try:
+        _assemble_into(path, arrays, opened, problems, flat)
+    finally:
+        for npz in opened.values():  # zip handles don't wait for GC
+            try:
+                npz.close()
+            except Exception:
+                pass
+    if problems:
+        raise CheckpointCorruptError(path, problems)
+    return flat
+
+
+def _assemble_into(path, arrays, opened, problems, flat):
+    import zipfile
+    import zlib
+
+    for i in range(len(arrays)):
+        rec = arrays[str(i)]
+        shape = tuple(rec["global_shape"])
+        out = np.empty(shape, dtype=np.dtype(rec["dtype"]))
+        covered = 0
+        for s in rec.get("shards", ()):
+            fp = os.path.join(path, s["file"])
+            try:
+                if s["file"] not in opened:
+                    opened[s["file"]] = np.load(fp)
+                data = opened[s["file"]][s["key"]]
+            except (zipfile.BadZipFile, zlib.error, ValueError, KeyError,
+                    FileNotFoundError) as e:
+                # torn/flipped bytes inside the zip container — or a shard
+                # file lost post-commit — are the same corruption class as
+                # a sha mismatch: deterministic, so non-retryable
+                # (retryable=False on CheckpointCorruptError)
+                problems.append(f"array {i} shard {s['key']} unreadable: "
+                                f"{type(e).__name__}: {e}")
+                continue
+            if integrity.array_digest(data) != s["sha256"]:
+                problems.append(f"array {i} shard {s['key']} sha256 mismatch")
+                continue
+            data = _undo_npz_void(data, out.dtype)
+            out[tuple(slice(a, b) for a, b in s["index"])] = data
+            covered += int(np.prod([b - a for a, b in s["index"]])) \
+                if s["index"] else 1
+        want = int(np.prod(shape)) if shape else 1
+        if covered != want:
+            problems.append(f"array {i} shard coverage {covered} != {want} "
+                            "elements")
+        flat.append(out)
 
 
 def _dir_bytes(path: str) -> int:
@@ -150,8 +417,14 @@ def load_train_state(path: str, like=None):
     with target shardings/dtypes (required for the orbax path).
 
     Restored leaves are verified against the checkpoint's manifest
-    (per-array sha256); any mismatch raises :class:`CheckpointCorruptError`
+    (per-array sha256; per-shard for ``npz-shards``, verified during
+    reassembly); any mismatch raises :class:`CheckpointCorruptError`
     rather than silently resuming from corrupt state.
+
+    ``npz-shards`` checkpoints reassemble to host-global arrays whatever
+    world size wrote them — the caller (e.g. ``TrainStep.restore``)
+    re-applies the *current* mesh layout, which is how elastic scale-down/
+    scale-up reshards fsdp state.
     """
     import jax
 
@@ -161,29 +434,54 @@ def load_train_state(path: str, like=None):
         faults.fire("ckpt.load")
         with open(os.path.join(path, "meta.json")) as f:
             meta = json.load(f)
-        if ocp is not None and not os.path.exists(os.path.join(path, "arrays.npz")):
+        try:
+            mf = integrity.read_manifest(path)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                path, [f"unreadable manifest: {e}"]) from e
+        if mf is not None and mf.get("format") == "npz-shards":
+            assert like is not None, "shard restore requires a template pytree"
+            flat = _assemble_shards(path, mf)
+            template = {"params": like[0], "opt_state": like[1]}
+            treedef = jax.tree_util.tree_structure(template)
+            state = jax.tree_util.tree_unflatten(treedef, flat)
+        elif ocp is not None and not os.path.exists(os.path.join(path, "arrays.npz")):
             ckptr = ocp.StandardCheckpointer()
             template = None
             if like is not None:
                 template = {"params": like[0], "opt_state": like[1]}
             state = ckptr.restore(os.path.abspath(path), template)
         else:
-            data = np.load(os.path.join(path, "arrays.npz"))
-            flat = [data[str(i)] for i in range(len(data.files))]
+            import zipfile
+            import zlib
+
+            try:
+                data = np.load(os.path.join(path, "arrays.npz"))
+                flat = [data[str(i)] for i in range(len(data.files))]
+            except (zipfile.BadZipFile, zlib.error, ValueError) as e:
+                # a torn zip container is deterministic corruption, not a
+                # transient read failure — surface it non-retryably
+                raise CheckpointCorruptError(
+                    path, [f"unreadable arrays.npz: "
+                           f"{type(e).__name__}: {e}"]) from e
             assert like is not None, "npz restore requires a template pytree"
+            if mf is not None and mf.get("arrays"):
+                flat = [_undo_npz_void(a, np.dtype(
+                            mf["arrays"][str(i)]["dtype"]))
+                        if str(i) in mf["arrays"] else a
+                        for i, a in enumerate(flat)]
             template = {"params": like[0], "opt_state": like[1]}
             treedef = jax.tree_util.tree_structure(template)
             state = jax.tree_util.tree_unflatten(treedef, flat)
-        return state, meta
+        return state, meta, mf
 
     t0 = time.perf_counter()
-    state, meta = retry.retry_call(_read, site="ckpt.load")
-    try:
-        manifest = integrity.read_manifest(path)
-    except (OSError, ValueError) as e:
-        raise CheckpointCorruptError(path, [f"unreadable manifest: {e}"]) from e
+    state, meta, manifest = retry.retry_call(_read, site="ckpt.load")
     verify_dt = 0.0
-    if manifest is not None and manifest.get("arrays"):
+    if manifest is not None and manifest.get("arrays") \
+            and manifest.get("format") != "npz-shards":
+        # (npz-shards leaves were already sha-verified shard-by-shard
+        # inside _assemble_shards — no whole-array digest exists for them)
         flat, _ = jax.tree_util.tree_flatten(state)
         if all(getattr(a, "is_fully_addressable", True) for a in flat):
             v0 = time.perf_counter()

@@ -72,6 +72,26 @@ _KNOBS: Dict[str, tuple] = {
     "ckpt_keep_last": (int, 0, ("MXNET_TPU_CKPT_KEEP_LAST",),
                        "retention sweep after each save_train_state: keep "
                        "the newest N committed checkpoints (0 = keep all)"),
+    "ckpt_sharded": (bool, False, ("MXNET_TPU_CKPT_SHARDED",),
+                     "force the world-size-agnostic npz-shards checkpoint "
+                     "format even for fully-addressable single-process "
+                     "state (multi-process and non-addressable saves use "
+                     "it regardless)"),
+    # -- elastic training (docs/RESILIENCE.md "Elastic training") ------------
+    "dist_init_retries": (int, 3, ("MXNET_TPU_DIST_INIT_RETRIES",),
+                          "attempts for jax.distributed bootstrap (site "
+                          "dist.init) — a replacement worker joining before "
+                          "the coordinator port is up retries instead of "
+                          "hard-failing"),
+    "dist_init_timeout": (float, 0.0, ("MXNET_TPU_DIST_INIT_TIMEOUT",),
+                          "per-attempt jax.distributed.initialize timeout "
+                          "in seconds (0 = jax default)"),
+    "elastic_hb_interval": (float, 0.5, ("MXNET_TPU_ELASTIC_HB_INTERVAL",),
+                            "seconds between heartbeat-file touches"),
+    "elastic_hb_timeout": (float, 5.0, ("MXNET_TPU_ELASTIC_HB_TIMEOUT",),
+                           "heartbeat staleness after which a peer counts "
+                           "as lost and the worker requests a mesh "
+                           "re-formation"),
     # -- compilation (docs/PERFORMANCE.md) -----------------------------------
     "compile_cache": (str, "", ("MXNET_TPU_COMPILE_CACHE",),
                       "persistent XLA compilation-cache directory "

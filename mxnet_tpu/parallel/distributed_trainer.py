@@ -10,6 +10,7 @@ semantics (scale by local batch; divide lr or not exactly as horovod did).
 """
 from __future__ import annotations
 
+import math
 import os
 from typing import Optional
 
@@ -17,17 +18,37 @@ import jax
 
 from ..gluon.trainer import Trainer
 
-__all__ = ["DistributedTrainer", "init", "rank", "size", "local_rank"]
+__all__ = ["DistributedTrainer", "init", "shutdown", "rank", "size",
+           "local_rank"]
 
 _initialized = False
 
 
+def _already_bootstrapped() -> bool:
+    # is_initialized() only exists in newer jax; older versions expose the
+    # bootstrap state as jax._src.distributed.global_state.client
+    if hasattr(jax.distributed, "is_initialized"):
+        return jax.distributed.is_initialized()
+    from jax._src import distributed as _dist
+
+    return _dist.global_state.client is not None
+
+
 def init(coordinator_address: Optional[str] = None, num_processes: Optional[int] = None,
-         process_id: Optional[int] = None):
+         process_id: Optional[int] = None, timeout: Optional[float] = None,
+         retries: Optional[int] = None):
     """Multi-host bootstrap (replaces tools/launch.py + ps-lite scheduler).
 
     Env-var driven like the DMLC vars: MXNET_TPU_COORDINATOR, MXNET_TPU_NPROC,
     MXNET_TPU_PROCID (or the standard jax coordinator envs on TPU pods).
+
+    The bootstrap is fault site ``dist.init`` and runs under the retry
+    policy (``retries`` attempts, default the ``dist_init_retries`` knob;
+    observable in ``retry_attempts_total{site="dist.init"}``): in an
+    elastic re-formation a replacement worker routinely dials the new
+    coordinator before its port is listening, which must back off and
+    rejoin rather than hard-fail the generation. ``timeout`` bounds each
+    attempt (jax's ``initialization_timeout``, seconds).
     """
     global _initialized
     if _initialized:
@@ -36,15 +57,7 @@ def init(coordinator_address: Optional[str] = None, num_processes: Optional[int]
     if coordinator_address is None:
         _initialized = True  # single process
         return
-    # is_initialized() only exists in newer jax; older versions expose the
-    # bootstrap state as jax._src.distributed.global_state.client
-    if hasattr(jax.distributed, "is_initialized"):
-        already = jax.distributed.is_initialized()
-    else:
-        from jax._src import distributed as _dist
-
-        already = _dist.global_state.client is not None
-    if already:
+    if _already_bootstrapped():
         _initialized = True  # someone (pod runtime, user) already bootstrapped
         return
     plats = (jax.config.jax_platforms or "").split(",")
@@ -57,12 +70,86 @@ def init(coordinator_address: Optional[str] = None, num_processes: Optional[int]
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:
             pass  # older/newer jax without the option: keep prior behavior
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes or int(os.environ.get("MXNET_TPU_NPROC", "1")),
-        process_id=process_id or int(os.environ.get("MXNET_TPU_PROCID", "0")),
-    )
+
+    from .. import config
+    from ..resilience import faults, retry
+
+    timeout = config.get("dist_init_timeout") if timeout is None else timeout
+    kwargs = {}
+    if timeout and timeout > 0:
+        # jax takes whole seconds; a sub-second bound must round UP, not
+        # truncate to an instant-fail 0-second window
+        kwargs["initialization_timeout"] = max(1, math.ceil(timeout))
+
+    # rank 0 may be passed explicitly: `or` would discard it for the (stale)
+    # env var — after a re-formation the two legitimately disagree
+    nproc = num_processes if num_processes is not None \
+        else int(os.environ.get("MXNET_TPU_NPROC", "1"))
+    pid = process_id if process_id is not None \
+        else int(os.environ.get("MXNET_TPU_PROCID", "0"))
+
+    def _bootstrap():
+        faults.fire("dist.init")
+        try:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=nproc, process_id=pid, **kwargs)
+            except TypeError:  # older jax without initialization_timeout
+                if not kwargs:
+                    raise
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=nproc, process_id=pid)
+        except Exception:
+            _clear_half_bootstrap()
+            raise
+
+    policy = retry.RetryPolicy(
+        max_attempts=retries if retries is not None
+        else config.get("dist_init_retries"))
+    retry.retry_call(_bootstrap, site="dist.init", policy=policy)
     _initialized = True
+
+
+def _clear_half_bootstrap() -> None:
+    """Undo a *failed* bootstrap attempt so the next retry can re-dial.
+
+    jax's ``State.initialize`` registers ``global_state.client`` (and rank
+    0's coordinator service) BEFORE ``client.connect()`` — a timed-out dial
+    leaves them set, every later attempt dies on "should only be called
+    once", and ``_already_bootstrapped()`` would report the failure as
+    success. Clear the fields first (so the state is clean even when the
+    handles refuse to shut down), then best-effort release the handles."""
+    try:
+        from jax._src import distributed as _jdist
+
+        state = _jdist.global_state
+        client, state.client = state.client, None
+        service, state.service = state.service, None
+        state.preemption_sync_manager = None
+        for h in (client, service):
+            if h is not None:
+                try:
+                    h.shutdown()
+                except Exception:
+                    pass
+    except Exception:  # jax internals moved: fall back to the public path
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+
+
+def shutdown() -> None:
+    """Tear down the ``jax.distributed`` bootstrap so :func:`init` can
+    re-form against a new coordinator/world (elastic re-formation). No-op
+    when never initialized; single-process "initialized" state is also
+    cleared."""
+    global _initialized
+    if _already_bootstrapped():
+        jax.distributed.shutdown()
+    _initialized = False
 
 
 def rank() -> int:

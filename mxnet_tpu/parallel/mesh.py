@@ -15,7 +15,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["MeshConfig", "make_mesh", "local_mesh"]
+__all__ = ["MeshConfig", "make_mesh", "local_mesh", "refit_config"]
 
 AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
 
@@ -60,3 +60,33 @@ def local_mesh(n: Optional[int] = None, **axis_sizes) -> Mesh:
     devs = jax.devices()[: n or len(jax.devices())]
     cfg = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig(dp=len(devs))
     return make_mesh(cfg, devs)
+
+
+def refit_config(config: MeshConfig, n_devices: int) -> MeshConfig:
+    """Scale a mesh config to a new device count (elastic re-formation).
+
+    The re-formation rule: world-size changes resize the *data* axes only
+    (``dp``/``fsdp`` — state along them is resharded from the checkpoint
+    manifest), while the model axes (``tp``/``sp``/``pp``/``ep``) encode
+    how the network is cut up and must survive unchanged — a world that
+    can't hold them is an error, not a silent re-partition.
+
+    The data capacity goes to ``fsdp`` when the old config sharded state
+    there (keeping the ZeRO layout, at the new width), else to ``dp``.
+    """
+    model = config.tp * config.sp * config.pp * config.ep
+    if n_devices % model != 0:
+        raise ValueError(
+            f"cannot re-form: model axes need multiples of {model} devices "
+            f"(tp={config.tp} sp={config.sp} pp={config.pp} ep={config.ep}), "
+            f"got {n_devices}")
+    data = n_devices // model
+    new = dataclasses.replace(config)
+    if config.fsdp > 1:
+        if config.dp > 1 and data % config.fsdp == 0:
+            new.fsdp, new.dp = config.fsdp, data // config.fsdp
+        else:
+            new.fsdp, new.dp = data, 1
+    else:
+        new.dp, new.fsdp = data, 1
+    return new

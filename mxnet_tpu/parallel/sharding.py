@@ -13,7 +13,8 @@ from typing import Dict, List, Optional, Tuple
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["ShardingRules", "named_sharding", "shard_params", "DEFAULT_BERT_RULES"]
+__all__ = ["ShardingRules", "named_sharding", "shard_params", "reshard_tree",
+           "DEFAULT_BERT_RULES"]
 
 
 class ShardingRules:
@@ -69,6 +70,26 @@ def shard_params(params: Dict[str, jax.Array], mesh: Mesh,
     specs = rules.tree_specs(params, mesh)
     return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
             for k, v in params.items()}
+
+
+def reshard_tree(tree, shardings):
+    """Re-lay-out a restored state tree onto (possibly re-formed) meshes.
+
+    ``shardings`` is a per-top-level-key map (param name ->
+    :class:`NamedSharding`, the TrainStep storage layout); each key's
+    whole subtree (the param itself, or its optimizer-state tuple/dict)
+    lands on that sharding, matching how TrainStep places optimizer state
+    alongside its parameter. Keys without an entry (None map) stay where
+    restore left them. This is the restore half of reshard-on-restore:
+    checkpoints reassemble to host-global arrays at *any* world size, and
+    this puts them back into the current mesh's fsdp layout.
+    """
+    if shardings is None:
+        return tree
+    return {k: jax.tree_util.tree_map(
+        lambda x, _k=k: jax.device_put(x, shardings[_k]), v)
+        if k in shardings else v
+        for k, v in tree.items()}
 
 
 # Megatron-style TP pattern set for the transformer models in models/:

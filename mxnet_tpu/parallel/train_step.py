@@ -939,8 +939,15 @@ class TrainStep:
                               "skipped": jnp.int32(a["skipped"])}
             self._amp_skipped_seen = int(a["skipped"])
         if self.param_sharding is not None:
-            self.params = {k: jax.device_put(v, self.param_sharding[k])
-                           for k, v in self.params.items()}
+            # reshard-on-restore (docs/RESILIENCE.md "Elastic training"):
+            # the checkpoint reassembled to host-global arrays whatever
+            # world wrote it; lay params AND optimizer state back out onto
+            # the CURRENT mesh — after an elastic scale-down/up this is
+            # where the fsdp layout changes width
+            from .sharding import reshard_tree
+
+            self.params = reshard_tree(self.params, self.param_sharding)
+            self.opt_state = reshard_tree(self.opt_state, self.param_sharding)
         self.sync()
         return True
 

@@ -11,21 +11,27 @@ testable on CPU via deterministic fault injection:
   - ``retry``       exponential backoff + jitter around IO/DCN edges
   - ``integrity``   manifests (per-array sha256), atomic commits, retention
   - ``preemption``  SIGTERM/SIGINT -> checkpoint at step boundary -> exit 0
+  - ``elastic``     worker-loss detection + mesh re-formation + elastic
+                    world size (with ``tools/launch.py --elastic``)
 
 See docs/RESILIENCE.md for the operator-facing contract.
 """
 from __future__ import annotations
 
+from . import elastic  # noqa: F401
 from . import faults  # noqa: F401
 from . import integrity  # noqa: F401
 from . import preemption  # noqa: F401
 from . import retry  # noqa: F401
+from .elastic import (ELASTIC_RESTART_EXIT, ElasticContext,  # noqa: F401
+                      HeartbeatMonitor, PeerLost, ReformExit)
 from .faults import InjectedCrash, InjectedFault  # noqa: F401
 from .integrity import CheckpointCorruptError, sweep_retention  # noqa: F401
 from .preemption import Preempted, PreemptionGuard  # noqa: F401
 from .retry import RetryError, RetryPolicy, retry_call  # noqa: F401
 
-__all__ = ["faults", "retry", "integrity", "preemption",
+__all__ = ["faults", "retry", "integrity", "preemption", "elastic",
            "InjectedFault", "InjectedCrash", "CheckpointCorruptError",
            "Preempted", "PreemptionGuard", "RetryError", "RetryPolicy",
-           "retry_call", "sweep_retention"]
+           "retry_call", "sweep_retention", "ELASTIC_RESTART_EXIT",
+           "ElasticContext", "HeartbeatMonitor", "PeerLost", "ReformExit"]

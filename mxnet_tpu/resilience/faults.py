@@ -28,6 +28,14 @@ Known sites (see docs/RESILIENCE.md):
   ``kv.dcn_psum_batch``   the batched (one-transfer) all-reduce
   ``kv.save_states``      ``KVStore.save_optimizer_states`` pre-commit
   ``data.batch``          one DataLoader batch fetch/batchify
+  ``dist.init``           ``jax.distributed`` bootstrap (``dist_init``) —
+                          a replacement worker dialing the coordinator
+                          before its port is up; absorbed by the retry
+                          policy around the bootstrap
+  ``dist.heartbeat``      ``HeartbeatMonitor.check`` — a failed/partitioned
+                          liveness probe; surfaces as ``PeerLost`` and
+                          drives a mesh re-formation with no real dead
+                          process
   ======================  ====================================================
 
 Env grammar (entries separated by ``;``, options by ``:``)::

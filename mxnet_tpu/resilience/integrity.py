@@ -39,7 +39,14 @@ _CKPT_RE = re.compile(r"ckpt-(\d+)")
 
 
 class CheckpointCorruptError(IOError):
-    """A checkpoint failed manifest validation; carries the mismatches."""
+    """A checkpoint failed manifest validation; carries the mismatches.
+
+    ``retryable = False``: corruption is deterministic — re-reading the
+    same bytes cannot heal it, so ``retry_call`` re-raises it unwrapped
+    instead of burning the backoff budget and surfacing a ``RetryError``.
+    """
+
+    retryable = False
 
     def __init__(self, path: str, problems: List[str]):
         super().__init__(f"corrupt checkpoint {path}: " + "; ".join(problems))
@@ -62,8 +69,15 @@ def file_digest(path: str) -> str:
 
 
 def build_manifest(flat_arrays, fmt: str, dirpath: str,
-                   files: Optional[List[str]] = None) -> dict:
-    """Manifest dict for the flat leaf list + the named payload files."""
+                   files: Optional[List[str]] = None,
+                   specs: Optional[List] = None) -> dict:
+    """Manifest dict for the flat leaf list + the named payload files.
+
+    ``specs`` (parallel to ``flat_arrays``) records each array's partition
+    spec; with the shape (global) already here, any world size can
+    reassemble and re-lay-out the state — the manifest is the
+    world-size-agnostic description the elastic restore path consumes.
+    """
     manifest: dict = {"format": fmt, "files": {}, "arrays": {}}
     for name in files or ():
         p = os.path.join(dirpath, name)
@@ -75,6 +89,7 @@ def build_manifest(flat_arrays, fmt: str, dirpath: str,
             "sha256": array_digest(host),
             "shape": list(host.shape),
             "dtype": str(host.dtype),
+            "spec": specs[i] if specs is not None else None,
         }
     return manifest
 

@@ -130,7 +130,10 @@ def retry_call(fn: Callable, site: str, policy: Optional[RetryPolicy] = None):
     ``BaseException``s that are not ``Exception``s — KeyboardInterrupt,
     SystemExit, and the fault injector's :class:`~.faults.InjectedCrash` —
     pass straight through: a simulated (or real) process death must not be
-    "absorbed" into a successful-looking retry.
+    "absorbed" into a successful-looking retry. Exceptions whose class sets
+    ``retryable = False`` (e.g. :class:`~.integrity.CheckpointCorruptError`
+    — corruption is deterministic, a second read returns the same bytes)
+    are recorded as a failed attempt and re-raised unwrapped immediately.
     """
     policy = policy or RetryPolicy()
     start = time.monotonic()
@@ -143,6 +146,10 @@ def retry_call(fn: Callable, site: str, policy: Optional[RetryPolicy] = None):
                    "error": f"{type(e).__name__}: {e}", "delay": None}
             attempts.append(rec)
             _record(site, rec)
+            if not getattr(e, "retryable", True):
+                logger.error("non-retryable failure: site=%s error=%s",
+                             site, rec["error"])
+                raise
             out_of_budget = policy.timeout > 0 and \
                 (time.monotonic() - start) >= policy.timeout
             if attempt >= policy.max_attempts or out_of_budget:

@@ -128,6 +128,233 @@ _CHILD4 = textwrap.dedent("""
 """)
 
 
+# Elastic chaos drill child (docs/RESILIENCE.md "Elastic training"): a
+# deterministic fsdp-sharded Adam run whose batches depend only on the step
+# number, so a re-formed generation replays the exact trajectory from its
+# restore point. Gen 0 SIGKILLs DRILL_KILL_RANK at DRILL_KILL_STEP.
+_ELASTIC_CHILD = textwrap.dedent("""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import json
+    import signal
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from mxnet_tpu.parallel import dist_init
+    dist_init()
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, observability as obs, optimizer
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import (MeshConfig, ShardingRules, TrainStep,
+                                    make_mesh)
+    from mxnet_tpu.resilience import elastic
+
+    rank = jax.process_index()
+    world = jax.process_count()
+
+    CKPT = os.environ["DRILL_CKPT"]
+    OUT = os.environ["DRILL_OUT"]
+    LOSSES = os.environ["DRILL_LOSSES"]
+    TOTAL = int(os.environ.get("DRILL_STEPS", "12"))
+    SAVE_EVERY = int(os.environ.get("DRILL_SAVE_EVERY", "3"))
+    KILL_RANK = int(os.environ.get("DRILL_KILL_RANK", "-1"))
+    KILL_STEP = int(os.environ.get("DRILL_KILL_STEP", "-1"))
+
+    ctx = elastic.context()
+    gen = ctx.generation if ctx else 0
+    obs.enable(os.path.join(os.environ["DRILL_OBS"], f"g{gen}-r{rank}"))
+    if ctx:
+        ctx.start()
+        ctx.install_preemption()
+
+    # deterministic model: same init whatever the generation or world size
+    mx.random.seed(11)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(24, in_units=12, activation="relu"),
+            nn.Dense(12, in_units=24))
+    net.initialize()
+    _ = net(nd.ones((2, 12)))
+
+    mesh = make_mesh(MeshConfig(fsdp=world))
+    rules = ShardingRules(fsdp_axis="fsdp", min_fsdp_size=1)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    ts = TrainStep(net, lambda o, y: loss_fn(o, y),
+                   optimizer.Adam(learning_rate=1e-2), mesh=mesh,
+                   rules=rules)
+
+
+    def batch(step):
+        rng = np.random.RandomState(1000 + step)
+        x = rng.randn(12, 12).astype(np.float32)
+        y = rng.randint(0, 12, size=(12,)).astype(np.float32)
+        return nd.array(x), nd.array(y)
+
+
+    def _restore():
+        ts.restore(CKPT)
+        return int(ts.optimizer.num_update)
+
+
+    if ctx is not None and gen > 0:
+        start = ctx.resume(_restore)  # times + announces elastic_restore
+    else:
+        ts.restore(CKPT)
+        start = int(ts.optimizer.num_update)
+
+    for step in range(start + 1, TOTAL + 1):
+        if gen == 0 and rank == KILL_RANK and step == KILL_STEP:
+            os.kill(os.getpid(), signal.SIGKILL)
+        x, y = batch(step)
+        try:
+            loss = ts(x, y)
+            lval = float(np.asarray(loss))
+            if step % SAVE_EVERY == 0:
+                ts.save(CKPT)
+        except SystemExit:
+            raise
+        except Exception as e:  # peer died mid-collective: ask to re-form
+            if ctx is not None:
+                elastic.exit_for_reform(f"step_error:{type(e).__name__}")
+            raise
+
+        if rank == 0:
+            with open(LOSSES, "a") as f:
+                f.write(json.dumps({"step": step, "loss": lval, "gen": gen,
+                                    "world": world}) + "\\n")
+        if ctx is not None:
+            ctx.check()  # peer loss / preemption -> ReformExit(75)
+
+    from jax.experimental import multihost_utils
+
+    # collective: every rank participates in the gather; rank 0 writes
+    params = {k: multihost_utils.process_allgather(v, tiled=True).tolist()
+              for k, v in sorted(ts.params.items())}
+    if rank == 0:
+        reformations = 0.0
+        if ctx is not None and gen > 0:
+            reformations = obs.REGISTRY.get(
+                "mesh_reformations_total").value(
+                    cause=ctx.cause or "unknown")
+        with open(OUT, "w") as f:
+            json.dump({"gen": gen, "world": world,
+                       "num_update": int(ts.optimizer.num_update),
+                       "params": params, "reformations": reformations}, f)
+    print(f"DRILL-RANK{rank}-DONE gen={gen} world={world}", flush=True)
+""")
+
+
+def _run_drill(tmp, name, elastic_args=(), kill_rank=-1, kill_step=-1):
+    """One supervised drill run; returns (result, out.json dict, losses)."""
+    import json
+
+    d = tmp / name
+    d.mkdir(parents=True, exist_ok=True)
+    child = d / "child.py"
+    child.write_text(_ELASTIC_CHILD)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root
+    env.update({
+        "DRILL_CKPT": str(d / "ckpt"), "DRILL_OUT": str(d / "out.json"),
+        "DRILL_LOSSES": str(d / "losses.jsonl"), "DRILL_OBS": str(d / "obs"),
+        "DRILL_KILL_RANK": str(kill_rank), "DRILL_KILL_STEP": str(kill_step),
+        # the world-size-agnostic manifest format + a fast failover window
+        "MXNET_TPU_CKPT_SHARDED": "1", "MXNET_TPU_ELASTIC_HB_TIMEOUT": "3",
+    })
+    res = subprocess.run(
+        [sys.executable, "tools/launch.py", "-n", "4", *elastic_args,
+         sys.executable, str(child)],
+        capture_output=True, text=True, timeout=280, env=env, cwd=repo_root)
+    out = losses = None
+    if (d / "out.json").exists():
+        out = json.loads((d / "out.json").read_text())
+    if (d / "losses.jsonl").exists():
+        losses = {}
+        for line in (d / "losses.jsonl").read_text().splitlines():
+            r = json.loads(line)
+            losses[r["step"]] = r["loss"]  # replayed steps: last write wins
+    return res, out, losses
+
+
+@pytest.fixture(scope="module")
+def _elastic_baseline(tmp_path_factory):
+    """The never-killed 4-process run every drill compares against."""
+    res, out, losses = _run_drill(
+        tmp_path_factory.mktemp("elastic"), "base")
+    assert res.returncode == 0, (res.stdout + res.stderr)[-3000:]
+    assert out is not None and losses is not None
+    return out, losses
+
+
+@pytest.mark.timeout(600)
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("policy,expect_world", [("replace", 4),
+                                                 ("shrink", 3)])
+def test_chaos_elastic_kill_worker(tmp_path, _elastic_baseline, policy,
+                                   expect_world):
+    """`make chaos-elastic` (ISSUE 7 acceptance): SIGKILL rank 2 at step 7
+    of 12; the supervisor re-forms the mesh (1:1 replacement, and scaled
+    down to 3 under the shrink policy), the job resumes from ckpt-6 and
+    finishes — with final params matching the never-killed baseline
+    (replace: bit-identical — same world, same deterministic replay;
+    shrink: 1e-5, the fsdp reduction order changes at world 3), the loss
+    trajectory checkpoint-consistent, mesh_reformations_total >= 1, and an
+    elastic_restore event carrying cause + old/new world size."""
+    import json
+
+    import numpy as np
+
+    base_out, base_losses = _elastic_baseline
+    res, out, losses = _run_drill(
+        tmp_path, policy,
+        elastic_args=("--elastic", "--elastic-policy", policy,
+                      "--max-restarts", "2", "--grace", "3"),
+        kill_rank=2, kill_step=7)
+    tail = (res.stdout + res.stderr)[-3000:]
+    assert res.returncode == 0, tail
+    assert "[elastic] job complete" in res.stderr, tail
+    assert out is not None, tail
+
+    # the job finished on a re-formed mesh at the policy's world size
+    assert out["gen"] == 1 and out["world"] == expect_world, out
+    assert out["num_update"] == 12, out
+    assert out["reformations"] >= 1  # mesh_reformations_total, gen-1 rank 0
+
+    # final params vs the never-killed run's trajectory
+    atol = 0.0 if policy == "replace" else 1e-5
+    for k in base_out["params"]:
+        np.testing.assert_allclose(
+            np.array(out["params"][k]), np.array(base_out["params"][k]),
+            atol=atol, rtol=0, err_msg=k)
+    # per-step losses (replayed steps overwrote gen-0's rows): the resumed
+    # trajectory is the checkpoint-consistent one
+    assert set(losses) == set(base_losses)
+    for step, want in base_losses.items():
+        assert abs(losses[step] - want) <= (0.0 if policy == "replace"
+                                            else 1e-5), step
+
+    # the elastic_restore event: cause + old/new world (acceptance contract)
+    evdir = tmp_path / policy / "obs" / "g1-r0"
+    events = [json.loads(line)
+              for f in sorted(evdir.glob("events*.jsonl"))
+              for line in f.read_text().splitlines()]
+    restore = [e for e in events if e["event"] == "elastic_restore"]
+    reform = [e for e in events if e["event"] == "mesh_reformation"]
+    assert len(restore) == 1 and len(reform) == 1, events
+    for e in restore + reform:
+        assert e["cause"] == "worker_killed:sig9"
+        assert (e["old_world"], e["new_world"]) == (4, expect_world)
+    assert restore[0]["ckpt_step"] == 6  # killed at 7, saved every 3
+
+
 @pytest.mark.timeout(300)
 @pytest.mark.slow
 def test_four_process_dist_matrix(tmp_path):

@@ -10,14 +10,33 @@ is one jax.distributed participant; the coordinator is worker 0. Same UX::
 Local mode forks N processes on this host (the reference's ``--launcher
 local`` CI topology, SURVEY §4 fixture #5); ssh mode prints per-host
 commands (zero-egress environments can't ssh out, so it stops at the plan).
+
+Elastic mode (``--elastic``, docs/RESILIENCE.md "Elastic training") wraps
+local mode in a *supervising* loop: when a worker dies (crash, SIGKILL,
+preemption) or exits with the re-formation code (75, EX_TEMPFAIL — see
+``mxnet_tpu.resilience.elastic``), the supervisor tears the surviving
+generation down, picks the next world size (1:1 replacement, or scale-down
+under ``--elastic-policy shrink``), and respawns every rank against a fresh
+coordinator address with an incremented generation — the job resumes from
+its latest valid checkpoint without ever leaving this process tree. The
+restart budget (``--max-restarts``) bounds how many re-formations a job may
+spend before the supervisor gives up and propagates the failure.
 """
 from __future__ import annotations
 
 import argparse
 import os
+import shutil
 import socket
 import subprocess
 import sys
+import tempfile
+import time
+
+#: exit code a worker uses to request a mesh re-formation (kept in sync
+#: with mxnet_tpu.resilience.elastic.ELASTIC_RESTART_EXIT without importing
+#: the package — the launcher must run from a bare checkout/venv)
+ELASTIC_RESTART_EXIT = 75
 
 
 def free_port() -> int:
@@ -28,29 +47,197 @@ def free_port() -> int:
     return port
 
 
-def launch_local(n: int, command: list[str]) -> int:
+def _worker_env(rank: int, n: int, coord: str, extra=None) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "MXNET_TPU_COORDINATOR": coord,
+        "MXNET_TPU_NPROC": str(n),
+        "MXNET_TPU_PROCID": str(rank),
+        # all-local launch: local_rank == rank, local_size == n
+        "MXNET_TPU_LOCAL_RANK": str(rank),
+        "MXNET_TPU_LOCAL_SIZE": str(n),
+        # reference-compat aliases so DMLC-era scripts keep working
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": str(n),
+        "DMLC_WORKER_ID": str(rank),
+    })
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _terminate(procs, grace: float = 5.0) -> None:
+    """Stop every still-running worker: SIGTERM, a grace window (their
+    preemption guards may want to flush), then SIGKILL the stragglers."""
+    alive = [p for p in procs if p.poll() is None]
+    for p in alive:
+        try:
+            p.terminate()
+        except OSError:
+            pass
+    deadline = time.time() + grace
+    for p in alive:
+        try:
+            p.wait(timeout=max(0.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            try:
+                p.kill()
+                p.wait()
+            except OSError:
+                pass
+
+
+def launch_local(n: int, command: list[str], env_extra=None,
+                 grace: float = 5.0) -> int:
+    """One generation of n local workers; returns the job's exit code.
+
+    Peer cleanup: ranks blocked in a collective against a dead peer never
+    return, so the first *non-zero* exit terminates the survivors
+    (SIGTERM -> grace -> SIGKILL) and that first bad code is propagated —
+    instead of hanging until the caller's timeout. Ranks that finish with
+    0 are left to drain normally.
+    """
     port = free_port()
     coord = f"127.0.0.1:{port}"
-    procs = []
-    for rank in range(n):
-        env = dict(os.environ)
-        env.update({
-            "MXNET_TPU_COORDINATOR": coord,
-            "MXNET_TPU_NPROC": str(n),
-            "MXNET_TPU_PROCID": str(rank),
-            # all-local launch: local_rank == rank, local_size == n
-            "MXNET_TPU_LOCAL_RANK": str(rank),
-            "MXNET_TPU_LOCAL_SIZE": str(n),
-            # reference-compat aliases so DMLC-era scripts keep working
-            "DMLC_ROLE": "worker",
-            "DMLC_NUM_WORKER": str(n),
-            "DMLC_WORKER_ID": str(rank),
-        })
-        procs.append(subprocess.Popen(command, env=env))
-    code = 0
-    for p in procs:
-        code = p.wait() or code
-    return code
+    procs = [subprocess.Popen(command, env=_worker_env(r, n, coord, env_extra))
+             for r in range(n)]
+    first_bad = 0
+    while True:
+        codes = [p.poll() for p in procs]
+        bad = [c for c in codes if c not in (None, 0)]
+        if bad and not first_bad:
+            first_bad = bad[0]
+            sys.stderr.write(f"[launch] worker exited {first_bad}; "
+                             "terminating peers\n")
+            _terminate(procs, grace)
+        if all(c is not None for c in codes):
+            return _shell_code(first_bad) if first_bad else 0
+        time.sleep(0.1)
+
+
+def _shell_code(code: int) -> int:
+    """A Popen returncode as a shell-visible exit status: signal deaths are
+    negative and sys.exit would truncate them mod 256 (-9 -> 247); the
+    shell convention 128+signum survives the round trip."""
+    return 128 - code if code < 0 else code
+
+
+class ElasticSupervisor:
+    """Process-lifecycle half of elastic training (the worker half lives in
+    ``mxnet_tpu.resilience.elastic``): restart crashed ranks on a re-formed
+    mesh under a bounded restart budget.
+
+    Each *generation* g gets a fresh coordinator port (the old coordinator
+    died with rank 0 — reassigning the address is what lets a replacement
+    world bootstrap at all) and its own heartbeat directory
+    ``{hb_base}/gen-{g}`` (a dead generation's stale beat files must not
+    count against the new one). The environment exported to workers is the
+    :func:`mxnet_tpu.resilience.elastic.context` contract:
+    ``MXNET_TPU_ELASTIC/GENERATION/ELASTIC_CAUSE/PREV_WORLD/HEARTBEAT_DIR``.
+
+    World-size policy on a re-formation:
+
+      - ``replace`` (default): respawn at the same world size — the lost
+        rank is 1:1 replaced;
+      - ``shrink``: drop the ranks that *died* (exit 75 re-formation
+        requests don't shrink — those workers are healthy) down to
+        ``min_workers``; the job continues on the smaller mesh, resharding
+        fsdp state from the checkpoint manifest on restore. Scaling back
+        *up* is a new launch at the larger ``-n`` — same manifest, same
+        restore path, opposite direction.
+    """
+
+    def __init__(self, n: int, command: list[str], max_restarts: int = 3,
+                 policy: str = "replace", min_workers: int = 1,
+                 grace: float = 5.0, hb_dir: str | None = None,
+                 poll_interval: float = 0.2):
+        self.world = n
+        self.command = command
+        self.max_restarts = max_restarts
+        self.policy = policy
+        self.min_workers = max(1, min_workers)
+        self.grace = grace
+        self.poll_interval = poll_interval
+        self._own_hb = hb_dir is None
+        self.hb_base = hb_dir or tempfile.mkdtemp(prefix="mxtpu-elastic-hb-")
+        self.generation = 0
+        self.reformations = 0
+
+    def _spawn(self, cause: str, prev_world: int):
+        port = free_port()
+        coord = f"127.0.0.1:{port}"
+        gen_hb = os.path.join(self.hb_base, f"gen-{self.generation}")
+        os.makedirs(gen_hb, exist_ok=True)
+        extra = {
+            "MXNET_TPU_ELASTIC": "1",
+            "MXNET_TPU_GENERATION": str(self.generation),
+            "MXNET_TPU_ELASTIC_CAUSE": cause,
+            "MXNET_TPU_PREV_WORLD": str(prev_world),
+            "MXNET_TPU_HEARTBEAT_DIR": gen_hb,
+        }
+        sys.stderr.write(
+            f"[elastic] generation {self.generation}: world={self.world} "
+            f"coord={coord}" + (f" cause={cause}" if cause else "") + "\n")
+        return [subprocess.Popen(
+            self.command, env=_worker_env(r, self.world, coord, extra))
+            for r in range(self.world)]
+
+    @staticmethod
+    def _classify(code: int) -> str:
+        if code == ELASTIC_RESTART_EXIT:
+            return "reform_requested"
+        if code < 0:
+            return f"worker_killed:sig{-code}"
+        return f"worker_died:exit{code}"
+
+    def _next_world(self, n_died: int) -> int:
+        if self.policy == "shrink" and n_died > 0:
+            return max(self.min_workers, self.world - n_died)
+        return self.world
+
+    def run(self) -> int:
+        try:
+            return self._run()
+        finally:
+            if self._own_hb:
+                shutil.rmtree(self.hb_base, ignore_errors=True)
+
+    def _run(self) -> int:
+        procs = self._spawn(cause="", prev_world=self.world)
+        while True:
+            codes = [p.poll() for p in procs]
+            bad = [c for c in codes if c not in (None, 0)]
+            if not bad:
+                if all(c == 0 for c in codes):
+                    sys.stderr.write(
+                        f"[elastic] job complete: world={self.world} "
+                        f"generations={self.generation + 1} "
+                        f"reformations={self.reformations}\n")
+                    return 0
+                time.sleep(self.poll_interval)
+                continue
+            # a generation is over the moment one worker is gone: survivors
+            # would only hang in collectives against the dead rank. A real
+            # death outranks a concurrent exit-75 request for the cause
+            # label — a survivor's "peer lost" exit must not mask WHY
+            hard = [c for c in bad if c != ELASTIC_RESTART_EXIT]
+            cause = self._classify(hard[0] if hard else bad[0])
+            sys.stderr.write(f"[elastic] generation {self.generation} lost "
+                             f"{len(bad)} worker(s): {cause}\n")
+            _terminate(procs, self.grace)
+            if self.reformations >= self.max_restarts:
+                sys.stderr.write(f"[elastic] restart budget exhausted "
+                                 f"({self.max_restarts}); giving up\n")
+                return _shell_code(hard[0] if hard else bad[0])
+            # settle: collect post-terminate exit codes to count the dead
+            # (terminated survivors exit non-zero too — only the codes seen
+            # BEFORE teardown count as died)
+            n_died = len(hard)
+            prev_world = self.world
+            self.world = self._next_world(n_died)
+            self.generation += 1
+            self.reformations += 1
+            procs = self._spawn(cause=cause, prev_world=prev_world)
 
 
 def main():
@@ -61,12 +248,36 @@ def main():
                          "role (state is sharded with workers)")
     ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
     ap.add_argument("-H", "--hostfile", default=None)
+    ap.add_argument("--elastic", action="store_true",
+                    help="supervise workers: re-form the mesh on worker "
+                         "loss instead of failing the job")
+    ap.add_argument("--max-restarts", type=int, default=3,
+                    help="elastic restart budget: mesh re-formations before "
+                         "the supervisor gives up")
+    ap.add_argument("--elastic-policy", choices=["replace", "shrink"],
+                    default="replace",
+                    help="replace: respawn at the same world size; shrink: "
+                         "continue on a smaller mesh without the dead ranks")
+    ap.add_argument("--min-workers", type=int, default=1,
+                    help="floor for --elastic-policy shrink")
+    ap.add_argument("--grace", type=float, default=5.0,
+                    help="seconds between SIGTERM and SIGKILL at teardown")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
     if args.launcher == "local":
-        sys.exit(launch_local(args.num_workers, args.command))
+        if args.elastic:
+            sup = ElasticSupervisor(
+                args.num_workers, args.command,
+                max_restarts=args.max_restarts, policy=args.elastic_policy,
+                min_workers=args.min_workers, grace=args.grace)
+            sys.exit(sup.run())
+        sys.exit(launch_local(args.num_workers, args.command,
+                              grace=args.grace))
+    if args.elastic:
+        ap.error("--elastic requires --launcher local (the supervisor owns "
+                 "the worker process tree)")
     # ssh plan (zero-egress: print what would run per host)
     hosts = open(args.hostfile).read().split() if args.hostfile else ["host%d" % i for i in range(args.num_workers)]
     port = free_port()
