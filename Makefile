@@ -9,10 +9,16 @@
 #   audit   - structural HLO audit (tools/audit.py): zero f64 in bf16
 #             programs, 100% donation coverage on the TrainStep and
 #             decode-cache carries, shape recompiles logged with a cause
+#   shardcheck - golden-program sharding + communication gate
+#             (tools/shardcheck.py): contract violations, accidental
+#             reshards, new collective kinds, comm-byte regressions and
+#             fingerprint drift vs mxnet_tpu/analysis/goldens/
 #   native  - build libmxtpu.so (C++ runtime: recordio/jpeg/runtime/c_api)
 #   fast    - pytest without @slow (target < 10 min on 8 virtual CPU devs)
 #   slow    - the @slow remainder (model compiles, 4-process launches)
-#   ci      - sanity + lint + native + fast + audit (the pre-merge gate)
+#   ci      - sanity + lint + native + fast + audit + shardcheck +
+#             chaos-elastic (the pre-merge gate; chaos-elastic is the
+#             slow 4-process kill-a-worker drill)
 #   test    - full suite (ci + slow), what the driver effectively runs
 
 PY ?= python
@@ -23,9 +29,9 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity lint audit native fast slow test chaos chaos-elastic obs perfwin genbench ampbench bench clean
+.PHONY: ci sanity lint audit shardcheck native fast slow test chaos chaos-elastic obs perfwin genbench ampbench bench clean
 
-ci: sanity lint native fast audit chaos-elastic
+ci: sanity lint native fast audit shardcheck chaos-elastic
 
 sanity:
 	$(PY) -m compileall -q mxnet_tpu tools tests examples bench.py __graft_entry__.py
@@ -40,6 +46,16 @@ lint:
 # and explained recompile causes
 audit:
 	$(PY) tools/audit.py
+
+# golden-program sharding + communication gate (docs/ANALYSIS.md): lowers
+# the representative program families on 8 virtual CPU devices, runs the
+# sharding contract checker + the comm cost model, and diffs against the
+# committed goldens — contract violations, accidental reshards, new
+# collective kinds, comm-byte regressions > tolerance, donation drops and
+# fingerprint drift all fail; rebless intentional changes with
+# `python tools/shardcheck.py --update-golden`
+shardcheck:
+	$(PY) tools/shardcheck.py
 
 native:
 	$(MAKE) -C native

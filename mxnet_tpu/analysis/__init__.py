@@ -24,10 +24,24 @@ from .hlo_audit import (  # noqa: F401
     ProgramAudit,
     ProgramReport,
     RecompileGuard,
+    ShardingInfo,
     audit_compiled,
     audit_lowered,
     audit_text,
     fingerprint_diff,
+    parse_sharding,
+)
+from .comm import (  # noqa: F401
+    CollectiveCost,
+    CommReport,
+    Reshard,
+    comm_report,
+    detect_accidental_reshards,
+)
+from .contract import (  # noqa: F401
+    ContractViolation,
+    check_contract,
+    expected_tiles,
 )
 from .astlint import (  # noqa: F401
     LintRule,
@@ -42,6 +56,10 @@ __all__ = [
     "Op", "Collective", "DonationReport", "ProgramReport", "ProgramAudit",
     "audit_text", "audit_lowered", "audit_compiled",
     "Fingerprint", "fingerprint_diff", "RecompileGuard",
+    "ShardingInfo", "parse_sharding",
+    "CollectiveCost", "CommReport", "Reshard", "comm_report",
+    "detect_accidental_reshards",
+    "ContractViolation", "check_contract", "expected_tiles",
     "LintRule", "Violation", "lint_source", "lint_file", "lint_paths",
     "list_rules",
 ]

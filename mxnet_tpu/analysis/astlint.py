@@ -24,6 +24,11 @@ Rules (docs/ANALYSIS.md has the full catalog with examples):
   JH004 mutable-default-arg     ``def f(x=[], y={}, z=set())``.
   JH005 unlocked-global-mutation  mutating a module-global dict/list/set
                                 outside any ``with <lock>:`` block.
+  JH006 unknown-mesh-axis       a ``PartitionSpec``/``P``/``named_sharding``
+                                call site passing an axis-name string
+                                literal outside the MeshConfig vocabulary
+                                (dp/fsdp/tp/sp/pp/ep) — GSPMD silently
+                                replicates the tensor on a typo'd axis.
 
 **Hot paths** are found two ways: structurally — any function passed to
 (or decorated with) ``jax.jit``/``pmap``/``checkpoint``/``shard_map``,
@@ -63,7 +68,20 @@ RULES: Dict[str, str] = {
     "JH004": "mutable-default-arg: shared mutable state across calls",
     "JH005": "unlocked-global-mutation: module-global registry mutated "
              "outside a lock (loader/dispatch threads also import/mutate)",
+    "JH006": "unknown-mesh-axis: PartitionSpec/named_sharding axis-name "
+             "literal not in the MeshConfig vocabulary (dp/fsdp/tp/sp/pp/"
+             "ep) — a typo'd axis name silently replicates the tensor",
 }
+
+#: the MeshConfig axis vocabulary (mirror of parallel.mesh.AXES — kept
+#: literal so the linter stays stdlib-only; tests/test_analysis.py pins
+#: the two in sync)
+_MESH_AXES = frozenset({"dp", "fsdp", "tp", "sp", "pp", "ep"})
+
+# JH006: call names that take PartitionSpec axis-name strings. `P` is the
+# conventional PartitionSpec alias throughout the codebase; NamedSharding
+# literals reach here via the nested P(...) call.
+_SPEC_CALLS = frozenset({"PartitionSpec", "P", "named_sharding"})
 
 #: helpers reached by tracing but not lexically inside a jitted closure —
 #: registered hot paths, keyed by a path suffix. Extend when adding a new
@@ -401,6 +419,19 @@ class _Linter(ast.NodeVisitor):
         # assignment RHS (`h = _REG.setdefault(k, [])`), return value —
         # the mutation happens regardless of what the result feeds
         self._visit_mutating_call(node)
+        # JH006: axis-name literals at PartitionSpec construction sites
+        if leaf in _SPEC_CALLS:
+            args = node.args
+            if leaf == "named_sharding" and args:
+                args = args[1:]  # named_sharding(mesh, *spec)
+            for a in args:
+                for lit in self._axis_literals(a):
+                    if lit.value not in _MESH_AXES:
+                        self.report(
+                            "JH006", lit,
+                            f"axis name {lit.value!r} is not a MeshConfig "
+                            "axis (dp/fsdp/tp/sp/pp/ep) — GSPMD silently "
+                            "replicates on an unknown axis")
         if self.in_hot or self.is_op_module:
             if dotted.startswith("time.") and leaf in _TIME_FNS:
                 self.report("JH003", node,
@@ -419,6 +450,22 @@ class _Linter(ast.NodeVisitor):
                             f"stdlib {dotted}() global RNG in op/compiled "
                             "code")
         self.generic_visit(node)
+
+    @staticmethod
+    def _axis_literals(arg: ast.AST) -> List[ast.Constant]:
+        """String-literal axis names in one PartitionSpec argument: a bare
+        string, or strings inside a tuple/list entry (``P(("dp",
+        "fsdp"))``). Non-literals (variables, ``*spec`` splats) are the
+        caller's responsibility — only what is visibly a literal is
+        checked."""
+        out: List[ast.Constant] = []
+        nodes = [arg]
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            nodes = list(arg.elts)
+        for n in nodes:
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                out.append(n)
+        return out
 
     def _mentions_traced(self, expr: ast.AST) -> Optional[str]:
         for n in ast.walk(expr):

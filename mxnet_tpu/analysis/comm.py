@@ -1,0 +1,286 @@
+"""Communication cost model over a program's collectives.
+
+The HLO auditor inventories *which* collectives a compiled program runs;
+this module prices them. Each collective gets a logical byte cost from its
+payload size and replica-group span, attributed to the mesh axes the
+group actually crosses — so "the dp gradient all-reduce moves
+2 x param-bytes over the dp axis and nothing else" is a structural
+assertion, and a mis-specified sharding that turns a reduce-scatter
+pattern into replicated all-gathers (arXiv:2004.13336's failure mode)
+shows up as a byte regression on the wrong axis.
+
+Cost convention (documented, deliberately simple — logical bytes of the
+bandwidth-optimal algorithm, not a hardware model):
+
+  =====================  =================================================
+  all_reduce             2 x full tensor bytes (reduce-scatter + all-gather
+                         halves of the ring)
+  all_gather             1 x full tensor bytes (operand shard x group span)
+  reduce_scatter         1 x full tensor bytes (the pre-scatter input)
+  all_to_all             1 x tensor bytes
+  collective_permute     1 x tensor bytes (one ICI hop per pair)
+  collective_broadcast   1 x tensor bytes
+  =====================  =================================================
+
+Async start/done pairs were already collapsed to ONE collective by the
+parser, so nothing here double-counts. Collectives inside a ``lax.scan``
+body (the fused k-step window) appear once in the program text and are
+counted once — the report is a static per-dispatch census, not a trace.
+
+Axis attribution maps each normalized replica group onto the mesh: the
+axes whose coordinates vary inside a group are the axes the collective
+spans. Groups that cannot be resolved (no mesh, ``source_target_pairs``
+collectives, exotic iota forms) land under the ``"?"`` axis key with their
+bytes intact — unattributed traffic is still traffic.
+
+Also here: the accidental-reshard detector. An ``all_gather`` whose full
+result exactly matches the global shape of a tensor the sharding rules
+*declared* sharded — and that is not on the intended gather list (the
+ZeRO compute-spec params TrainStep gathers on purpose) — means GSPMD is
+silently materializing the tensor every step.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter as _Counter
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .hlo_audit import Collective, ProgramReport
+
+__all__ = ["CollectiveCost", "CommReport", "Reshard", "comm_report",
+           "detect_accidental_reshards", "DTYPE_BYTES"]
+
+#: element width in bytes per HLO dtype token (pred stored as one byte)
+DTYPE_BYTES: Dict[str, int] = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+    "s32": 4, "u32": 4, "s64": 8, "u64": 8, "f8e4m3fn": 1, "f8e5m2": 1,
+    "bf16": 2, "f16": 2, "f32": 4, "f64": 8, "c64": 8, "c128": 16,
+}
+
+# byte multiplier per collective kind (see module docstring table)
+_KIND_FACTOR = {
+    "all_reduce": 2, "all_gather": 1, "reduce_scatter": 1, "all_to_all": 1,
+    "collective_permute": 1, "collective_broadcast": 1,
+}
+
+
+def _elems(shape: Tuple[int, ...]) -> int:
+    n = 1
+    for d in shape:
+        n *= d
+    return n
+
+
+def _tensors_bytes(info: Sequence[Tuple[str, Tuple[int, ...]]]) -> int:
+    return sum(_elems(sh) * DTYPE_BYTES.get(dt, 4) for dt, sh in info)
+
+
+def _payload_bytes(c: Collective) -> int:
+    """Full-tensor logical payload of one collective, before the per-kind
+    factor. Operand-side sizing survives both the sync and the
+    tuple-result async-start spellings (a start op's operands are exactly
+    the payloads; its result tuple carries bookkeeping scalars)."""
+    opd = _tensors_bytes(c.operand_info)
+    if c.name == "all_gather":
+        # operand is the shard; the full tensor is shard x group span
+        if opd and c.group_size:
+            return opd * c.group_size
+        # fall back to the largest result tensor (the gathered output)
+        if c.result_info:
+            return max(_elems(sh) * DTYPE_BYTES.get(dt, 4)
+                       for dt, sh in c.result_info)
+        return opd
+    if c.name == "reduce_scatter" and opd == 0 and c.result_info \
+            and c.group_size:
+        return _tensors_bytes(c.result_info) * c.group_size
+    if opd:
+        return opd
+    # no operand info (best-effort MLIR region ops): result side, else the
+    # op's own shape/dtype
+    if c.result_info:
+        return _tensors_bytes(c.result_info)
+    if c.dtype is not None:
+        return _elems(c.shape) * DTYPE_BYTES.get(c.dtype, 4)
+    return 0
+
+
+def _axes_for_groups(groups, mesh) -> Tuple[str, ...]:
+    """Mesh axes a replica grouping spans: the axes whose coordinates vary
+    inside a group. () when unresolvable (no mesh / out-of-range ids).
+
+    Replica-group entries are LOGICAL ids — positions in the program's
+    device assignment, which for a jitted mesh program is ``mesh.devices``
+    flattened — NOT ``Device.id``. The two coincide on a single process,
+    but multi-process backends number real devices sparsely (CPU:
+    ``process_index << 17``), so a ``Device.id`` lookup would silently
+    unattribute every cross-host collective."""
+    if not groups or mesh is None:
+        return ()
+    import numpy as np
+
+    shape = tuple(mesh.devices.shape)
+    size = int(mesh.devices.size)
+    names = list(mesh.shape)
+    varying = set()
+    for g in groups:
+        if any(d < 0 or d >= size for d in g):
+            return ()
+        coords = [np.unravel_index(d, shape) for d in g]
+        for ax_i in range(len(names)):
+            if len({c[ax_i] for c in coords}) > 1:
+                varying.add(ax_i)
+    return tuple(n for i, n in enumerate(names) if i in varying)
+
+
+@dataclasses.dataclass
+class CollectiveCost:
+    """One priced collective: kind, payload, span, and the mesh axes it
+    crosses (``()`` = unattributed, rendered as ``"?"``)."""
+
+    kind: str
+    dtype: Optional[str]
+    payload_bytes: int  # full logical tensor bytes (pre-factor)
+    bytes: int  # payload x per-kind factor (all_reduce counts 2x)
+    group_size: Optional[int]
+    n_groups: Optional[int]
+    axes: Tuple[str, ...]
+    line: int
+
+    @property
+    def axis_key(self) -> str:
+        return "×".join(self.axes) if self.axes else "?"
+
+
+@dataclasses.dataclass
+class Reshard:
+    """A GSPMD-inserted all-gather that fully materializes a tensor the
+    rules declared sharded (and that was not an intended compute
+    gather) — the silent replication arXiv:2004.13336 warns about."""
+
+    param: str
+    kind: str
+    bytes: int
+    line: int
+
+    def __str__(self):
+        return (f"{self.param}: declared sharded but a {self.kind} at "
+                f"L{self.line} fully materializes it ({self.bytes} bytes)")
+
+
+@dataclasses.dataclass
+class CommReport:
+    """Per-program communication census: every collective priced, rolled
+    up by mesh axis and by kind (docs/ANALYSIS.md). Truthy iff any
+    collective was found."""
+
+    costs: List[CollectiveCost] = dataclasses.field(default_factory=list)
+    reshards: List[Reshard] = dataclasses.field(default_factory=list)
+
+    def __bool__(self):
+        return bool(self.costs)
+
+    def total_bytes(self) -> int:
+        return sum(c.bytes for c in self.costs)
+
+    def by_axis(self) -> Dict[str, int]:
+        out: _Counter = _Counter()
+        for c in self.costs:
+            out[c.axis_key] += c.bytes
+        return dict(out)
+
+    def by_kind(self) -> Dict[str, int]:
+        out: _Counter = _Counter()
+        for c in self.costs:
+            out[c.kind] += c.bytes
+        return dict(out)
+
+    def kind_counts(self) -> Dict[str, int]:
+        return dict(_Counter(c.kind for c in self.costs))
+
+    def summary(self) -> dict:
+        return {
+            "n_collectives": len(self.costs),
+            "total_bytes": self.total_bytes(),
+            "by_axis": self.by_axis(),
+            "by_kind": self.by_kind(),
+            "kind_counts": self.kind_counts(),
+            "accidental_reshards": [str(r) for r in self.reshards],
+        }
+
+
+def comm_report(report: ProgramReport, mesh=None) -> CommReport:
+    """Price every collective in ``report``. ``mesh`` (a
+    ``jax.sharding.Mesh``, optional) enables axis attribution — without
+    it all traffic lands under ``"?"``."""
+    costs = []
+    for c in report.collectives:
+        payload = _payload_bytes(c)
+        factor = _KIND_FACTOR.get(c.name, 1)
+        costs.append(CollectiveCost(
+            kind=c.name, dtype=c.dtype, payload_bytes=payload,
+            bytes=payload * factor, group_size=c.group_size,
+            n_groups=len(c.groups) if c.groups else None,
+            axes=_axes_for_groups(c.groups, mesh), line=c.line))
+    return CommReport(costs=costs)
+
+
+def detect_accidental_reshards(
+        report: ProgramReport,
+        declared_specs: Dict[str, object],
+        shapes: Dict[str, Tuple[int, ...]],
+        intended: Optional[set] = None,
+        mesh=None) -> List[Reshard]:
+    """All-gathers whose full result matches the *global* shape of a
+    declared-sharded tensor not on the ``intended`` gather list.
+
+    ``declared_specs`` maps name -> PartitionSpec (entries iterable;
+    anything with a non-None entry counts as declared-sharded),
+    ``shapes`` maps name -> global shape, ``intended`` names tensors the
+    caller gathers on purpose (TrainStep's ZeRO compute-spec params).
+
+    Matching is a shape heuristic, tightened two ways against false CI
+    failures: a shape shared between an intended and a non-intended
+    tensor is ambiguous and skipped entirely; and with ``mesh`` given,
+    the gather's *operand* must also match the shard shape the declared
+    spec implies (global dims / expected tiles), so e.g. an activation
+    gather whose result merely coincides with a square weight's global
+    shape is not pinned on the weight. A missed flag on a correct
+    program beats failing the shardcheck gate on a coincidence."""
+    intended = intended or set()
+    intended_shapes = {tuple(shapes[n]) for n in intended if n in shapes}
+    mesh_shape = dict(mesh.shape) if mesh is not None else None
+    watch: Dict[Tuple[int, ...], List[Tuple[str, object]]] = {}
+    for name, spec in declared_specs.items():
+        if name in intended:
+            continue
+        shape = tuple(shapes[name])
+        if shape in intended_shapes:
+            continue
+        if any(e is not None for e in tuple(spec)):
+            watch.setdefault(shape, []).append((name, spec))
+    if not watch:
+        return []
+
+    def shard_shape(shape, spec):
+        from .contract import expected_tiles
+
+        tiles = expected_tiles(spec, len(shape), mesh_shape)
+        if tiles is None or any(d % t for d, t in zip(shape, tiles)):
+            return None
+        return tuple(d // t for d, t in zip(shape, tiles))
+
+    out: List[Reshard] = []
+    for c in report.collectives:
+        if c.name != "all_gather":
+            continue
+        full = max((sh for _, sh in c.result_info), key=_elems,
+                   default=c.shape)
+        opd_shapes = {sh for _, sh in c.operand_info}
+        for name, spec in watch.get(tuple(full), []):
+            if mesh_shape is not None and opd_shapes:
+                want = shard_shape(tuple(full), spec)
+                if want is not None and want not in opd_shapes:
+                    continue
+            out.append(Reshard(param=name, kind=c.name,
+                               bytes=_payload_bytes(c), line=c.line))
+    return out

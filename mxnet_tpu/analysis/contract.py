@@ -1,0 +1,127 @@
+"""Sharding contract checker: declared layouts vs compiled layouts.
+
+``ShardingRules`` declares how every parameter should be laid out on the
+mesh; XLA's compiled executable records how each one actually *is* laid
+out (the ``sharding={...}`` / ``mhlo.sharding`` annotations the HLO
+auditor parses into :class:`~mxnet_tpu.analysis.ShardingInfo`). Nothing
+previously checked that the two agree — and they silently disagree the
+moment a rule mis-specifies an axis: a dim that doesn't divide, or a
+typo'd axis name, makes ``spec_for`` fall back to replicated, and the
+program trains with a replicated tensor the author believes is sharded
+(arXiv:2004.13336's reduce-scatter-becomes-all-gather failure).
+
+The checker diffs the *declared intent*
+(``ShardingRules.declared_tree_specs`` — the first matching rule's raw
+spec, before divisibility/axis-existence fallbacks) against the layouts
+in the compiled program, per flat input. Each mismatch renders as::
+
+    dense0_weight: declared P('fsdp', None) → compiled replicated
+
+Comparison is structural: a PartitionSpec + mesh axis sizes give the
+expected shard count per tensor dimension; the parsed annotation gives
+the actual one. Axes of size 1 partition nothing, so ``P('tp')`` on a
+tp=1 mesh legitimately compiles replicated and is not a violation.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from .hlo_audit import ProgramReport, ShardingInfo
+
+__all__ = ["ContractViolation", "check_contract", "expected_tiles",
+           "render_spec"]
+
+
+def render_spec(spec) -> str:
+    """``P('fsdp', None)`` — the short spelling used in diffs."""
+    entries = tuple(spec)
+    return "P(" + ", ".join(repr(e) for e in entries) + ")"
+
+
+def expected_tiles(spec, rank: int, mesh_shape: Dict[str, int]) -> \
+        Optional[Tuple[int, ...]]:
+    """Shards per tensor dim that ``spec`` asks for on a mesh with
+    ``mesh_shape`` axis sizes. None when the spec names an axis the mesh
+    does not have (the intent is un-realizable — always a violation)."""
+    out = []
+    entries = tuple(spec)
+    for i in range(rank):
+        e = entries[i] if i < len(entries) else None
+        if e is None:
+            out.append(1)
+            continue
+        axes = e if isinstance(e, tuple) else (e,)
+        n = 1
+        for ax in axes:
+            if ax not in mesh_shape:
+                return None
+            n *= mesh_shape[ax]
+        out.append(n)
+    return tuple(out)
+
+
+def _actual_tiles(info: Optional[ShardingInfo],
+                  rank: int) -> Optional[Tuple[int, ...]]:
+    """Shards per tensor dim the program actually uses. Missing/replicated
+    annotations mean one shard everywhere; unknown forms return None
+    (reported as unparseable rather than silently passed)."""
+    if info is None or info.is_replicated:
+        return (1,) * rank
+    if info.kind == "tiled":
+        dims = info.tile_dims
+        if len(dims) < rank:
+            dims = dims + (1,) * (rank - len(dims))
+        return tuple(dims[:rank])
+    return None
+
+
+def _render_actual(info: Optional[ShardingInfo]) -> str:
+    if info is None:
+        return "replicated"
+    return info.describe()
+
+
+@dataclasses.dataclass
+class ContractViolation:
+    """One parameter whose compiled layout differs from the declared one."""
+
+    param: str
+    index: int  # flat program input index
+    declared: str  # e.g. "P('fsdp', None)"
+    compiled: str  # e.g. "replicated" / "sharded devices=[4, 1]"
+
+    def __str__(self):
+        return f"{self.param}: declared {self.declared} → compiled " \
+               f"{self.compiled}"
+
+
+def check_contract(report: ProgramReport,
+                   declared_specs: Dict[str, object],
+                   shapes: Dict[str, Tuple[int, ...]],
+                   name_to_index: Dict[str, int],
+                   mesh) -> List[ContractViolation]:
+    """Diff declared specs against the layouts ``report`` compiled.
+
+    ``declared_specs``: name -> PartitionSpec intent;
+    ``shapes``: name -> global shape; ``name_to_index``: name -> flat
+    program input index (TrainStep: sorted param order, the head of the
+    donated carry); ``mesh``: the jax Mesh (axis sizes read off
+    ``mesh.shape``). Returns violations sorted by input index.
+    """
+    mesh_shape = dict(mesh.shape)
+    out: List[ContractViolation] = []
+    for name, idx in sorted(name_to_index.items(), key=lambda kv: kv[1]):
+        spec = declared_specs.get(name)
+        if spec is None:
+            continue
+        rank = len(shapes[name])
+        info = report.arg_sharding(idx)
+        want = expected_tiles(spec, rank, mesh_shape)
+        got = _actual_tiles(info, rank)
+        if want is not None and got is not None and want == got:
+            continue
+        out.append(ContractViolation(
+            param=name, index=idx, declared=render_spec(spec),
+            compiled=_render_actual(info)))
+    return out

@@ -37,7 +37,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Op", "Collective", "DonationReport", "ProgramReport",
            "ProgramAudit", "audit_text", "audit_lowered", "audit_compiled",
-           "Fingerprint", "fingerprint_diff", "RecompileGuard"]
+           "Fingerprint", "fingerprint_diff", "RecompileGuard",
+           "ShardingInfo", "parse_sharding"]
 
 # ops that move data between host and device (either dialect's spelling,
 # normalized): the serving/training hot loops must never contain one
@@ -82,6 +83,69 @@ def _normalize_op(name: str) -> str:
     return name
 
 
+@dataclasses.dataclass(frozen=True)
+class ShardingInfo:
+    """One parsed sharding annotation — the GSPMD layout of a tensor.
+
+    Both spellings normalize here: the lowered dialect's
+    ``mhlo.sharding = "{devices=[4,1,2]<=[2,4]T(1,0) last_tile_dim_replicate}"``
+    arg attribute and the compiled dialect's ``sharding={...}`` parameter
+    attribute. ``tile_dims`` is the number of shards along each *tensor*
+    dimension (the subgroup-replication tile — ``last_tile_dim_replicate``
+    — already stripped), so "is this tensor laid out the way the rules
+    declared" is a per-dim integer comparison, never a device-list diff.
+    """
+
+    kind: str  # "replicated" | "tiled" | "maximal" | "manual" | "unknown"
+    tile_dims: Tuple[int, ...] = ()  # shards per tensor dim (tiled only)
+    replicate_last: bool = False  # subgroup replication was present
+    raw: str = ""
+
+    @property
+    def is_replicated(self) -> bool:
+        """Fully materialized on every device (maximal — one device holds
+        the whole tensor — counts: nothing is partitioned)."""
+        return self.kind in ("replicated", "maximal") or (
+            self.kind == "tiled" and all(d == 1 for d in self.tile_dims))
+
+    def describe(self) -> str:
+        if self.kind == "tiled" and not self.is_replicated:
+            return f"sharded devices={list(self.tile_dims)}"
+        if self.kind == "unknown":
+            return f"unknown {self.raw!r}"
+        return "replicated" if self.is_replicated else self.kind
+
+
+_SHARDING_DEVICES = re.compile(r"devices=\[([0-9,]+)\]")
+
+
+def parse_sharding(raw: str) -> ShardingInfo:
+    """Parse one HLO sharding attribute value (either dialect's spelling,
+    braces/quotes tolerated) into a :class:`ShardingInfo`."""
+    body = raw.strip().strip('"').strip()
+    if body.startswith("{") and body.endswith("}"):
+        body = body[1:-1].strip()
+    if body.startswith("{"):
+        # tuple sharding ({{..}, {..}}): per-element layouts — not a
+        # single-tensor annotation, keep raw
+        return ShardingInfo("unknown", raw=raw)
+    if body == "replicated":
+        return ShardingInfo("replicated", raw=raw)
+    if body.startswith("maximal"):
+        return ShardingInfo("maximal", raw=raw)
+    if body == "manual":
+        return ShardingInfo("manual", raw=raw)
+    m = _SHARDING_DEVICES.search(body)
+    if m:
+        dims = tuple(int(d) for d in m.group(1).split(",") if d)
+        rep_last = "last_tile_dim_replicate" in body
+        if rep_last and dims:
+            dims = dims[:-1]
+        return ShardingInfo("tiled", tile_dims=dims, replicate_last=rep_last,
+                            raw=raw)
+    return ShardingInfo("unknown", raw=raw)
+
+
 @dataclasses.dataclass
 class Op:
     """One program instruction: normalized name, result dtype/shape, and
@@ -93,6 +157,7 @@ class Op:
     dtypes: Tuple[str, ...]  # all dtypes on the line, operands included
     line: int
     shapes: Tuple[Tuple[int, ...], ...] = ()  # shapes paired with `dtypes`
+    sharding: Optional[ShardingInfo] = None  # per-op sharding annotation
 
     def __repr__(self):
         dims = "x".join(map(str, self.shape)) or "scalar"
@@ -103,10 +168,15 @@ class Op:
 class Collective(Op):
     """A collective op plus its replica grouping. ``groups`` is the
     normalized tuple-of-tuples of device ids, or None when the grouping
-    could not be parsed (``raw_groups`` always keeps the source text)."""
+    could not be parsed (``raw_groups`` always keeps the source text).
+    ``operand_info``/``result_info`` split the line's tensors by side of
+    the op — the communication cost model reads payload sizes from them
+    (an all-gather's operand is the shard, its result the full tensor)."""
 
     raw_groups: str = ""
     groups: Optional[Tuple[Tuple[int, ...], ...]] = None
+    operand_info: Tuple[Tuple[str, Tuple[int, ...]], ...] = ()
+    result_info: Tuple[Tuple[str, Tuple[int, ...]], ...] = ()
 
     @property
     def group_size(self) -> Optional[int]:
@@ -189,20 +259,87 @@ _RG = re.compile(r"replica_groups=(\[[^\]]*\]<=\[[^\]]*\](?:T\([^)]*\))?"
                  r"|\{\{[^=]*?\}\})")
 # replica groups, stablehlo: replica_groups = dense<[[0, 1, ..]]> : tensor<..>
 _RG_MLIR = re.compile(r"replica_groups\s*=\s*dense<(\[\[.*?\]\]|\d+)>")
-_IOTA_RG = re.compile(r"\[(\d+),(\d+)\]<=\[(\d+)\]$")
+# ...and the whole clause incl. the attribute's own tensor type, which
+# must never be mistaken for a collective operand/result
+_RG_MLIR_CLAUSE = re.compile(
+    r"replica_groups\s*=\s*dense<(?:\[\[.*?\]\]|\d+)>\s*:\s*tensor<[^>]*>")
+# sharding annotations: lowered args/ops carry a quoted mhlo.sharding attr;
+# compiled HLO parameters/ops carry a bare sharding={...} (the negative
+# lookbehind keeps `mhlo.sharding` and header fields like
+# allow_spmd_sharding_propagation_to_parameters from matching)
+_MLIR_SHARDING = re.compile(r'mhlo\.sharding\s*=\s*"([^"]*)"')
+_HLO_SHARDING = re.compile(r"(?<![.\w])sharding=")
+
+
+def _hlo_sharding_attr(line: str) -> Optional[str]:
+    """The balanced-brace body of a compiled-dialect ``sharding={...}``
+    attribute (tuple shardings nest braces), or None."""
+    m = _HLO_SHARDING.search(line)
+    if m is None or m.end() >= len(line) or line[m.end()] != "{":
+        return None
+    depth = 0
+    for j in range(m.end(), len(line)):
+        if line[j] == "{":
+            depth += 1
+        elif line[j] == "}":
+            depth -= 1
+            if depth == 0:
+                return line[m.end():j + 1]
+    return None
+_IOTA_RG = re.compile(r"\[([0-9,]+)\]<=\[([0-9,]+)\]"
+                      r"(?:T\(([0-9,\s]+)\))?$")
+
+
+def _iota_ids(reshape_dims: Sequence[int],
+              perm: Sequence[int]) -> List[int]:
+    """The V2 iota device list: ``arange(n).reshape(reshape_dims)
+    .transpose(perm)`` flattened — pure-stdlib (no numpy) index walk."""
+    n = 1
+    for d in reshape_dims:
+        n *= d
+    t_shape = [reshape_dims[p] for p in perm]
+    out = []
+    for i in range(n):
+        rem, t = i, []
+        for d in reversed(t_shape):
+            t.append(rem % d)
+            rem //= d
+        t.reverse()
+        orig = [0] * len(reshape_dims)
+        for k, p in enumerate(perm):
+            orig[p] = t[k]
+        v = 0
+        for d, c in zip(reshape_dims, orig):
+            v = v * d + c
+        out.append(v)
+    return out
 
 
 def _parse_groups(raw: str) -> Optional[Tuple[Tuple[int, ...], ...]]:
     """Normalize a replica-group spec to a tuple of device-id tuples.
-    Handles the explicit list form and the untransposed iota form
-    ``[g,s]<=[n]``; anything fancier keeps groups=None (raw preserved)."""
+    Handles the explicit list form and the V2 iota form — plain
+    ``[g,s]<=[n]`` AND the reshaped/transposed ``[g,s]<=[a,b]T(1,0)``
+    GSPMD emits for collectives over a non-trailing mesh axis; anything
+    fancier keeps groups=None (raw preserved)."""
     raw = raw.strip()
     m = _IOTA_RG.match(raw)
     if m:
-        g, s, n = map(int, m.groups())
-        if g * s == n:
-            return tuple(tuple(range(i * s, (i + 1) * s)) for i in range(g))
-        return None
+        dims = [int(d) for d in m.group(1).split(",") if d]
+        reshape = [int(d) for d in m.group(2).split(",") if d]
+        perm = ([int(p) for p in m.group(3).replace(" ", "").split(",") if p]
+                if m.group(3) else list(range(len(reshape))))
+        n = 1
+        for d in reshape:
+            n *= d
+        total = 1
+        for d in dims:
+            total *= d
+        if len(dims) != 2 or total != n or sorted(perm) != \
+                list(range(len(reshape))):
+            return None
+        g, s = dims
+        ids = _iota_ids(reshape, perm)
+        return tuple(tuple(ids[i * s:(i + 1) * s]) for i in range(g))
     if raw.startswith("{{") or raw.startswith("[["):
         body = raw.strip("{}[]")
         groups = []
@@ -253,6 +390,10 @@ class ProgramReport:
     donation: DonationReport
     inputs: List[Tuple[str, Tuple[int, ...]]]  # (dtype, shape) per flat input
     n_lines: int
+    # flat input index -> parsed sharding annotation (both dialects: the
+    # lowered mhlo.sharding arg attr / the compiled parameter sharding=)
+    arg_shardings: Dict[int, ShardingInfo] = \
+        dataclasses.field(default_factory=dict)
 
     # -- census --------------------------------------------------------------
     def op_census(self) -> Dict[str, int]:
@@ -305,6 +446,18 @@ class ProgramReport:
     def host_transfers(self) -> List[Op]:
         return [o for o in self.ops if o.name in HOST_TRANSFER_OPS]
 
+    # -- shardings -----------------------------------------------------------
+    def arg_sharding(self, idx: int) -> Optional[ShardingInfo]:
+        """Parsed sharding annotation of flat input ``idx`` (None when the
+        program carries no annotation for it — mesh-less programs)."""
+        return self.arg_shardings.get(idx)
+
+    def sharded_inputs(self) -> List[int]:
+        """Flat input indices whose annotation actually partitions the
+        tensor (replicated/maximal annotations excluded)."""
+        return [i for i, s in sorted(self.arg_shardings.items())
+                if not s.is_replicated and s.kind == "tiled"]
+
     # -- shape queries -------------------------------------------------------
     def has_tensor(self, shape: Tuple[int, ...],
                    dtype: Optional[str] = None,
@@ -337,6 +490,7 @@ class ProgramReport:
             "host_transfers": [o.name for o in self.host_transfers()],
             "donation": {"n_inputs": self.donation.n_inputs,
                          "n_aliased": self.donation.n_aliased},
+            "sharded_inputs": len(self.sharded_inputs()),
         }
 
 
@@ -346,6 +500,7 @@ def _parse_stablehlo(text: str) -> ProgramReport:
     custom_calls: List[str] = []
     inputs: List[Tuple[str, Tuple[int, ...]]] = []
     aliased: Dict[int, str] = {}
+    arg_shardings: Dict[int, ShardingInfo] = {}
     lines = text.splitlines()
     in_main_sig = False
     sig_buf = []
@@ -372,19 +527,40 @@ def _parse_stablehlo(text: str) -> ProgramReport:
         rdt, rshape = (tensors[-1] if tensors else (None, ()))
         dtypes = tuple(dt for dt, _ in tensors)
         shapes = tuple(sh for _, sh in tensors)
+        sm = _MLIR_SHARDING.search(s)
+        op_sharding = parse_sharding(sm.group(1)) if sm else None
         if name == "custom_call":
             m = re.search(r'call_target_name\s*=\s*"([^"]+)"', s)
             custom_calls.append(m.group(1) if m else "?")
         if name in COLLECTIVE_OPS:
             m = _RG_MLIR.search(s)
             raw = m.group(1) if m else ""
-            c = Collective(name, rdt, rshape, dtypes, i, shapes=shapes,
-                           raw_groups=raw,
-                           groups=_parse_groups(raw) if raw else None)
+            # payload sizing must not read the replica_groups attribute's
+            # own `dense<...> : tensor<NxMxi64>` type as a tensor — strip
+            # the clause, THEN split operands/results at the trailing type
+            # signature (`: (operands) -> result`). Region-form
+            # collectives keep their types on the closing line, so after
+            # the strip nothing may remain — payload 0 (best effort; the
+            # comm model primarily reads the compiled dialect) beats
+            # pricing the group table.
+            sc = _RG_MLIR_CLAUSE.sub("", s)
+            ctensors = _mlir_tensors(sc)
+            crdt, crshape = (ctensors[-1] if ctensors else (None, ()))
+            arrow = sc.rfind("->")
+            res_info = tuple(_mlir_tensors(sc[arrow:])) if arrow >= 0 else ()
+            opd_info = (tuple(_mlir_tensors(sc[:arrow])) if arrow >= 0
+                        else tuple(ctensors))
+            c = Collective(name, crdt, crshape,
+                           tuple(dt for dt, _ in ctensors), i,
+                           shapes=tuple(sh for _, sh in ctensors),
+                           sharding=op_sharding, raw_groups=raw,
+                           groups=_parse_groups(raw) if raw else None,
+                           operand_info=opd_info, result_info=res_info)
             collectives.append(c)
             ops.append(c)
             continue
-        ops.append(Op(name, rdt, rshape, dtypes, i, shapes=shapes))
+        ops.append(Op(name, rdt, rshape, dtypes, i, shapes=shapes,
+                      sharding=op_sharding))
     sig = " ".join(sig_buf)
     matches = list(_MLIR_ARG.finditer(sig))
     for k, m in enumerate(matches):
@@ -406,11 +582,14 @@ def _parse_stablehlo(text: str) -> ProgramReport:
         end = matches[k + 1].start() if k + 1 < len(matches) else len(sig)
         if _MLIR_ALIAS.search(sig, m.end(), end):
             aliased[idx] = "may-alias"
+        shm = _MLIR_SHARDING.search(sig[m.end():end])
+        if shm:
+            arg_shardings[idx] = parse_sharding(shm.group(1))
     return ProgramReport(
         dialect="stablehlo", ops=ops, collectives=collectives,
         custom_calls=custom_calls,
         donation=DonationReport(n_inputs=len(inputs), aliased=aliased),
-        inputs=inputs, n_lines=len(lines))
+        inputs=inputs, n_lines=len(lines), arg_shardings=arg_shardings)
 
 
 def _parse_hlo(text: str) -> ProgramReport:
@@ -419,6 +598,7 @@ def _parse_hlo(text: str) -> ProgramReport:
     custom_calls: List[str] = []
     inputs: List[Tuple[str, Tuple[int, ...]]] = []
     aliased: Dict[int, str] = {}
+    arg_shardings: Dict[int, ShardingInfo] = {}
     lines = text.splitlines()
     entry_params: Dict[int, Tuple[str, Tuple[int, ...]]] = {}
     in_entry = False
@@ -442,6 +622,9 @@ def _parse_hlo(text: str) -> ProgramReport:
                 pm = re.search(r"parameter\((\d+)\)", s)
                 if pm:
                     entry_params[int(pm.group(1))] = tensors[0]
+                    sh = _hlo_sharding_attr(s)
+                    if sh is not None:
+                        arg_shardings[int(pm.group(1))] = parse_sharding(sh)
             continue
         name = _normalize_op(name)
         if name in ("constant", "tuple", "get_tuple_element", "bitcast",
@@ -457,19 +640,28 @@ def _parse_hlo(text: str) -> ProgramReport:
         rdt, rshape = (tensors[0] if tensors else (None, ()))
         dtypes = tuple(dt for dt, _ in tensors)
         shapes = tuple(sh for _, sh in tensors)
+        sh_attr = _hlo_sharding_attr(s)
+        op_sharding = parse_sharding(sh_attr) if sh_attr is not None else None
         if name == "custom_call":
             cm = re.search(r'custom_call_target="([^"]+)"', s)
             custom_calls.append(cm.group(1) if cm else "?")
         if name in COLLECTIVE_OPS:
             gm = _RG.search(s)
             raw = gm.group(1) if gm else ""
+            # split the line's tensors by side of the op name: result
+            # type(s) precede it, operand types live in the call parens —
+            # payload sizing for the comm cost model
+            res_info = tuple(_hlo_tensors(s[:m.start(1)]))
+            opd_info = tuple(_hlo_tensors(s[m.end(1):]))
             c = Collective(name, rdt, rshape, dtypes, i, shapes=shapes,
-                           raw_groups=raw,
-                           groups=_parse_groups(raw) if raw else None)
+                           sharding=op_sharding, raw_groups=raw,
+                           groups=_parse_groups(raw) if raw else None,
+                           operand_info=opd_info, result_info=res_info)
             collectives.append(c)
             ops.append(c)
             continue
-        ops.append(Op(name, rdt, rshape, dtypes, i, shapes=shapes))
+        ops.append(Op(name, rdt, rshape, dtypes, i, shapes=shapes,
+                      sharding=op_sharding))
     n_inputs = (max(entry_params) + 1) if entry_params else 0
     for idx in range(n_inputs):
         inputs.append(entry_params.get(idx, ("?", ())))
@@ -477,7 +669,7 @@ def _parse_hlo(text: str) -> ProgramReport:
         dialect="hlo", ops=ops, collectives=collectives,
         custom_calls=custom_calls,
         donation=DonationReport(n_inputs=n_inputs, aliased=aliased),
-        inputs=inputs, n_lines=len(lines))
+        inputs=inputs, n_lines=len(lines), arg_shardings=arg_shardings)
 
 
 @dataclasses.dataclass
@@ -491,6 +683,13 @@ class ProgramAudit:
     lowered: ProgramReport
     compiled: Optional[ProgramReport]
     carry_indices: Tuple[int, ...] = ()
+    # sharding-contract violations (analysis.contract.ContractViolation):
+    # declared layout != compiled layout, [] when the contract holds or no
+    # mesh is involved
+    contract: List = dataclasses.field(default_factory=list)
+    # communication cost model over the program's collectives
+    # (analysis.comm.CommReport), None when not computed
+    comm: Optional[object] = None
 
     def carry_donation(self) -> float:
         """Donation coverage of the carry (params/opt-state for TrainStep,
@@ -507,9 +706,12 @@ class ProgramAudit:
         out = {"lowered": self.lowered.summary(),
                "carry": {"n": len(self.carry_indices),
                          "donation_coverage": self.carry_donation(),
-                         "missing": self.carry_missing()}}
+                         "missing": self.carry_missing()},
+               "contract": [str(v) for v in self.contract]}
         if self.compiled is not None:
             out["compiled"] = self.compiled.summary()
+        if self.comm is not None:
+            out["comm"] = self.comm.summary()
         return out
 
 

@@ -315,11 +315,18 @@ class GenerationEngine:
                 jnp.asarray(bucket, jnp.int32), key)
         # flat arg order: params leaves, then the cache leaves (argnum 1,
         # the donated carry)
+        lowered_rep = _analysis.audit_lowered(lowered)
+        compiled_rep = (_analysis.audit_compiled(lowered.compile())
+                        if compile else None)
+        # serving programs run mesh-less today, so the comm report is the
+        # "no collectives crept into the decode path" check — any priced
+        # collective here is a regression tools/shardcheck.py catches
+        comm = _analysis.comm_report(
+            compiled_rep if compiled_rep is not None else lowered_rep)
         return _analysis.ProgramAudit(
-            lowered=_analysis.audit_lowered(lowered),
-            compiled=(_analysis.audit_compiled(lowered.compile())
-                      if compile else None),
-            carry_indices=tuple(range(n_params, n_params + n_cache)))
+            lowered=lowered_rep, compiled=compiled_rep,
+            carry_indices=tuple(range(n_params, n_params + n_cache)),
+            comm=comm)
 
     def release_slot(self, slot: int) -> None:
         """Mark a row free (emits pad, frontier frozen) — the next prefill

@@ -17,6 +17,13 @@ __all__ = ["ShardingRules", "named_sharding", "shard_params", "reshard_tree",
            "DEFAULT_BERT_RULES"]
 
 
+def _size(shape):
+    n = 1
+    for s in shape:
+        n *= s
+    return n
+
+
 class ShardingRules:
     """Ordered (pattern, spec-maker) list; first match wins."""
 
@@ -26,13 +33,38 @@ class ShardingRules:
         self.fsdp_axis = fsdp_axis
         self.min_fsdp_size = min_fsdp_size
 
+    @staticmethod
+    def _fits(spec, shape, mesh) -> bool:
+        """Does ``spec`` lay ``shape`` onto ``mesh`` evenly? Always a
+        bool, never an exception: a dim that doesn't divide, a spec
+        naming an axis the mesh doesn't have (typo'd axis name), or a
+        tuple entry whose combined axis product doesn't divide all
+        answer False — the caller falls back to the next rule /
+        replicated, and the sharding contract checker + the JH006 lint
+        rule surface the mistake instead of a KeyError at trace time.
+        A spec longer than the rank only constrains the dims that exist
+        (``zip`` stops at the shape)."""
+        for dim, entry in zip(shape, spec):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            n = 1
+            for ax in axes:
+                if ax not in mesh.shape:
+                    return False
+                n *= mesh.shape[ax]
+            if dim % n != 0:
+                return False
+        return True
+
     def spec_for(self, name: str, shape, mesh: Mesh) -> P:
         for pat, spec in self.rules:
             if pat.search(name):
                 spec = tuple(spec)[: len(shape)]
-                if _fits(spec, shape, mesh):
+                if self._fits(spec, shape, mesh):
                     return P(*spec)
-        if self.fsdp_axis and _size(shape) >= self.min_fsdp_size:
+        if self.fsdp_axis and self.fsdp_axis in mesh.shape \
+                and _size(shape) >= self.min_fsdp_size:
             ax_size = mesh.shape[self.fsdp_axis]
             for dim, s in sorted(enumerate(shape), key=lambda t: -t[1]):
                 if s % ax_size == 0:
@@ -44,19 +76,29 @@ class ShardingRules:
     def tree_specs(self, params: Dict[str, jax.Array], mesh: Mesh):
         return {k: self.spec_for(k, v.shape, mesh) for k, v in params.items()}
 
+    # -- declared intent (the sharding contract checker's input) -------------
+    def declared_spec_for(self, name: str, shape, mesh: Mesh) -> P:
+        """The layout this rule set *declares* for ``name`` — the first
+        pattern-matching rule's raw spec, BEFORE the divisibility /
+        axis-existence fallbacks ``spec_for`` applies. When intent and
+        resolution differ (a mis-specified rule silently replicated the
+        tensor), ``analysis.check_contract`` reports the diff as
+        ``name: declared P('fsdp', None) → compiled replicated``. With no
+        matching pattern the fallback path IS the intent, so this returns
+        ``spec_for``'s answer."""
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return P(*tuple(spec)[: len(shape)])
+        return self.spec_for(name, shape, mesh)
 
-def _size(shape):
-    n = 1
-    for s in shape:
-        n *= s
-    return n
+    def declared_tree_specs(self, shapes: Dict[str, tuple], mesh: Mesh):
+        """name -> declared spec over a ``{name: global_shape}`` map."""
+        return {k: self.declared_spec_for(k, s, mesh)
+                for k, s in shapes.items()}
 
 
-def _fits(spec, shape, mesh) -> bool:
-    for dim, ax in zip(shape, spec):
-        if ax is not None and dim % mesh.shape[ax] != 0:
-            return False
-    return True
+# module-level alias kept for existing callers/tests
+_fits = ShardingRules._fits
 
 
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:

@@ -1011,7 +1011,7 @@ class TrainStep:
                         stacked, keys, lrs, wd)
 
     def audit(self, *batch, window: Optional[int] = None, accum: int = 1,
-              compile: bool = True):
+              compile: bool = True, rules: Optional[ShardingRules] = None):
         """Structural :class:`~mxnet_tpu.analysis.ProgramAudit` of the
         program this batch signature runs (docs/ANALYSIS.md): the lowered
         StableHLO report (dtype census — assert bf16 dots / no f64 leaks
@@ -1019,7 +1019,17 @@ class TrainStep:
         and the flat input indices of the donated params/opt-state carry
         so ``audit(...).carry_donation() == 1.0`` is the whole no-copy
         update check. ``window=`` audits the fused k-step scan program
-        instead of the single step."""
+        instead of the single step.
+
+        On a mesh the audit also carries the sharding-and-communication
+        layer: ``audit.contract`` diffs the declared parameter layouts
+        (``rules=`` overrides the step's own rules as the declaration
+        under check) against the layouts the program actually compiled —
+        every mismatch rendered as ``name: declared P('fsdp', None) →
+        compiled replicated`` — and ``audit.comm`` prices every
+        collective into a :class:`~mxnet_tpu.analysis.CommReport`
+        (per-axis logical bytes, accidental-reshard flags; the intended
+        ZeRO compute gathers are exempt)."""
         from .. import analysis as _analysis
 
         if window:
@@ -1031,8 +1041,33 @@ class TrainStep:
         # then opt-state leaves — exactly the donated (0, 1) argnums
         n_carry = len(jax.tree_util.tree_leaves((self.params,
                                                  self.opt_state)))
+        lowered_rep = _analysis.audit_lowered(lowered)
+        compiled_rep = (_analysis.audit_compiled(lowered.compile())
+                        if compile else None)
+        contract: list = []
+        comm = None
+        if self.mesh is not None:
+            # layout truth: the compiled executable when available, else
+            # the lowered annotations (same precedence as carry_donation)
+            rep = compiled_rep if compiled_rep is not None else lowered_rep
+            decl_rules = rules if rules is not None else self.rules
+            shapes = {k: tuple(v.shape) for k, v in self.params.items()}
+            declared = decl_rules.declared_tree_specs(shapes, self.mesh)
+            # flat input order of a dict pytree is sorted-key order, so
+            # param i of the donated carry is the i-th sorted name
+            order = {name: i for i, name in enumerate(sorted(shapes))}
+            contract = _analysis.check_contract(rep, declared, shapes,
+                                                order, self.mesh)
+            comm = _analysis.comm_report(rep, self.mesh)
+            comm.reshards = _analysis.detect_accidental_reshards(
+                rep, declared, shapes, intended=set(self._compute_specs),
+                mesh=self.mesh)
+        else:
+            # mesh-less: no layouts to contract-check, but any collective
+            # that crept into a single-device program is still priced
+            comm = _analysis.comm_report(
+                compiled_rep if compiled_rep is not None else lowered_rep)
         return _analysis.ProgramAudit(
-            lowered=_analysis.audit_lowered(lowered),
-            compiled=(_analysis.audit_compiled(lowered.compile())
-                      if compile else None),
-            carry_indices=tuple(range(n_carry)))
+            lowered=lowered_rep, compiled=compiled_rep,
+            carry_indices=tuple(range(n_carry)),
+            contract=contract, comm=comm)

@@ -1,8 +1,10 @@
-"""Static-analysis subsystem (ISSUE 6, docs/ANALYSIS.md): the HLO auditor
-(ProgramReport parsing over both text dialects, donation coverage, program
-fingerprints + recompile causes) and the AST jit-hazard linter (rule
-engine, suppressions, and the package-is-clean regression that backs
-``make lint``).
+"""Static-analysis subsystem (ISSUES 6 + 8, docs/ANALYSIS.md): the HLO
+auditor (ProgramReport parsing over both text dialects, donation coverage,
+program fingerprints + recompile causes), the sharding-and-communication
+layer (ShardingInfo parsing, the declared-vs-compiled contract checker,
+the comm cost model + accidental-reshard detector), and the AST jit-hazard
+linter (rule engine, suppressions, and the package-is-clean regression
+that backs ``make lint``).
 """
 import os
 import textwrap
@@ -345,6 +347,343 @@ def test_audit_does_not_consume_training_rng():
     assert (ref == got).all(), "audit() advanced the global key stream"
 
 
+# -- sharding annotations (ISSUE 8) ------------------------------------------
+def test_parse_sharding_spellings():
+    """Every GSPMD annotation form normalizes into ShardingInfo — both the
+    compiled ``sharding={...}`` body and the lowered quoted-attr value."""
+    p = analysis.parse_sharding
+    assert p("{replicated}").is_replicated
+    assert p('"{replicated}"').kind == "replicated"   # lowered quoting
+    s = p("{devices=[4,1]<=[4]}")
+    assert s.kind == "tiled" and s.tile_dims == (4, 1)
+    assert not s.is_replicated
+    assert s.describe() == "sharded devices=[4, 1]"
+    # subgroup replication: the trailing tile dim partitions nothing
+    s = p("{devices=[4,1,2]<=[2,4]T(1,0) last_tile_dim_replicate}")
+    assert s.tile_dims == (4, 1) and s.replicate_last
+    assert p("{maximal device=0}").is_replicated     # one device holds all
+    assert p("{manual}").kind == "manual"
+    assert p("{devices=[1,1]<=[1]}").is_replicated   # all-ones tiling
+    # tuple shardings (per-element layouts) are not a single-tensor form
+    t = p("{{replicated}, {devices=[2]<=[2]}}")
+    assert t.kind == "unknown" and t.raw
+
+
+def test_hlo_parameter_shardings_parsed():
+    """Compiled-dialect parameter shardings land in arg_shardings, with
+    the balanced-brace scan surviving nested/annotated bodies."""
+    text = textwrap.dedent("""\
+        HloModule m
+
+        ENTRY %main (p0: f32[8,8], p1: f32[4], p2: f32[2,2]) -> f32[8,8] {
+          %p0 = f32[8,8]{1,0} parameter(0), sharding={devices=[4,1]<=[8] last_tile_dim_replicate}
+          %p1 = f32[4]{0} parameter(1), sharding={replicated}
+          %p2 = f32[2,2]{1,0} parameter(2)
+          ROOT %r = f32[8,8]{1,0} add(f32[8,8]{1,0} %p0, f32[8,8]{1,0} %p0)
+        }
+        """)
+    rep = analysis.audit_text(text)
+    assert rep.arg_sharding(0).tile_dims == (4,)
+    assert rep.arg_sharding(1).is_replicated
+    assert rep.arg_sharding(2) is None       # unannotated -> None
+    assert rep.sharded_inputs() == [0]
+    assert rep.summary()["sharded_inputs"] == 1
+
+
+def test_stablehlo_arg_and_op_shardings_parsed():
+    """Lowered-dialect mhlo.sharding attrs: per-arg annotations on a live
+    mesh lowering parse into arg_shardings (and per-op attrs onto Op)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from mxnet_tpu.parallel import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=8))
+
+    def f(p, x):
+        return p * x.sum()
+
+    lowered = jax.jit(
+        f, in_shardings=(NamedSharding(mesh, P()),
+                         NamedSharding(mesh, P("dp"))),
+        out_shardings=NamedSharding(mesh, P())).lower(
+            jnp.ones((4,)), jnp.ones((8, 4)))
+    rep = analysis.audit_lowered(lowered)
+    assert "mhlo.sharding" in lowered.as_text()
+    assert rep.arg_sharding(0) is not None
+    assert rep.arg_sharding(0).is_replicated
+    assert rep.arg_sharding(1) is not None
+    assert not rep.arg_sharding(1).is_replicated
+    assert rep.arg_sharding(1).tile_dims[0] == 8
+    assert rep.sharded_inputs() == [1]
+
+
+def test_replica_groups_transposed_iota():
+    """The V2 iota form GSPMD emits for a NON-trailing mesh axis:
+    ``[4,2]<=[2,4]T(1,0)`` groups device ids column-major — the dp-axis
+    groups of a dp=2 x fsdp=4 mesh, not 4 consecutive pairs."""
+    from mxnet_tpu.analysis.hlo_audit import _parse_groups
+
+    assert _parse_groups("[4,2]<=[2,4]T(1,0)") == \
+        ((0, 4), (1, 5), (2, 6), (3, 7))
+    assert _parse_groups("[2,4]<=[8]") == ((0, 1, 2, 3), (4, 5, 6, 7))
+    # malformed forms stay unparsed (raw preserved), never throw
+    assert _parse_groups("[2,4]<=[9]") is None
+    assert _parse_groups("[2,2,2]<=[8]") is None
+
+
+# -- communication cost model (ISSUE 8) ---------------------------------------
+_COMM_HLO = textwrap.dedent("""\
+    HloModule m
+
+    ENTRY %main (p0: f32[100], p1: f32[2,8], p2: f32[4,8]) -> f32[100] {
+      %p0 = f32[100]{0} parameter(0)
+      %p1 = f32[2,8]{1,0} parameter(1)
+      %p2 = f32[4,8]{1,0} parameter(2)
+      %ar = f32[100]{0} all-reduce(f32[100]{0} %p0), replica_groups={{0,1,2,3},{4,5,6,7}}, to_apply=%add
+      %ag = f32[8,8]{1,0} all-gather(f32[2,8]{1,0} %p1), replica_groups={{0,1,2,3},{4,5,6,7}}, dimensions={0}
+      %rs = f32[1,8]{1,0} reduce-scatter(f32[4,8]{1,0} %p2), replica_groups={{0,1,2,3}}, to_apply=%add
+      ROOT %r = f32[100]{0} add(f32[100]{0} %ar, f32[100]{0} %ar)
+    }
+    """)
+
+
+def test_comm_report_prices_collectives():
+    """The documented cost convention: all-reduce 2x tensor bytes,
+    all-gather shard x group span, reduce-scatter 1x the input."""
+    rep = analysis.audit_text(_COMM_HLO)
+    comm = analysis.comm_report(rep)          # no mesh: all axes "?"
+    by = {c.kind: c for c in comm.costs}
+    assert by["all_reduce"].payload_bytes == 400      # 100 x f32
+    assert by["all_reduce"].bytes == 800              # 2x factor
+    # (2,8) shard x span 4 == the full (8,8) gathered tensor
+    assert by["all_gather"].payload_bytes == 256
+    assert by["all_gather"].bytes == 256
+    assert by["reduce_scatter"].bytes == 128          # the (4,8) input
+    assert by["reduce_scatter"].payload_bytes == 128
+    assert comm.total_bytes() == 800 + 256 + 128
+    assert comm.by_axis() == {"?": comm.total_bytes()}
+    assert comm.by_kind()["all_reduce"] == 800
+    assert comm.kind_counts() == {"all_reduce": 1, "all_gather": 1,
+                                  "reduce_scatter": 1}
+    assert bool(comm)
+    assert comm.summary()["n_collectives"] == 3
+
+
+def test_stablehlo_collective_payload_ignores_group_table():
+    """The lowered dialect's ``replica_groups = dense<..> : tensor<NxMxi64>``
+    attribute carries its own tensor type — payload sizing must price the
+    operands, never the group table; the region form (types on the closing
+    line) prices 0 rather than garbage."""
+    text = textwrap.dedent("""\
+        module @m {
+          func.func public @main(%arg0: tensor<2x8xf32>) -> tensor<8x8xf32> {
+            %0 = "stablehlo.all_gather"(%arg0) {all_gather_dim = 0 : i64, replica_groups = dense<[[0, 1, 2, 3]]> : tensor<1x4xi64>} : (tensor<2x8xf32>) -> tensor<8x8xf32>
+            %1 = "stablehlo.all_reduce"(%0) <{channel_handle = #stablehlo.channel_handle<handle = 1, type = 1>, replica_groups = dense<[[0, 1, 2, 3, 4, 5, 6, 7]]> : tensor<1x8xi64>, use_global_device_ids}> ({
+            ^bb0(%a: tensor<f32>, %b: tensor<f32>):
+              "stablehlo.return"(%a) : (tensor<f32>) -> ()
+            }) : (tensor<8x8xf32>) -> tensor<8x8xf32>
+            return %1 : tensor<8x8xf32>
+          }
+        }
+        """)
+    rep = analysis.audit_text(text)
+    ag, ar = rep.collectives
+    assert ag.name == "all_gather" and ag.group_size == 4
+    assert ag.operand_info == (("f32", (2, 8)),)
+    assert "i64" not in ag.dtypes                 # the table is not a tensor
+    comm = analysis.comm_report(rep)
+    by = {c.kind: c for c in comm.costs}
+    assert by["all_gather"].payload_bytes == 256  # (2,8) f32 shard x 4
+    # region form: groups still parse, payload best-effort 0 — NOT the
+    # 32-byte i64 table priced as an all-reduce
+    assert ar.groups == ((0, 1, 2, 3, 4, 5, 6, 7),)
+    assert by["all_reduce"].payload_bytes == 0
+
+
+def test_comm_report_axis_attribution():
+    """Replica groups resolve onto mesh axes: groups whose device
+    coordinates vary along dp land under "dp", groups varying along fsdp
+    under "fsdp" — so per-axis byte budgets are structural."""
+    from mxnet_tpu.parallel import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=4))
+    text = textwrap.dedent("""\
+        HloModule m
+
+        ENTRY %main (p0: f32[16], p1: f32[16]) -> f32[16] {
+          %p0 = f32[16]{0} parameter(0)
+          %p1 = f32[16]{0} parameter(1)
+          %a = f32[16]{0} all-reduce(f32[16]{0} %p0), replica_groups=[4,2]<=[2,4]T(1,0), to_apply=%add
+          %b = f32[16]{0} all-reduce(f32[16]{0} %p1), replica_groups={{0,1,2,3},{4,5,6,7}}, to_apply=%add
+          ROOT %r = f32[16]{0} add(f32[16]{0} %a, f32[16]{0} %b)
+        }
+        """)
+    comm = analysis.comm_report(analysis.audit_text(text), mesh)
+    assert [c.axes for c in comm.costs] == [("dp",), ("fsdp",)]
+    assert comm.by_axis() == {"dp": 128, "fsdp": 128}   # 2 x 64 bytes each
+
+
+def test_accidental_reshard_detector():
+    """An all-gather whose full result matches a declared-sharded tensor's
+    global shape is flagged — unless it is an intended compute gather."""
+    from jax.sharding import PartitionSpec as P
+
+    rep = analysis.audit_text(_COMM_HLO)
+    declared = {"w": P("fsdp", None), "b": P(None)}
+    shapes = {"w": (8, 8), "b": (100,)}
+    flagged = analysis.detect_accidental_reshards(rep, declared, shapes)
+    assert len(flagged) == 1 and flagged[0].param == "w"
+    assert "fully materializes" in str(flagged[0])
+    assert flagged[0].bytes == 256
+    # the intended ZeRO compute gathers are exempt
+    assert analysis.detect_accidental_reshards(
+        rep, declared, shapes, intended={"w"}) == []
+    # a replicated declaration is never a reshard (nothing to preserve)
+    assert analysis.detect_accidental_reshards(
+        rep, {"b": P(None)}, {"b": (8, 8)}) == []
+    # shape shared between an intended and a non-intended tensor is
+    # ambiguous: skipped, so the intended gather never flags its twin
+    twin = {"w": P("fsdp", None), "w2": P("tp", None)}
+    tshapes = {"w": (8, 8), "w2": (8, 8)}
+    assert analysis.detect_accidental_reshards(
+        rep, twin, tshapes, intended={"w"}) == []
+    # with a mesh the gather's OPERAND must be the declared shard shape:
+    # P('fsdp', None) on fsdp=4 shards (8,8) into (2,8) — matches the
+    # program's gather, still flagged...
+    from mxnet_tpu.parallel import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=4))
+    hit = analysis.detect_accidental_reshards(
+        rep, declared, shapes, mesh=mesh)
+    assert [r.param for r in hit] == ["w"]
+    # ...but a declaration whose shard shape is (4,8) does NOT own this
+    # gather (a same-result-shape coincidence, e.g. an activation)
+    assert analysis.detect_accidental_reshards(
+        rep, {"w": P("dp", None)}, shapes, mesh=mesh) == []
+
+
+# -- sharding contract checker (ISSUE 8) --------------------------------------
+def test_expected_tiles():
+    from jax.sharding import PartitionSpec as P
+
+    shape = {"dp": 2, "fsdp": 4, "tp": 1}
+    assert analysis.expected_tiles(P("fsdp", None), 2, shape) == (4, 1)
+    assert analysis.expected_tiles(P(None, ("dp", "fsdp")), 2, shape) == \
+        (1, 8)
+    # spec shorter than rank pads with 1s; size-1 axes partition nothing
+    assert analysis.expected_tiles(P("tp"), 3, shape) == (1, 1, 1)
+    # an axis the mesh does not have: un-realizable intent
+    assert analysis.expected_tiles(P("ghost"), 1, shape) is None
+
+
+def test_check_contract_synthetic():
+    """Declared-vs-compiled diffs over a synthetic compiled program: a
+    matching tiled layout passes, a replicated-where-declared-sharded
+    param is reported in the ``declared → compiled`` rendering."""
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=4))
+    text = textwrap.dedent("""\
+        HloModule m
+
+        ENTRY %main (p0: f32[8,8], p1: f32[4]) -> f32[8,8] {
+          %p0 = f32[8,8]{1,0} parameter(0), sharding={devices=[4,1,2]<=[2,4]T(1,0) last_tile_dim_replicate}
+          %p1 = f32[4]{0} parameter(1)
+          ROOT %r = f32[8,8]{1,0} add(f32[8,8]{1,0} %p0, f32[8,8]{1,0} %p0)
+        }
+        """)
+    rep = analysis.audit_text(text)
+    shapes = {"w": (8, 8), "b": (4,)}
+    order = {"w": 0, "b": 1}
+    # intent matches the compiled layout: no violations
+    ok = analysis.check_contract(
+        rep, {"w": P("fsdp", None), "b": P(None)}, shapes, order, mesh)
+    assert ok == []
+    # w declared on dp (2 shards) but compiled with 4; b fine
+    vs = analysis.check_contract(
+        rep, {"w": P("dp", None), "b": P(None)}, shapes, order, mesh)
+    assert len(vs) == 1
+    assert str(vs[0]) == \
+        "w: declared P('dp', None) → compiled sharded devices=[4, 1]"
+    # b declared sharded but compiled without any annotation (replicated)
+    vs = analysis.check_contract(
+        rep, {"b": P("fsdp")}, shapes, {"b": 1}, mesh)
+    assert str(vs[0]) == "b: declared P('fsdp') → compiled replicated"
+    # declaring a size-1 axis legitimately compiles replicated: no report
+    assert analysis.check_contract(
+        rep, {"b": P("tp")}, shapes, {"b": 1}, mesh) == []
+    # an axis the mesh lacks is ALWAYS a violation, even vs replicated
+    vs = analysis.check_contract(
+        rep, {"b": P("ghost")}, shapes, {"b": 1}, mesh)
+    assert len(vs) == 1 and "P('ghost')" in vs[0].declared
+
+
+def test_train_step_audit_fsdp_contract_and_comm():
+    """ISSUE 8 acceptance: on a 4-device fsdp mesh the audit reports ZERO
+    sharding-contract violations, a non-empty CommReport with the ZeRO
+    traffic attributed to mesh axes, and no accidental reshards."""
+    from mxnet_tpu.parallel import MeshConfig, ShardingRules, make_mesh
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = nd.ones((8, 16))
+    _ = net(x)
+    mesh = make_mesh(MeshConfig(fsdp=4))
+    rules = ShardingRules(fsdp_axis="fsdp", min_fsdp_size=1)
+    ts = TrainStep(net, lambda out, *l: ((out - l[0]) ** 2).mean(),
+                   opt.Adam(learning_rate=1e-3), mesh=mesh, rules=rules)
+    audit = ts.audit(x, nd.zeros((8, 8)))
+    assert audit.contract == [], [str(v) for v in audit.contract]
+    comm = audit.comm
+    assert comm is not None and bool(comm), "empty CommReport on a mesh"
+    assert comm.reshards == [], [str(r) for r in comm.reshards]
+    # the ZeRO pattern: compute all-gathers + grad reductions, every
+    # priced byte attributed to a real mesh axis (nothing under "?")
+    assert comm.kind_counts().get("all_gather", 0) >= 1
+    assert comm.kind_counts().get("all_reduce", 0) >= 1
+    assert "fsdp" in comm.by_axis() and "?" not in comm.by_axis()
+    assert audit.summary()["comm"]["total_bytes"] == comm.total_bytes()
+    assert audit.summary()["contract"] == []
+
+
+def test_train_step_audit_catches_misspecced_rule():
+    """ISSUE 8 acceptance: a deliberately mis-specced rule (typo'd axis
+    name — spec_for silently falls back to replicated) is caught with the
+    ``declared → compiled`` diff."""
+    from mxnet_tpu.parallel import MeshConfig, ShardingRules, make_mesh
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = nd.ones((8, 16))
+    _ = net(x)
+    mesh = make_mesh(MeshConfig(fsdp=4))
+    bad = ShardingRules(rules=[("weight", ("fsdq", None))])   # typo'd axis
+    ts = TrainStep(net, lambda out, *l: ((out - l[0]) ** 2).mean(),
+                   opt.Adam(learning_rate=1e-3), mesh=mesh, rules=bad)
+    audit = ts.audit(x, nd.zeros((8, 8)))
+    msgs = [str(v) for v in audit.contract]
+    assert len(msgs) == 2, msgs                    # both dense weights
+    for m in msgs:
+        assert "declared P('fsdq', None) → compiled replicated" in m
+    # the rules= override audits an alternative declaration against the
+    # SAME compiled program (what shardcheck uses for what-if checks)
+    good = ShardingRules(fsdp_axis="fsdp", min_fsdp_size=1)
+    ts2 = TrainStep(net, lambda out, *l: ((out - l[0]) ** 2).mean(),
+                    opt.Adam(learning_rate=1e-3), mesh=mesh, rules=good)
+    vs = ts2.audit(x, nd.zeros((8, 8)), rules=bad).contract
+    # every param diffs: the weights' typo'd intent vs the compiled fsdp
+    # layout, and the biases' implied-replicated intent vs their compiled
+    # fsdp-fallback sharding
+    weight_vs = [v for v in vs if "weight" in v.param]
+    assert weight_vs and all(
+        "declared P('fsdq', None) → compiled sharded" in str(v)
+        for v in weight_vs)
+
+
 # -- astlint: rules ----------------------------------------------------------
 HOT_SRC = textwrap.dedent("""\
     import time
@@ -519,6 +858,81 @@ def test_lint_registered_extra_hot_paths():
     vs = astlint.lint_source(src, "mxnet_tpu/parallel/train_step.py")
     assert _rules(vs) == ["JH001"]
     assert astlint.lint_source(src, "mxnet_tpu/parallel/other.py") == []
+
+
+def test_lint_unknown_mesh_axis_jh006():
+    """ISSUE 8 satellite: axis-name literals outside the MeshConfig
+    vocabulary at PartitionSpec/named_sharding call sites — a typo'd axis
+    silently replicates the tensor."""
+    src = textwrap.dedent("""\
+        from jax.sharding import PartitionSpec as P
+
+        def specs(mesh):
+            a = P("fsdq", None)               # JH006: typo'd axis
+            b = P("dp", "fsdp")               # ok
+            c = P(("dp", "fsdpp"))            # JH006: inside a tuple entry
+            d = named_sharding(mesh, "tpp")   # JH006 (mesh arg skipped)
+            e = PartitionSpec(None, "ep")     # ok
+            f = P(axis)                       # ok: not a literal
+            return a, b, c, d, e, f
+        """)
+    vs = astlint.lint_source(src, "mxnet_tpu/x.py")
+    assert _rules(vs) == ["JH006", "JH006", "JH006"]
+    assert sorted(v.line for v in vs) == [4, 6, 7]
+    assert "fsdq" in [v for v in vs if v.line == 4][0].message
+    # inline-suppressible like JH001-JH005
+    sup = 'P("fsdq")  # lint: disable=JH006\n'
+    assert astlint.lint_source(sup, "mxnet_tpu/x.py") == []
+    # the vocabulary pins to parallel.mesh.AXES — update both together
+    from mxnet_tpu.parallel.mesh import AXES
+
+    assert astlint._MESH_AXES == frozenset(AXES)
+
+
+def test_lint_changed_diffs_merge_base(tmp_path):
+    """ISSUE 8 satellite: --changed diffs against the merge-base of main,
+    so a pre-commit run late in a branch still sees the files committed
+    earlier ON that branch (the old vs-HEAD diff saw only the dirty
+    tree)."""
+    import importlib.util
+    import subprocess
+
+    spec = importlib.util.spec_from_file_location(
+        "lintcli", os.path.join(os.path.dirname(PKG_DIR), "tools",
+                                "lint.py"))
+    lintcli = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lintcli)
+
+    repo = tmp_path / "r"
+    (repo / "mxnet_tpu").mkdir(parents=True)
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=repo, check=True,
+                       capture_output=True, text=True)
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    git("checkout", "-q", "-b", "main")
+    (repo / "mxnet_tpu" / "old.py").write_text("def f(x=()):\n    return x\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    git("checkout", "-q", "-b", "feature")
+    (repo / "mxnet_tpu" / "committed.py").write_text("x = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "branch work")
+    (repo / "mxnet_tpu" / "untracked.py").write_text("y = 2\n")
+    (repo / "elsewhere.py").write_text("z = 3\n")   # outside linted trees
+
+    names = {os.path.basename(f)
+             for f in lintcli._changed_files(repo=str(repo))}
+    # the branch's committed file IS seen (the fix), untracked still is,
+    # the unchanged seed file and out-of-tree files are not
+    assert names == {"committed.py", "untracked.py"}
+    # on main itself the merge-base degrades to HEAD: nothing changed
+    (repo / "mxnet_tpu" / "untracked.py").unlink()
+    git("checkout", "-q", "main")
+    assert lintcli._changed_files(repo=str(repo)) == []
 
 
 def test_package_is_lint_clean():

@@ -124,6 +124,33 @@ _CHILD4 = textwrap.dedent("""
     assert obs.REGISTRY.get("kv_psum_dtype_buckets_total").value(dtype="float32") == 4
     obs.shutdown()
 
+    # --- 7. sharding/comm audit on the REAL 4-process dp mesh (ISSUE 8):
+    # zero contract violations, and the dp gradient all-reduce spans ONLY
+    # the dp axis moving exactly 2 x (param + loss) bytes ------------------
+    from mxnet_tpu import optimizer
+    from mxnet_tpu.parallel import MeshConfig, TrainStep, make_mesh
+
+    mesh = make_mesh(MeshConfig(dp=4))
+    mx.random.seed(3)
+    anet = nn.HybridSequential()
+    anet.add(nn.Dense(5, in_units=3), nn.Dense(2, in_units=5))
+    anet.initialize()
+    ats = TrainStep(anet, lambda o, y: ((o - y) ** 2).mean(),
+                    optimizer.SGD(learning_rate=0.1), mesh=mesh)
+    audit = ats.audit(nd.ones((4, 3)), nd.zeros((4, 2)))
+    assert audit.contract == [], [str(v) for v in audit.contract]
+    comm = audit.comm
+    assert comm and comm.costs, "empty CommReport on the dp mesh"
+    ars = [c for c in comm.costs if c.kind == "all_reduce"]
+    assert ars, comm.summary()
+    assert all(c.axes == ("dp",) for c in ars), \
+        [(c.kind, c.axes) for c in comm.costs]
+    param_bytes = sum(int(np.prod(v.shape)) * 4 for v in ats.params.values())
+    want = 2 * (param_bytes + 4)   # grads + the scalar loss psum
+    got = sum(c.bytes for c in ars)
+    assert got == want, (got, want, comm.summary())
+    assert comm.by_axis() == {"dp": got}, comm.by_axis()
+
     print(f"RANK{rank}-OK4", flush=True)
 """)
 

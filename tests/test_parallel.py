@@ -32,6 +32,53 @@ def test_sharding_rules_tp_patterns():
     assert spec == P()
 
 
+def test_sharding_rules_fits_edge_cases():
+    """ISSUE 8 satellite: _fits is a total predicate — uneven axis
+    divisibility, rank-shorter-than-spec, tuple-axis products, and a spec
+    naming an axis the mesh lacks all answer False (spec_for then falls
+    back), never raise."""
+    from mxnet_tpu.parallel.sharding import ShardingRules, _fits
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=4))
+    # uneven divisibility: 6 % fsdp(4) != 0
+    assert not _fits(("fsdp", None), (6, 4), mesh)
+    assert _fits(("fsdp", None), (8, 4), mesh)
+    # a spec naming a missing mesh axis answers False, not KeyError
+    assert not _fits(("nope", None), (8, 4), mesh)
+    # tuple entries multiply the axis sizes
+    assert _fits((("dp", "fsdp"), None), (8, 4), mesh)
+    assert not _fits((("dp", "fsdp"),), (12,), mesh)    # 12 % 8
+    assert not _fits((("dp", "ghost"),), (8,), mesh)    # missing in tuple
+    # spec longer than the rank only constrains the dims that exist
+    assert _fits(("dp", "fsdp", "tp"), (2,), mesh)
+    # None entries constrain nothing
+    assert _fits((None, None), (7, 13), mesh)
+
+    # spec_for: a rule with a typo'd axis falls back to REPLICATED (the
+    # contract checker + JH006 report it; tracing must not crash)
+    rules = ShardingRules(rules=[("weight", ("ghost", None))])
+    assert rules.spec_for("dense0_weight", (8, 4), mesh) == P()
+    # ...while the declared intent keeps the raw (broken) spec
+    assert tuple(rules.declared_spec_for(
+        "dense0_weight", (8, 4), mesh)) == ("ghost", None)
+    # rank shorter than the rule's spec: truncated, not an IndexError
+    r2 = ShardingRules(rules=[("bias", (None, "fsdp"))])
+    assert r2.spec_for("dense0_bias", (8,), mesh) == P(None)
+    # the fsdp fallback is skipped entirely on a mesh without that axis
+    # (make_mesh always carries all six axes; a hand-built Mesh may not)
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    r3 = ShardingRules(fsdp_axis="fsdp", min_fsdp_size=1)
+    dp_only = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    assert r3.spec_for("w", (8, 8), dp_only) == P()
+    # and picks the largest divisible dim when the axis exists
+    assert r3.spec_for("w", (6, 8), mesh) == P(None, "fsdp")
+    # no divisible dim at all: replicated, not a crash
+    assert r3.spec_for("w", (7, 13), mesh) == P()
+
+
 def test_train_step_dp_matches_single_device():
     """DP over the mesh must produce the same params as single-device."""
     def build():

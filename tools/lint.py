@@ -9,8 +9,10 @@ Usage::
 
     python tools/lint.py                  # lint mxnet_tpu/ + tools/
     python tools/lint.py path [path ...]  # specific files/trees
-    python tools/lint.py --changed        # only files changed vs git HEAD
-                                          # (staged, unstaged + untracked)
+    python tools/lint.py --changed        # only files changed vs the
+                                          # merge-base of main (committed
+                                          # on the branch + staged +
+                                          # unstaged + untracked)
     python tools/lint.py --list-rules     # rule catalog
 
 Exit status: 0 clean, 1 violations, 2 usage/environment error. Suppression
@@ -27,27 +29,47 @@ sys.path.insert(0, REPO)
 DEFAULT_PATHS = ["mxnet_tpu", "tools"]
 
 
-def _changed_files():
-    """Python files changed vs HEAD (staged + unstaged + untracked), kept
-    to the trees the full gate lints — --changed must be a strict subset
-    of `make lint`, never stricter (a jitted `.item()` oracle in tests/
-    is legitimate there and unlinted by CI)."""
+def _merge_base(repo):
+    """The merge-base of HEAD and the main branch — the point the branch
+    forked from. Falls back through origin/main and master spellings;
+    HEAD (the old vs-HEAD behavior, exact on main itself) when no main
+    ref exists at all."""
+    for ref in ("main", "origin/main", "master", "origin/master"):
+        r = subprocess.run(["git", "merge-base", "HEAD", ref], cwd=repo,
+                          capture_output=True, text=True)
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip()
+    return "HEAD"
+
+
+def _changed_files(repo=REPO):
+    """Python files changed vs the merge-base of ``main`` — committed on
+    the branch, staged, and unstaged (``git diff`` against the merge-base
+    covers all three) plus untracked — kept to the trees the full gate
+    lints: --changed must be a strict subset of `make lint`, never
+    stricter (a jitted `.item()` oracle in tests/ is legitimate there and
+    unlinted by CI). Diffing against HEAD (the old behavior) missed
+    everything already committed on a feature branch, so a pre-commit run
+    late in a branch saw almost nothing."""
     try:
-        out = subprocess.run(
-            ["git", "status", "--porcelain"], cwd=REPO,
+        base = _merge_base(repo)
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", base, "--"], cwd=repo,
+            capture_output=True, text=True, check=True).stdout
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"], cwd=repo,
             capture_output=True, text=True, check=True).stdout
     except (OSError, subprocess.CalledProcessError) as e:
         print(f"lint: --changed needs git ({e})", file=sys.stderr)
         raise SystemExit(2)
     files = []
-    for line in out.splitlines():
-        # porcelain: XY <path> (or `XY old -> new` for renames)
-        path = line[3:].split(" -> ")[-1].strip().strip('"')
+    for path in diff.splitlines() + untracked.splitlines():
+        path = path.strip().strip('"')
         if path.endswith(".py") \
                 and any(path.startswith(p + "/") for p in DEFAULT_PATHS) \
-                and os.path.exists(os.path.join(REPO, path)):
-            files.append(os.path.join(REPO, path))
-    return files
+                and os.path.exists(os.path.join(repo, path)):
+            files.append(os.path.join(repo, path))
+    return sorted(set(files))
 
 
 def main(argv=None):
