@@ -29,9 +29,9 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity lint audit shardcheck native fast slow test chaos chaos-elastic obs perfwin genbench ampbench bench clean
+.PHONY: ci sanity lint audit shardcheck native fast slow test chaos chaos-elastic obs obsfleet perfwin genbench ampbench bench clean
 
-ci: sanity lint native fast audit shardcheck chaos-elastic
+ci: sanity lint native fast audit shardcheck chaos-elastic obsfleet
 
 sanity:
 	$(PY) -m compileall -q mxnet_tpu tools tests examples bench.py __graft_entry__.py
@@ -92,6 +92,15 @@ chaos-elastic: native
 # checkpoint durations, and retry counters that match attempt_log
 obs: native
 	$(PY) tools/obs_smoke.py
+
+# fleet observability gate (docs/OBSERVABILITY.md "Fleet view"): a
+# 4-process launch whose rank 2 is SIGSTOPped mid-run must be flagged as a
+# straggler by the fleet aggregator (and surfaced in the supervisor log),
+# and the elastic chaos drill's merged fleet report must attribute the
+# re-formation interval to downtime — goodput buckets summing to wall time
+# (±1%) with a nonzero reformation bucket
+obsfleet: native
+	$(PY) -m pytest tests/test_launch_dist.py -q -k "fleet"
 
 # fused multi-step window gate (docs/PERFORMANCE.md): CPU dry-run of the
 # compiled k-step scan window on a LeNet — asserts ONE window lowering,

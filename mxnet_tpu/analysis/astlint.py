@@ -103,6 +103,15 @@ EXTRA_HOT_PATHS: Dict[str, Tuple[str, ...]] = {
         "HeartbeatMonitor.check", "HeartbeatMonitor.stale_peers",
         "HeartbeatMonitor.beat", "ElasticContext.check",
     ),
+    # fleet telemetry snapshot writer: maybe_snapshot runs at every step
+    # boundary of an elastic run (throttled, but the gate itself is hot);
+    # snapshot/_write also fire from the cadence thread concurrent with
+    # training
+    "observability/fleet.py": (
+        "FleetSnapshotter.maybe_snapshot", "FleetSnapshotter.snapshot",
+        "FleetSnapshotter._write", "FleetSnapshotter._copy_events",
+        "FleetSnapshotter._append_range",
+    ),
 }
 
 # function names that wrap a python callable into a compiled/traced one

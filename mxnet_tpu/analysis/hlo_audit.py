@@ -158,6 +158,12 @@ class Op:
     line: int
     shapes: Tuple[Tuple[int, ...], ...] = ()  # shapes paired with `dtypes`
     sharding: Optional[ShardingInfo] = None  # per-op sharding annotation
+    # dot/convolution contraction structure (both dialects), feeding the
+    # analytic FLOPs model (observability.goodput.program_flops):
+    #   dot_general:  {"lhs_contracting": (dims,), "lhs_batching": (dims,)}
+    #   convolution:  {"kernel_out_dim": i, "batch_groups": g}
+    # None for every other op, or when the attributes could not be parsed.
+    dot_meta: Optional[dict] = None
 
     def __repr__(self):
         dims = "x".join(map(str, self.shape)) or "scalar"
@@ -349,6 +355,61 @@ def _parse_groups(raw: str) -> Optional[Tuple[Tuple[int, ...], ...]]:
                 groups.append(tuple(ids))
         return tuple(groups) or None
     return None
+
+
+# -- dot/conv contraction attributes (FLOPs model inputs) --------------------
+# stablehlo pretty form: `contracting_dims = [1] x [0]`, `batching_dims =
+# [0] x [0]`; generic form: `lhs_contracting_dimensions = [1]` inside a
+# #stablehlo.dot<...> attribute
+_DOT_CONTRACT_MLIR = re.compile(
+    r"contracting_dims\s*=\s*\[([0-9,\s]*)\]\s*x\s*\[[0-9,\s]*\]")
+_DOT_BATCH_MLIR = re.compile(
+    r"batching_dims\s*=\s*\[([0-9,\s]*)\]\s*x\s*\[[0-9,\s]*\]")
+_DOT_CONTRACT_GENERIC = re.compile(
+    r"lhs_contracting_dimensions\s*=\s*\[([0-9,\s]*)\]")
+_DOT_BATCH_GENERIC = re.compile(
+    r"lhs_batching_dimensions\s*=\s*\[([0-9,\s]*)\]")
+# compiled HLO: `lhs_contracting_dims={1}`, `lhs_batch_dims={0}`
+_DOT_CONTRACT_HLO = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+_DOT_BATCH_HLO = re.compile(r"lhs_batch_dims=\{([0-9,]*)\}")
+# convolution kernel layout: stablehlo `dim_numbers = [b, f, 1, 0]x[o, i,
+# 1, 0]->[...]` / HLO `dim_labels=bf01_oi01->bf01`; the position of `o` in
+# the kernel spec is the output-feature dim of the rhs
+_CONV_KERNEL_MLIR = re.compile(r"x\[([^\]]*)\]\s*->")
+_CONV_LABELS_HLO = re.compile(r"dim_labels=[^_\s,]+_([^-\s,]+)->")
+_GROUP_COUNT = re.compile(r"batch_group_count\s*=\s*(\d+)")
+
+
+def _ints(csv: str) -> Tuple[int, ...]:
+    return tuple(int(t) for t in re.findall(r"\d+", csv))
+
+
+def _dot_meta(line: str, dialect: str) -> Optional[dict]:
+    if dialect == "stablehlo":
+        cm = _DOT_CONTRACT_MLIR.search(line) or \
+            _DOT_CONTRACT_GENERIC.search(line)
+        bm = _DOT_BATCH_MLIR.search(line) or _DOT_BATCH_GENERIC.search(line)
+    else:
+        cm = _DOT_CONTRACT_HLO.search(line)
+        bm = _DOT_BATCH_HLO.search(line)
+    if cm is None:
+        return None
+    return {"lhs_contracting": _ints(cm.group(1)),
+            "lhs_batching": _ints(bm.group(1)) if bm else ()}
+
+
+def _conv_meta(line: str, dialect: str) -> Optional[dict]:
+    if dialect == "stablehlo":
+        km = _CONV_KERNEL_MLIR.search(line)
+        labels = [t.strip() for t in km.group(1).split(",")] if km else []
+    else:
+        km = _CONV_LABELS_HLO.search(line)
+        labels = list(km.group(1)) if km else []
+    if "o" not in labels:
+        return None
+    gm = _GROUP_COUNT.search(line)
+    return {"kernel_out_dim": labels.index("o"),
+            "batch_groups": int(gm.group(1)) if gm else 1}
 
 
 def _mlir_line_op(line: str) -> Optional[str]:
@@ -559,8 +620,13 @@ def _parse_stablehlo(text: str) -> ProgramReport:
             collectives.append(c)
             ops.append(c)
             continue
+        meta = None
+        if name in ("dot_general", "dot"):
+            meta = _dot_meta(s, "stablehlo")
+        elif name == "convolution":
+            meta = _conv_meta(s, "stablehlo")
         ops.append(Op(name, rdt, rshape, dtypes, i, shapes=shapes,
-                      sharding=op_sharding))
+                      sharding=op_sharding, dot_meta=meta))
     sig = " ".join(sig_buf)
     matches = list(_MLIR_ARG.finditer(sig))
     for k, m in enumerate(matches):
@@ -660,8 +726,13 @@ def _parse_hlo(text: str) -> ProgramReport:
             collectives.append(c)
             ops.append(c)
             continue
+        meta = None
+        if name in ("dot_general", "dot"):
+            meta = _dot_meta(s, "hlo")
+        elif name == "convolution":
+            meta = _conv_meta(s, "hlo")
         ops.append(Op(name, rdt, rshape, dtypes, i, shapes=shapes,
-                      sharding=op_sharding))
+                      sharding=op_sharding, dot_meta=meta))
     n_inputs = (max(entry_params) + 1) if entry_params else 0
     for idx in range(n_inputs):
         inputs.append(entry_params.get(idx, ("?", ())))

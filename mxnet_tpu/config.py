@@ -110,6 +110,22 @@ _KNOBS: Dict[str, tuple] = {
     "telemetry_rotate_mb": (int, 64, ("MXNET_TPU_TELEMETRY_ROTATE_MB",),
                             "event-log rotation threshold per file (one .1 "
                             "predecessor is kept)"),
+    # -- fleet observability (docs/OBSERVABILITY.md "Fleet view") ------------
+    "fleet_dir": (str, "", ("MXNET_TPU_FLEET_DIR",),
+                  "shared directory for cross-rank telemetry snapshots "
+                  "(telemetry-h{rank}/ per rank, same contract as the "
+                  "elastic heartbeat dir); empty = fleet snapshots off"),
+    "fleet_snapshot_interval": (float, 5.0,
+                                ("MXNET_TPU_FLEET_SNAPSHOT_INTERVAL",),
+                                "seconds between per-rank fleet telemetry "
+                                "snapshots"),
+    "straggler_factor": (float, 3.0, ("MXNET_TPU_STRAGGLER_FACTOR",),
+                         "a rank whose step / collective-wait time exceeds "
+                         "the fleet median by this factor is flagged as a "
+                         "straggler"),
+    "peak_flops": (float, 0.0, ("MXNET_TPU_PEAK_FLOPS",),
+                   "accelerator peak FLOP/s per process for train_mfu "
+                   "(e.g. 1.97e14 for one v5e chip); 0 = MFU not computed"),
 }
 
 _values: Dict[str, Any] = {}

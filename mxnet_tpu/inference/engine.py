@@ -285,6 +285,11 @@ class GenerationEngine:
             _obs.histogram("gen_decode_step_seconds",
                            "one compiled decode step wall clock",
                            unit="s").observe(dt)
+            # slot utilization of this step: fraction of the static batch
+            # that decoded real tokens (the fleet report's serving rollup)
+            _obs.gauge("gen_slot_utilization",
+                       "fraction of decode slots active this step").set(
+                           float(active_in.sum()) / self.batch_size)
         return tok, done, logits
 
     def audit(self, bucket: Optional[int] = None, compile: bool = True):

@@ -36,14 +36,16 @@ from contextlib import contextmanager
 from typing import Optional
 
 from . import events  # noqa: F401
+from . import goodput  # noqa: F401
 from . import metrics  # noqa: F401
 from .events import emit, read_events, set_step  # noqa: F401
 from .metrics import REGISTRY, counter, gauge, histogram  # noqa: F401
+from . import fleet  # noqa: F401  (imports events/metrics/goodput above)
 
 __all__ = ["metrics", "events", "REGISTRY", "counter", "gauge", "histogram",
            "emit", "set_step", "read_events", "enabled", "enable", "disable",
            "shutdown", "span", "timed_region", "telemetry_dir",
-           "throughput_delta"]
+           "throughput_delta", "fleet", "goodput"]
 
 
 def throughput_delta(prev):
@@ -107,6 +109,10 @@ def enable(directory: Optional[str] = None, run_id: Optional[str] = None) -> str
         atexit.register(shutdown)
         _atexit_registered = True
     events.emit("telemetry_enabled", dir=_dir)
+    # fleet view (docs/OBSERVABILITY.md "Fleet view"): when a shared fleet
+    # directory is configured (MXNET_TPU_FLEET_DIR — the elastic supervisor
+    # exports it), start the per-rank snapshot writer alongside telemetry
+    fleet.ensure_snapshotter()
     return _dir
 
 
@@ -123,6 +129,9 @@ def shutdown() -> None:
     Idempotent; registered atexit by :func:`enable`."""
     if _dir is None:
         return
+    # final fleet snapshot BEFORE the event log closes (the snapshot
+    # copies the event files; a clean exit must land its tail)
+    fleet.shutdown_snapshotter()
     host = events._host_index()
     suffix = f"-h{host}" if host else ""
     try:

@@ -31,13 +31,23 @@ __all__ = ["EventLog", "LOG", "emit", "set_step", "configure", "close",
            "read_events", "current_step"]
 
 
-def _host_index() -> int:
-    try:
-        import jax
+_host_index_cache = None
 
-        return jax.process_index()
-    except Exception:
-        return 0
+
+def _host_index() -> int:
+    # cached: emit() stamps every record with the host index, and
+    # jax.process_index() costs tens of microseconds per call — the bulk
+    # of the per-event budget (a process's index never changes once the
+    # distributed runtime is up; before that it is 0 either way)
+    global _host_index_cache
+    if _host_index_cache is None:
+        try:
+            import jax
+
+            _host_index_cache = int(jax.process_index())
+        except Exception:
+            return 0
+    return _host_index_cache
 
 
 class EventLog:
@@ -46,6 +56,7 @@ class EventLog:
         self._path: Optional[str] = None
         self._run_id: Optional[str] = None
         self._rotate_bytes = 64 * 1024 * 1024
+        self._size = 0
         self._step = 0
         self._lock = threading.Lock()
 
@@ -58,6 +69,9 @@ class EventLog:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             self._path = path
             self._fh = open(path, "a", buffering=1)  # line-buffered
+            # size tracked in-process: a tell() per emit is a syscall the
+            # per-event budget can't afford
+            self._size = self._fh.tell()
             self._run_id = run_id or f"{int(time.time())}-{os.getpid()}"
             if rotate_bytes is not None:
                 self._rotate_bytes = int(rotate_bytes)
@@ -105,6 +119,7 @@ class EventLog:
                 return False
             try:
                 self._fh.write(line + "\n")
+                self._size += len(line) + 1
                 self._maybe_rotate()
             except (OSError, ValueError):
                 # telemetry must NEVER fail the train loop: on a dead disk/
@@ -124,7 +139,7 @@ class EventLog:
         return True
 
     def _maybe_rotate(self) -> None:
-        if self._fh.tell() < self._rotate_bytes:
+        if self._size < self._rotate_bytes:
             return
         try:
             self._fh.close()
@@ -133,6 +148,7 @@ class EventLog:
             # reopen even if the rename failed (truncation beats a closed
             # handle); a reopen failure propagates to emit()'s guard above
             self._fh = open(self._path, "a", buffering=1)
+            self._size = self._fh.tell()
 
 
 def _json_fallback(o):

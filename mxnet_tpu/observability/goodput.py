@@ -1,0 +1,258 @@
+"""Goodput accounting + analytic FLOPs/MFU model (docs/OBSERVABILITY.md
+"Fleet view").
+
+Two independent pieces that the fleet report composes:
+
+  - the **goodput ledger** — classify every interval of a run's wall clock
+    into a small, exhaustive taxonomy (productive training, checkpoint
+    save, restore, re-formation downtime, data stall, idle) from the
+    events the subsystems already emit. The ledger is a boundary sweep
+    over the classified intervals, so the buckets partition wall time
+    exactly: ``sum(buckets) == wall`` by construction, and
+    ``goodput = train / wall``;
+
+  - the **FLOPs model** — price every dot-like op of a
+    :class:`~mxnet_tpu.analysis.ProgramReport` from its parsed
+    contraction structure ("Operator Fusion in XLA", arXiv:2301.13062:
+    op-level cost accounting as the substrate for optimization
+    decisions). ``TrainStep`` uses it to export model FLOPs/step and —
+    against the ``peak_flops`` config knob (``MXNET_TPU_PEAK_FLOPS``) —
+    the ``train_mfu`` gauge.
+
+Cost convention (dot-like ops only — elementwise traffic is not model
+FLOPs):
+
+  ============  =========================================================
+  dot_general   2 x prod(result shape) x prod(lhs contracted dim sizes)
+  convolution   2 x prod(result shape) x prod(kernel) / kernel_out_dim
+                / batch_group_count  (= multiply-accumulates per output
+                element; feature groups already fold into the kernel's
+                input-feature dim)
+  ============  =========================================================
+
+Dots whose contraction attributes could not be parsed (or parsed
+inconsistently with the operand shapes) fall back to the sqrt-derived
+contracted size (exact for unbatched dots, approximate for batched ones)
+and are counted in ``FlopsEstimate.n_approx``; a convolution whose kernel
+layout could not be parsed has no usable fallback and is counted in
+``FlopsEstimate.n_unpriced`` (contributing zero — the estimate is then a
+lower bound).
+
+A ``lax.scan`` body appears ONCE in the program text, so the census of a
+fused k-step window program is the FLOPs of one step (one microbatch when
+``accum`` > 1) — callers multiply back up (``TrainStep`` does).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["FlopsEstimate", "op_flops", "program_flops",
+           "GoodputReport", "classify_events", "goodput_ledger",
+           "GOODPUT_CATEGORIES"]
+
+_DOT_LIKE = ("dot_general", "dot", "convolution")
+
+
+# -- FLOPs model -------------------------------------------------------------
+@dataclasses.dataclass
+class FlopsEstimate:
+    """Analytic FLOPs of one program's dot census."""
+
+    total: float = 0.0
+    by_op: Dict[str, float] = dataclasses.field(default_factory=dict)
+    n_dots: int = 0
+    n_approx: int = 0  # dots priced via the sqrt fallback
+    n_unpriced: int = 0  # dot-like ops with no priceable structure at all
+
+    def summary(self) -> dict:
+        return {"total": self.total, "by_op": dict(self.by_op),
+                "n_dots": self.n_dots, "n_approx": self.n_approx,
+                "n_unpriced": self.n_unpriced}
+
+
+def _prod(shape: Sequence[int]) -> int:
+    out = 1
+    for d in shape:
+        out *= d
+    return out
+
+
+def _price(op) -> Tuple[Optional[float], bool]:
+    """(flops, exact) for one dot-like op; (None, False) when the op has
+    no priceable structure (non-dot, too few tensors, unparsed conv)."""
+    if op.name not in _DOT_LIKE or len(op.shapes) < 3:
+        return None, False
+    lhs, rhs, result = op.shapes[0], op.shapes[-2], op.shapes[-1]
+    meta = op.dot_meta
+    if op.name == "convolution":
+        # a conv's per-output multiply count needs the kernel layout; the
+        # dot fallback's sqrt identity does not hold for windowed
+        # contractions, so an unparsed conv stays unpriced
+        if meta is None or meta["kernel_out_dim"] >= len(rhs):
+            return None, False
+        out_features = rhs[meta["kernel_out_dim"]] or 1
+        return (2.0 * _prod(result) * _prod(rhs) / out_features
+                / max(1, meta.get("batch_groups", 1))), True
+    if meta is not None and all(d < len(lhs)
+                                for d in meta["lhs_contracting"]):
+        contracted = _prod([lhs[d] for d in meta["lhs_contracting"]])
+        return 2.0 * _prod(result) * contracted, True
+    # fallback: prod(lhs)*prod(rhs)/prod(result) == K^2 for an unbatched
+    # dot (overcounts batched dots by sqrt(batch) — flagged as approx)
+    denom = _prod(result) or 1
+    return 2.0 * _prod(result) * math.sqrt(
+        max(0.0, _prod(lhs) * _prod(rhs) / denom)), False
+
+
+def op_flops(op) -> Optional[float]:
+    """Analytic FLOPs of one dot-like :class:`~mxnet_tpu.analysis.Op`
+    (None for non-dot ops or unpriceable lines)."""
+    return _price(op)[0]
+
+
+def program_flops(report) -> FlopsEstimate:
+    """Price every dot-like op of a :class:`ProgramReport` (use the
+    *lowered* report: compiled HLO hides dots inside fusions)."""
+    est = FlopsEstimate()
+    for op in report.ops:
+        if op.name not in _DOT_LIKE:
+            continue
+        f, exact = _price(op)
+        if f is None:
+            est.n_unpriced += 1
+            continue
+        est.n_dots += 1
+        if not exact:
+            est.n_approx += 1
+        est.total += f
+        est.by_op[op.name] = est.by_op.get(op.name, 0.0) + f
+    return est
+
+
+# -- goodput ledger ----------------------------------------------------------
+#: interval taxonomy, highest classification priority first — when two
+#: classified intervals overlap, the earlier category wins the overlap
+#: (the most *specific* classification first: a checkpoint restore inside
+#: the re-formation gap is restore time, the rest of the gap downtime)
+GOODPUT_CATEGORIES = ("restore", "checkpoint", "reformation", "data_stall",
+                      "train", "idle")
+
+# event name -> (category, duration payload field); the interval is
+# [ts - duration, ts] (every emitter stamps ts at the END of the region)
+_EVENT_INTERVALS = {
+    "train_step": ("train", "step_seconds"),
+    "train_window": ("train", "window_seconds"),
+    "checkpoint_save": ("checkpoint", "seconds"),
+    "checkpoint_restore": ("restore", "seconds"),
+    "elastic_restore": ("restore", "seconds"),
+}
+
+
+@dataclasses.dataclass
+class GoodputReport:
+    """Wall-clock partition of one run (buckets sum to ``wall`` exactly)."""
+
+    wall_start: float
+    wall_end: float
+    buckets: Dict[str, float]
+    n_intervals: int = 0
+
+    @property
+    def wall(self) -> float:
+        return self.wall_end - self.wall_start
+
+    @property
+    def goodput(self) -> float:
+        """Fraction of wall time spent in productive training steps."""
+        return (self.buckets.get("train", 0.0) / self.wall) if self.wall > 0 \
+            else 0.0
+
+    def summary(self) -> dict:
+        return {"wall_seconds": round(self.wall, 6),
+                "goodput": round(self.goodput, 6),
+                "buckets": {k: round(v, 6)
+                            for k, v in sorted(self.buckets.items())},
+                "n_intervals": self.n_intervals}
+
+
+def classify_events(events: Sequence[dict],
+                    generation_key: str = "_gen"
+                    ) -> List[Tuple[str, float, float]]:
+    """Turn an event stream into classified ``(category, start, end)``
+    intervals. Re-formation downtime is the fleet-level gap between the
+    last event of generation g and the first event of generation g+1
+    (events tagged by the aggregator with ``generation_key``)."""
+    out: List[Tuple[str, float, float]] = []
+    gen_span: Dict[int, Tuple[float, float]] = {}
+    for e in events:
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        g = e.get(generation_key)
+        if isinstance(g, int):
+            lo, hi = gen_span.get(g, (ts, ts))
+            gen_span[g] = (min(lo, ts), max(hi, ts))
+        kind = _EVENT_INTERVALS.get(e.get("event"))
+        if kind is not None:
+            cat, field = kind
+            dur = e.get(field)
+            if isinstance(dur, (int, float)) and dur > 0:
+                out.append((cat, ts - dur, ts))
+            continue
+        if e.get("event") == "data_stall":
+            dur = e.get("wait_seconds")
+            if isinstance(dur, (int, float)) and dur > 0:
+                out.append(("data_stall", ts - dur, ts))
+    gens = sorted(gen_span)
+    for a, b in zip(gens, gens[1:]):
+        end_prev, start_next = gen_span[a][1], gen_span[b][0]
+        if start_next > end_prev:
+            out.append(("reformation", end_prev, start_next))
+    return out
+
+
+def goodput_ledger(events: Sequence[dict],
+                   generation_key: str = "_gen") -> Optional[GoodputReport]:
+    """Build the wall-clock ledger for one (merged) event stream: a
+    boundary sweep over the classified intervals, residual time = idle.
+    Returns None when the stream holds no usable timestamps."""
+    ts_all = [e["ts"] for e in events
+              if isinstance(e.get("ts"), (int, float))]
+    if not ts_all:
+        return None
+    intervals = classify_events(events, generation_key=generation_key)
+    wall_start = min(ts_all + [s for _c, s, _e in intervals])
+    wall_end = max(ts_all + [e for _c, _s, e in intervals])
+    buckets = {c: 0.0 for c in GOODPUT_CATEGORIES}
+    if wall_end <= wall_start:
+        return GoodputReport(wall_start, wall_end, buckets, len(intervals))
+    # boundary sweep with per-category active counters — every elementary
+    # segment belongs to exactly one bucket (the highest-priority interval
+    # covering it, else idle), so the buckets partition wall time with no
+    # double counting; O(n log n), so the supervisor's poll cadence stays
+    # cheap on runs with tens of thousands of step intervals
+    points: List[Tuple[float, int, str]] = []
+    for c, s, e in intervals:
+        s = max(wall_start, min(wall_end, s))
+        e = max(wall_start, min(wall_end, e))
+        if e > s:
+            points.append((s, 1, c))
+            points.append((e, -1, c))
+    points.sort(key=lambda p: p[0])
+    bounds = sorted({wall_start, wall_end} | {p[0] for p in points})
+    active = {c: 0 for c in GOODPUT_CATEGORIES}
+    i = 0
+    for a, b in zip(bounds, bounds[1:]):
+        while i < len(points) and points[i][0] <= a:
+            _t, d, c = points[i]
+            active[c] += d
+            i += 1
+        best = "idle"
+        for c in GOODPUT_CATEGORIES[:-1]:  # priority order, idle = residual
+            if active[c] > 0:
+                best = c
+                break
+        buckets[best] += b - a
+    return GoodputReport(wall_start, wall_end, buckets, len(intervals))

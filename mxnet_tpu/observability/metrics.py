@@ -42,6 +42,22 @@ def _label_key(labels: dict) -> _LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def series_percentile(s: Optional[dict], buckets, q: float) -> Optional[float]:
+    """Bucket-edge q-quantile (0..1) of one histogram series dict
+    (``{"count", "max", "buckets": [per-bucket counts...]}``) — shared by
+    the live :meth:`Histogram.percentile`, both exporters, and the fleet
+    aggregator's cross-rank bucket merges."""
+    if s is None or not s["count"]:
+        return None
+    target = q * s["count"]
+    acc = 0
+    for i, n in enumerate(s["buckets"]):
+        acc += n
+        if acc >= target:
+            return buckets[i] if i < len(buckets) else s["max"]
+    return s["max"]
+
+
 class _Metric:
     kind = "untyped"
 
@@ -155,23 +171,20 @@ class Histogram(_Metric):
 
     def percentile(self, q: float, **labels) -> Optional[float]:
         """Bucket-edge estimate of the q-quantile (0..1) for one series."""
-        s = self._series.get(_label_key(labels))
-        if s is None or s["count"] == 0:
-            return None
-        target = q * s["count"]
-        acc = 0
-        for i, n in enumerate(s["buckets"]):
-            acc += n
-            if acc >= target:
-                return self.buckets[i] if i < len(self.buckets) else s["max"]
-        return s["max"]
+        return series_percentile(self._series.get(_label_key(labels)),
+                                 self.buckets, q)
 
     def _snapshot_value(self, s):
-        # non-cumulative per-bucket counts keyed by upper edge, JSON-safe
+        # non-cumulative per-bucket counts keyed by upper edge, JSON-safe.
+        # p50/p95/p99 are exported alongside the raw buckets so consumers
+        # (the fleet report, dashboards) never re-derive them.
         edges = [str(e) for e in self.buckets] + ["+Inf"]
         return {"count": s["count"], "sum": s["sum"],
                 "min": None if s["count"] == 0 else s["min"],
                 "max": None if s["count"] == 0 else s["max"],
+                "p50": series_percentile(s, self.buckets, 0.5),
+                "p95": series_percentile(s, self.buckets, 0.95),
+                "p99": series_percentile(s, self.buckets, 0.99),
                 "buckets": dict(zip(edges, s["buckets"]))}
 
 
@@ -246,6 +259,7 @@ class Registry:
                 out.append(f"# HELP {name} {help_text}")
             out.append(f"# TYPE {name} {m.kind if m.kind != 'untyped' else 'gauge'}")
             if isinstance(m, Histogram):
+                pct_lines = []
                 for labels, s in m.series():
                     cum = 0
                     for edge, n in zip(list(m.buckets) + ["+Inf"], s["buckets"]):
@@ -254,6 +268,21 @@ class Registry:
                                    f"{_prom_labels(labels, le=edge)} {cum}")
                     out.append(f"{name}_sum{_prom_labels(labels)} {s['sum']}")
                     out.append(f"{name}_count{_prom_labels(labels)} {s['count']}")
+                    for suffix, q in (("p50", 0.5), ("p95", 0.95),
+                                      ("p99", 0.99)):
+                        v = series_percentile(s, m.buckets, q)
+                        if v is not None:
+                            pct_lines.append(
+                                (suffix,
+                                 f"{name}_{suffix}{_prom_labels(labels)} "
+                                 f"{float(v)}"))
+                # pre-computed percentile summaries as companion gauges —
+                # consumers stop re-deriving quantiles from raw buckets
+                for suffix in ("p50", "p95", "p99"):
+                    lines = [ln for sfx, ln in pct_lines if sfx == suffix]
+                    if lines:
+                        out.append(f"# TYPE {name}_{suffix} gauge")
+                        out.extend(lines)
             else:
                 with m._lock:
                     items = list(m._series.items())

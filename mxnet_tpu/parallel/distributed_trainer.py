@@ -110,6 +110,12 @@ def init(coordinator_address: Optional[str] = None, num_processes: Optional[int]
         else config.get("dist_init_retries"))
     retry.retry_call(_bootstrap, site="dist.init", policy=policy)
     _initialized = True
+    # the event log memoizes the host index (jax.process_index costs tens
+    # of µs per emit); a bootstrap that just changed this process's rank
+    # must drop the stale memo
+    from ..observability import events as _ev
+
+    _ev._host_index_cache = None
 
 
 def _clear_half_bootstrap() -> None:
@@ -150,6 +156,9 @@ def shutdown() -> None:
     if _already_bootstrapped():
         jax.distributed.shutdown()
     _initialized = False
+    from ..observability import events as _ev
+
+    _ev._host_index_cache = None
 
 
 def rank() -> int:

@@ -154,6 +154,10 @@ class TrainStep:
             # edits, batch arity) have always counted as "hyperparams"
             label_map={"static": "hyperparams", "arity": "hyperparams"})
         self._monitors: list = []
+        # analytic model-FLOPs memo (observability.goodput, keyed by the
+        # jit cache key): feeds the train_model_flops_per_step / train_mfu
+        # gauges without re-lowering on every recorded step
+        self._flops_cache: Dict[tuple, Optional[float]] = {}
         # attached DevicePrefetcher (io.prefetch): batches arrive already
         # device-resident + sharded, so __call__/run skip the per-call
         # device_put on the caller thread
@@ -569,7 +573,7 @@ class TrainStep:
         # host-side mirror (no device sync — loss is returned as a future)
         self.optimizer.num_update += 1
         if obs_on:
-            self._record_step(t0, raws, loss, gnorm)
+            self._record_step(t0, raws, loss, gnorm, cache_key)
         self._run_monitors()
         self._check_preemption()
         return loss
@@ -711,7 +715,8 @@ class TrainStep:
         self._window_dispatches += 1
         self.optimizer.num_update += window
         if obs_on:
-            self._record_window(t0, batches, losses, gnorms, window, accum)
+            self._record_window(t0, batches, losses, gnorms, window, accum,
+                                cache_key)
         self._run_monitors()
         self._check_preemption()
         return losses
@@ -738,6 +743,57 @@ class TrainStep:
         # shape change no input ever underwent
         self._recompile_guard.observe(fp, reason=reason, group=kind)
 
+    def model_flops_per_step(self, *batch, window: Optional[int] = None,
+                             accum: int = 1) -> Optional[float]:
+        """Analytic model FLOPs of one training step for this batch
+        signature — the :func:`~mxnet_tpu.observability.goodput.
+        program_flops` dot census of the lowered program (forward +
+        backward dots; docs/OBSERVABILITY.md "Fleet view"). A fused
+        window's scan body appears once in the program text, so the
+        window census is one step (× ``accum`` microbatches). Returns
+        None when the program holds no priceable dots."""
+        if window:
+            lower = lambda: self.lower_window_hlo(*batch, window=window,  # noqa: E731
+                                                  accum=accum)
+            key = self._window_cache_key(window, accum, len(batch),
+                                         _obs.enabled())
+        else:
+            lower = lambda: self.lower_hlo(*batch)  # noqa: E731
+            key = self._step_cache_key(len(batch), _obs.enabled())
+        return self._estimate_flops(key, lower, accum)
+
+    def _estimate_flops(self, cache_key, lower, accum=1):
+        """Memoized dot-census FLOPs of one program; never raises — a
+        telemetry estimate must not break the step loop."""
+        if cache_key in self._flops_cache:
+            return self._flops_cache[cache_key]
+        flops = None
+        try:
+            from ..analysis import audit_lowered
+            from ..observability.goodput import program_flops
+            total = program_flops(audit_lowered(lower())).total * max(1, accum)
+            flops = total or None
+        except Exception:  # estimation is best-effort telemetry
+            flops = None
+        self._flops_cache[cache_key] = flops
+        return flops
+
+    def _record_flops(self, flops, step_seconds):
+        """Export the FLOPs/step gauge and — against the ``peak_flops``
+        config knob (``MXNET_TPU_PEAK_FLOPS``) — model FLOPs utilization."""
+        if not flops:
+            return
+        from .. import config as _config
+
+        _obs.gauge("train_model_flops_per_step",
+                   "analytic model FLOPs per training step "
+                   "(ProgramReport dot census)", unit="flops").set(flops)
+        peak = float(_config.get("peak_flops"))
+        if peak > 0 and step_seconds > 0:
+            _obs.gauge("train_mfu",
+                       "model FLOPs utilization vs the configured "
+                       "peak_flops").set(flops / step_seconds / peak)
+
     def _amp_fetchable(self):
         """(scale, skipped) device scalars to ride the telemetry fetch, or
         None — so the amp gauges never cost a second host sync."""
@@ -745,7 +801,7 @@ class TrainStep:
             return None
         return (self.amp_state["scale"], self.amp_state["skipped"])
 
-    def _record_step(self, t0, raws, loss, gnorm):
+    def _record_step(self, t0, raws, loss, gnorm, cache_key=None):
         # reading loss/gnorm blocks on the device — when telemetry is on,
         # step time is the real wall-clock of the whole step, not dispatch
         loss_h, gnorm_h, amp_h = jax.device_get(
@@ -768,6 +824,13 @@ class TrainStep:
         if gnorm_f is not None:
             _obs.gauge("train_grad_norm").set(gnorm_f)
         self._record_amp(amp_h)
+        # the caller hands down the jit cache key it just dispatched with,
+        # so the memoized FLOPs lookup never re-resolves the multipliers
+        if cache_key is None:
+            cache_key = self._step_cache_key(len(raws), True)
+        self._record_flops(
+            self._estimate_flops(cache_key, lambda: self.lower_hlo(*raws)),
+            dt)
         _obs.emit("train_step", loss=loss_f, grad_norm=gnorm_f,
                   step_seconds=round(dt, 6), samples=samples, tokens=tokens,
                   tokens_per_sec=round(tokens / dt, 3) if dt > 0 else 0.0)
@@ -787,7 +850,8 @@ class TrainStep:
                          "steps dropped by AMP overflow handling").inc(d)
         self._amp_skipped_seen = int(skipped)
 
-    def _record_window(self, t0, batches, losses, gnorms, window, accum):
+    def _record_window(self, t0, batches, losses, gnorms, window, accum,
+                       cache_key=None):
         # ONE device sync for the whole window: losses+gnorms+amp carry
         # fetched together, so window time is true wall clock of K fused steps
         loss_h, gnorm_h, amp_h = jax.device_get(
@@ -810,6 +874,20 @@ class TrainStep:
         if gnorm_h is not None:
             _obs.gauge("train_grad_norm").set(float(gnorm_h[-1]))
         self._record_amp(amp_h)
+        # the scan body appears once in the window program text, so its
+        # census is one step's dots (one microbatch when accum > 1); the
+        # per-step batch is sliced off the stack only on the memo miss
+        lead = (0, 0) if accum > 1 else (0,)
+        if cache_key is None:
+            cache_key = self._window_cache_key(window, accum, len(batches),
+                                               True)
+        self._record_flops(
+            self._estimate_flops(
+                cache_key,
+                lambda: self.lower_window_hlo(*(b[lead] for b in batches),
+                                              window=window, accum=accum),
+                accum),
+            dt / window if window else dt)
         _obs.emit("train_window", window=window, accum=accum,
                   loss=float(loss_h[-1]),
                   loss_mean=float(sum(float(x) for x in loss_h) / len(loss_h)),

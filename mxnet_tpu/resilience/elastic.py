@@ -236,6 +236,11 @@ class ElasticContext:
 
         if self.monitor is not None:
             self.monitor.start()
+        # fleet view: arm this rank's telemetry snapshotter into the shared
+        # fleet dir (MXNET_TPU_FLEET_DIR, exported by the supervisor) so
+        # the aggregator sees this generation even if obs.enable() ran
+        # before the env contract was inspected
+        _obs.fleet.ensure_snapshotter()
         _obs.gauge("elastic_world_size",
                    "current number of worker processes").set(self.world)
         if self.generation > 0:
@@ -259,15 +264,26 @@ class ElasticContext:
 
     def check(self) -> None:
         """Step-boundary poll: preemption flag, then peer heartbeats.
-        Raises :class:`ReformExit` (SystemExit 75) on either."""
+        Raises :class:`ReformExit` (SystemExit 75) on either. Also the
+        step-boundary cadence for the fleet telemetry snapshot (throttled
+        to the configured interval — one clock read when not due)."""
+        from ..observability import fleet as _fleet
+
+        snap = _fleet.snapshotter()
+        if snap is not None:
+            snap.maybe_snapshot()
         if self._guard is not None and self._guard.requested:
             self._emit("elastic_preempted", signum=self._guard.signum)
+            if snap is not None:
+                snap.snapshot()  # last state of a rank about to leave
             raise ReformExit("preempted")
         if self.monitor is not None:
             try:
                 self.monitor.check()
             except PeerLost as e:
                 self._emit("elastic_peer_lost", ranks=e.ranks, cause=e.cause)
+                if snap is not None:
+                    snap.snapshot()
                 raise ReformExit(e.cause) from e
 
     def resume(self, restore_fn: Callable, ckpt_step: Optional[int] = None):

@@ -1,0 +1,578 @@
+"""Fleet observability (ISSUE 9, docs/OBSERVABILITY.md "Fleet view"):
+cross-rank snapshot/aggregation, straggler detection, goodput ledger, the
+ProgramReport-derived FLOPs model feeding train_mfu, percentile exporters,
+and the telemetry-off hot-path contract."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config, nd, observability as obs, optimizer as opt
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.observability import fleet as fleet_mod
+from mxnet_tpu.observability import goodput as gp
+from mxnet_tpu.observability.fleet import FleetAggregator, FleetSnapshotter
+from mxnet_tpu.observability.metrics import Registry, series_percentile
+from mxnet_tpu.parallel import TrainStep
+
+
+# -- helpers -----------------------------------------------------------------
+def _dense_step(seed=0, units=16, in_units=8, batch=4):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(units, in_units=in_units, activation="relu"),
+            nn.Dense(4, in_units=units))
+    net.initialize()
+    _ = net(nd.ones((batch, in_units)))
+    ts = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(),
+                   opt.SGD(learning_rate=0.01))
+    return ts, (nd.ones((batch, in_units)), nd.zeros((batch, 4)))
+
+
+def _write_snapshot(fleet_dir, rank, gen, metrics=None, events=None,
+                    ts=1000.0):
+    """Fabricate one rank's snapshot files the way FleetSnapshotter
+    writes them."""
+    d = os.path.join(str(fleet_dir), f"telemetry-h{rank}")
+    os.makedirs(d, exist_ok=True)
+    if metrics is not None:
+        payload = {"meta": {"rank": rank, "generation": gen, "pid": 1,
+                            "run": "r", "ts": ts}, "metrics": metrics}
+        with open(os.path.join(d, f"metrics-g{gen}.json"), "w") as f:
+            json.dump(payload, f)
+    if events is not None:
+        with open(os.path.join(d, f"events-g{gen}.jsonl"), "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+    return d
+
+
+def _step_hist(values, buckets=(0.1, 1.0, 10.0)):
+    """A metrics-dump histogram entry from raw observations."""
+    r = Registry()
+    h = r.histogram("train_step_seconds", buckets=buckets)
+    for v in values:
+        h.observe(v, loop="train_step")
+    return r.snapshot()
+
+
+def _step_event(step, seconds, ts, run="r"):
+    return {"ts": ts, "run": run, "host": 0, "step": step,
+            "event": "train_step", "loss": 1.0, "step_seconds": seconds}
+
+
+# -- percentile exporters (satellite 1) --------------------------------------
+def test_histogram_percentiles_in_json_snapshot():
+    r = Registry()
+    h = r.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for _ in range(90):
+        h.observe(0.05, op="x")
+    for _ in range(10):
+        h.observe(0.5, op="x")
+    snap = r.snapshot()["lat_seconds"]["series"][0]["value"]
+    assert snap["p50"] == 0.1   # bucket upper edge containing the median
+    assert snap["p95"] == 1.0
+    assert snap["p99"] == 1.0
+    # consumers get the same numbers the live API computes
+    assert snap["p50"] == h.percentile(0.5, op="x")
+    assert snap["p95"] == h.percentile(0.95, op="x")
+
+
+def test_histogram_percentiles_in_prometheus_export():
+    r = Registry()
+    h = r.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for _ in range(20):
+        h.observe(0.05, op="x")
+    text = r.to_prometheus()
+    assert '# TYPE lat_seconds_p50 gauge' in text
+    assert 'lat_seconds_p50{op="x"} 0.1' in text
+    assert 'lat_seconds_p95{op="x"} 0.1' in text
+    assert 'lat_seconds_p99{op="x"} 0.1' in text
+
+
+def test_series_percentile_merged_buckets():
+    # the fleet aggregator merges raw bucket counts across ranks, then
+    # derives percentiles with the same shared helper
+    s = {"count": 100, "max": 0.9,
+         "buckets": [50, 45, 5]}  # edges (0.1, 1.0) + overflow
+    assert series_percentile(s, (0.1, 1.0), 0.5) == 0.1
+    # the 99th sample sits in the +Inf overflow bucket: the observed max
+    # is the tightest honest answer
+    assert series_percentile(s, (0.1, 1.0), 0.99) == 0.9
+    assert series_percentile(None, (0.1,), 0.5) is None
+    assert series_percentile({"count": 0, "max": None, "buckets": [0, 0]},
+                             (0.1,), 0.5) is None
+
+
+# -- FLOPs model (acceptance: hand-counted LeNet + tiny-GPT2) ---------------
+def test_flops_lenet_step_hand_counted():
+    """The LeNet step program's dot census against the hand count.
+
+    Forward: conv (8,1,28,28)*(6,1,5,5)->(8,6,28,28) = 2*37632*25;
+    dense1 (8,1176)x(1176,32) = 2*8*32*1176; dense2 = 2*8*10*32.
+    Backward (params only — x is not differentiated, so no conv dgrad):
+    conv wgrad mirrors the forward conv's cost; dense1/dense2 each add a
+    wgrad + a dgrad mirroring their forward cost."""
+    from mxnet_tpu import analysis, gluon
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(6, 5, padding=2, activation="tanh"),
+            nn.MaxPool2D(2, 2), nn.Flatten(),
+            nn.Dense(32, activation="tanh"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(8, 1, 28, 28).astype(np.float32))
+    y = nd.array(np.arange(8) % 10)
+    _ = net(x)
+    ts = TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                   opt.create("adam", learning_rate=1e-3))
+    rep = analysis.audit_lowered(ts.lower_hlo(x, y))
+    est = gp.program_flops(rep)
+    conv_fwd = 2 * (8 * 6 * 28 * 28) * (1 * 5 * 5)
+    d1_fwd = 2 * 8 * 32 * 1176
+    d2_fwd = 2 * 8 * 10 * 32
+    expected = (conv_fwd * 2) + (d1_fwd * 3) + (d2_fwd * 3)
+    assert est.total == expected == 5584896
+    assert est.n_approx == 0  # every dot priced from parsed dims
+    assert est.by_op["convolution"] == conv_fwd * 2
+    assert ts.model_flops_per_step(x, y) == expected
+
+
+def test_flops_tiny_gpt2_step_hand_counted():
+    """Tiny-GPT2 LM step = 3x the analytic forward dot count (every dot's
+    lhs AND rhs need grads — the embedding gather feeds them all)."""
+    from mxnet_tpu import analysis
+    from mxnet_tpu.models import gpt2
+
+    B, T, d, h, V = 2, 32, 32, 2, 64
+    mx.random.seed(0)
+    net = gpt2.get_gpt2("gpt2_tiny", dropout=0.0, num_layers=2, units=d,
+                        num_heads=h, max_length=64, vocab_size=V)
+    net.initialize()
+    ids = nd.array(np.random.RandomState(0).randint(0, V, (B, T)),
+                   dtype="int32")
+    _ = net(ids)
+    lbl = nd.array(np.random.RandomState(1).randint(0, V, (B, T)),
+                   dtype="int32")
+    ts = TrainStep(net, gpt2.lm_loss, opt.Adam(learning_rate=1e-3))
+    est = gp.program_flops(analysis.audit_lowered(ts.lower_hlo(ids, lbl)))
+    ch = d // h
+    layer_fwd = (2 * B * T * d * 3 * d        # fused qkv projection
+                 + 2 * (2 * B * h * T * T * ch)  # scores + att@V
+                 + 2 * B * T * d * d          # output projection
+                 + 2 * (2 * B * T * d * 4 * d))  # ffn1 + ffn2
+    fwd = 2 * layer_fwd + 2 * B * T * d * V   # 2 layers + tied LM head
+    assert est.total == 3 * fwd == 11796480
+    assert est.n_approx == 0
+
+
+def test_flops_window_census_counts_scan_body_once():
+    ts, (x, y) = _dense_step()
+    single = ts.model_flops_per_step(x, y)
+    assert single and single > 0
+    # the fused window's scan body appears once in the program text
+    assert ts.model_flops_per_step(x, y, window=2) == single
+
+
+def test_op_flops_fallback_is_flagged():
+    from mxnet_tpu.analysis import Op
+
+    # no parsed dims: the sqrt fallback prices an unbatched dot exactly
+    op = Op("dot_general", "f32", (8, 10), ("f32",) * 3, 1,
+            shapes=((8, 32), (32, 10), (8, 10)))
+    assert gp.op_flops(op) == pytest.approx(2 * 8 * 10 * 32)
+    # parsed dims inconsistent with the operand shapes: STILL the
+    # fallback, and still flagged approx (not reported as exact)
+    bad = Op("dot_general", "f32", (8, 10), ("f32",) * 3, 1,
+             shapes=((8, 32), (32, 10), (8, 10)),
+             dot_meta={"lhs_contracting": (7,), "lhs_batching": ()})
+    # an unparseable convolution has no usable fallback: unpriced
+    conv = Op("convolution", "f32", (8, 6, 28, 28), ("f32",) * 3, 1,
+              shapes=((8, 1, 28, 28), (6, 1, 5, 5), (8, 6, 28, 28)))
+    assert gp.op_flops(conv) is None
+    rep_like = type("R", (), {"ops": [op, bad, conv]})
+    est = gp.program_flops(rep_like)
+    assert est.n_dots == 2 and est.n_approx == 2
+    assert est.n_unpriced == 1
+
+
+# -- train_mfu gauge ---------------------------------------------------------
+def test_train_mfu_gauge_from_flops(tmp_path):
+    config.set("peak_flops", 1e9)
+    try:
+        obs.enable(str(tmp_path / "run"))
+        ts, (x, y) = _dense_step(seed=3)
+        ts(x, y)
+        ts(x, y)
+        flops = obs.REGISTRY.get("train_model_flops_per_step").value()
+        assert flops == ts.model_flops_per_step(x, y)
+        mfu = obs.REGISTRY.get("train_mfu").value()
+        assert mfu is not None and mfu > 0
+        # mfu = flops / dt / peak for the LAST step
+        assert mfu < 1e9  # sanity: finite, scaled by the configured peak
+    finally:
+        config.set("peak_flops", 0.0)
+        obs.disable()
+
+
+# -- goodput ledger ----------------------------------------------------------
+def test_goodput_ledger_buckets_sum_to_wall():
+    ev = [
+        _step_event(1, 1.0, ts=101.0),
+        _step_event(2, 1.0, ts=102.0),
+        {"ts": 104.0, "event": "checkpoint_save", "seconds": 1.5},
+        _step_event(3, 1.0, ts=106.0),
+        {"ts": 107.5, "event": "data_stall", "wait_seconds": 1.0},
+    ]
+    for e in ev:
+        e.setdefault("_gen", 0)
+    rep = gp.goodput_ledger(ev)
+    assert rep.wall_start == 100.0 and rep.wall_end == 107.5
+    assert sum(rep.buckets.values()) == pytest.approx(rep.wall, rel=1e-9)
+    assert rep.buckets["train"] == pytest.approx(3.0)
+    assert rep.buckets["checkpoint"] == pytest.approx(1.5)
+    assert rep.buckets["data_stall"] == pytest.approx(1.0)
+    assert rep.buckets["idle"] == pytest.approx(2.0)
+    assert rep.goodput == pytest.approx(3.0 / 7.5)
+
+
+def test_goodput_ledger_overlap_priority_no_double_count():
+    # a checkpoint overlapping a train step: the overlap is counted ONCE,
+    # for the higher-priority category
+    ev = [_step_event(1, 2.0, ts=102.0),
+          {"ts": 102.0, "event": "checkpoint_save", "seconds": 1.0,
+           "_gen": 0}]
+    ev[0]["_gen"] = 0
+    rep = gp.goodput_ledger(ev)
+    assert sum(rep.buckets.values()) == pytest.approx(rep.wall)
+    assert rep.buckets["checkpoint"] == pytest.approx(1.0)
+    assert rep.buckets["train"] == pytest.approx(1.0)
+
+
+def test_goodput_ledger_reformation_gap_between_generations():
+    ev = ([_step_event(i, 0.5, ts=100.0 + i) for i in (1, 2, 3)]
+          + [{"ts": 110.0, "event": "elastic_restore", "seconds": 1.0,
+              "_gen": 1}]
+          + [_step_event(i, 0.5, ts=108.0 + i) for i in (3, 4)])
+    for e in ev[:3]:
+        e["_gen"] = 0
+    for e in ev[4:]:
+        e["_gen"] = 1
+    rep = gp.goodput_ledger(ev)
+    # gen-0 ends at 103, gen-1 starts at 109 (restore event interval
+    # [109,110] claims its share) -> downtime attributed to re-formation
+    assert rep.buckets["reformation"] == pytest.approx(6.0)
+    assert rep.buckets["restore"] == pytest.approx(1.0)
+    assert rep.goodput < 1.0
+    assert sum(rep.buckets.values()) == pytest.approx(rep.wall)
+
+
+def test_goodput_ledger_empty():
+    assert gp.goodput_ledger([]) is None
+    assert gp.goodput_ledger([{"event": "x"}]) is None
+
+
+# -- straggler detection -----------------------------------------------------
+def test_detect_stragglers_flags_slow_rank():
+    events = []
+    for step in range(1, 6):
+        for rank in range(4):
+            dt = 1.2 if (rank == 2 and step == 3) else 0.1
+            e = _step_event(step, dt, ts=100.0 + step)
+            e["_rank"], e["_gen"] = rank, 0
+            events.append(e)
+    stragglers, timeline = fleet_mod.detect_stragglers(events, factor=3.0)
+    assert len(stragglers) == 1
+    s = stragglers[0]
+    assert s["rank"] == 2 and s["step"] == 3 and s["kind"] == "step"
+    assert s["ratio"] == pytest.approx(12.0)
+    skews = {t["step"]: t for t in timeline}
+    assert skews[3]["skew_seconds"] == pytest.approx(1.1)
+    assert skews[3]["slowest_rank"] == 2
+    assert skews[1]["skew_seconds"] == pytest.approx(0.0)
+
+
+def test_detect_stragglers_needs_two_ranks_and_absolute_floor():
+    # single-rank steps never flag; microsecond skew under the absolute
+    # floor never flags even at a huge ratio
+    solo = [dict(_step_event(1, 5.0, ts=100.0), _rank=0, _gen=0)]
+    assert fleet_mod.detect_stragglers(solo, factor=2.0) == ([], [])
+    tiny = []
+    for rank in range(3):
+        dt = 1e-5 if rank != 2 else 9e-5
+        tiny.append(dict(_step_event(1, dt, ts=100.0), _rank=rank, _gen=0))
+    stragglers, _tl = fleet_mod.detect_stragglers(tiny, factor=2.0)
+    assert stragglers == []
+
+
+# -- snapshot + aggregation --------------------------------------------------
+def test_snapshotter_roundtrip(tmp_path):
+    run = tmp_path / "run"
+    fdir = tmp_path / "fleet"
+    obs.REGISTRY.reset()
+    try:
+        obs.enable(str(run))
+        obs.histogram("train_step_seconds").observe(0.2, loop="train_step")
+        obs.emit("train_step", step=1, step_seconds=0.2, loss=1.0)
+        snap = FleetSnapshotter(str(fdir), rank=0, generation=0,
+                                interval=60.0)
+        assert snap.snapshot()
+        d = fdir / "telemetry-h0"
+        payload = json.loads((d / "metrics-g0.json").read_text())
+        assert payload["meta"]["rank"] == 0
+        assert "train_step_seconds" in payload["metrics"]
+        lines = (d / "events-g0.jsonl").read_text().splitlines()
+        assert any(json.loads(ln)["event"] == "train_step" for ln in lines)
+        # throttled step-boundary variant: a fresh snapshot just landed
+        assert snap.maybe_snapshot() is False
+    finally:
+        obs.disable()
+        obs.REGISTRY.reset()
+
+    agg = FleetAggregator(str(fdir))
+    report = agg.collect()
+    assert report is not None
+    assert set(report.ranks) == {0}
+    rs = report.ranks[0]
+    assert rs.step_hist["count"] == 1
+    assert report.events and report.events[0]["_rank"] == 0
+
+
+def test_aggregator_merges_ranks_and_generations(tmp_path):
+    # rank 0 lived through generations 0 and 1; rank 1 joined at gen 1
+    _write_snapshot(tmp_path, 0, 0, metrics=_step_hist([0.1, 0.1]),
+                    events=[_step_event(1, 0.1, 100.1),
+                            _step_event(2, 0.1, 100.2)], ts=100.2)
+    _write_snapshot(tmp_path, 0, 1, metrics=_step_hist([0.1]),
+                    events=[_step_event(3, 0.1, 105.0)], ts=105.0)
+    _write_snapshot(tmp_path, 1, 1, metrics=_step_hist([0.3]),
+                    events=[_step_event(3, 0.3, 105.2)], ts=105.2)
+    report = FleetAggregator(str(tmp_path)).collect()
+    assert report.generations == [0, 1]
+    assert set(report.ranks) == {0, 1}
+    assert sorted(report.ranks[0].generations) == [0, 1]
+    assert report.ranks[0].step_hist["count"] == 3  # merged across gens
+    assert report.ranks[1].generations == [1]
+    # the gen-0 -> gen-1 gap lands in the reformation bucket
+    assert report.goodput.buckets["reformation"] > 0
+    gens = {e["_gen"] for e in report.events}
+    assert gens == {0, 1}
+
+
+def test_aggregator_skips_torn_snapshot_and_counts_it(tmp_path):
+    _write_snapshot(tmp_path, 0, 0, metrics=_step_hist([0.1]),
+                    events=[_step_event(1, 0.1, 100.1)])
+    d1 = os.path.join(str(tmp_path), "telemetry-h1")
+    os.makedirs(d1)
+    with open(os.path.join(d1, "metrics-g0.json"), "w") as f:
+        f.write('{"meta": {"rank": 1}, "metr')  # torn mid-write
+    agg = FleetAggregator(str(tmp_path))
+    report = agg.collect()
+    assert report is not None  # the torn rank never crashes the merge
+    assert report.torn_snapshots == 1
+    assert report.ranks[0].step_hist["count"] == 1
+    before = obs.REGISTRY.counter("fleet_torn_snapshots_total").total()
+    agg.poll()
+    agg.poll()  # second poll must not double count the same torn file
+    after = obs.REGISTRY.counter("fleet_torn_snapshots_total").total()
+    assert after - before == 1
+
+
+def test_aggregator_empty_dir(tmp_path):
+    assert FleetAggregator(str(tmp_path)).collect() is None
+    (tmp_path / "telemetry-h0").mkdir()  # rank dir with no snapshots yet
+    assert FleetAggregator(str(tmp_path)).collect() is None
+
+
+def test_aggregator_poll_emits_straggler_telemetry(tmp_path):
+    events = []
+    for step in (1, 2):
+        for rank in range(3):
+            dt = 2.0 if (rank == 1 and step == 2) else 0.1
+            events.append(_step_event(step, dt, ts=100.0 + step))
+            events[-1]["host"] = rank
+    by_rank = {}
+    for e in events:
+        by_rank.setdefault(e["host"], []).append(e)
+    for rank, evs in by_rank.items():
+        _write_snapshot(tmp_path, rank, 0, metrics=_step_hist(
+            [e["step_seconds"] for e in evs]), events=evs)
+    agg = FleetAggregator(str(tmp_path), straggler_factor=3.0)
+    report, new = agg.poll()
+    assert [s["rank"] for s in new] == [1]
+    assert report.stragglers and report.stragglers[0]["rank"] == 1
+    assert obs.REGISTRY.get("straggler_rank").value() == 1
+    skew = obs.REGISTRY.get("fleet_step_skew_seconds")
+    assert skew is not None and skew.total_count() >= 2
+    _report2, new2 = agg.poll()  # same findings: nothing new emitted
+    assert new2 == []
+
+
+def test_merged_percentile_overflow_bucket_is_max_not_inf(tmp_path):
+    # the +Inf overflow edge must never become a finite percentile edge:
+    # a quantile landing in the overflow bucket reads the observed max
+    r = Registry()
+    h = r.histogram("decode_tokens_per_s")  # DEFAULT_BUCKETS top edge 60
+    for _ in range(10):
+        h.observe(120.0)  # every sample past the last edge
+    _write_snapshot(tmp_path, 0, 0, metrics=r.snapshot(),
+                    events=[_step_event(1, 0.1, 100.1)])
+    report = FleetAggregator(str(tmp_path)).collect()
+    p99 = report.serving["decode_tokens_per_s"]["p99"]
+    assert p99 == 120.0 and np.isfinite(p99)
+
+
+def test_merge_hist_survives_mismatched_bucket_layouts(tmp_path):
+    from mxnet_tpu.observability.fleet import _hist_acc, _hist_pct, \
+        _merge_hist
+
+    a = Registry().histogram("train_step_seconds", buckets=(0.1, 1.0))
+    b = Registry().histogram("train_step_seconds", buckets=(0.5, 2.0))
+    acc = _hist_acc()
+    snaps = []
+    for hist, v in ((a, 0.05), (b, 0.3), (a, 0.07)):
+        hist.observe(v)
+        snaps.append(hist._snapshot_value(hist._series[()]))
+        hist._series.clear()
+    # match, mismatch, then match again: count/sum survive, percentiles
+    # degrade to None — never a TypeError
+    for s in snaps:
+        _merge_hist(acc, s)
+    assert acc["count"] == 3
+    assert acc["sum"] == pytest.approx(0.42)
+    assert acc["buckets"] is None
+    assert _hist_pct(acc, 0.5) is None
+
+
+def test_gen_sorted_orders_numerically():
+    from mxnet_tpu.observability.fleet import _gen_sorted
+
+    paths = [f"metrics-g{g}.json" for g in (0, 1, 2, 10, 11)]
+    shuffled = sorted(paths)  # lexicographic puts g10/g11 before g2
+    assert shuffled != paths
+    assert _gen_sorted(shuffled) == paths
+
+
+def test_snapshot_event_copy_is_incremental(tmp_path):
+    run = tmp_path / "run"
+    fdir = tmp_path / "fleet"
+    obs.REGISTRY.reset()
+    try:
+        obs.enable(str(run))
+        obs.emit("train_step", step=1, step_seconds=0.1, loss=1.0)
+        snap = FleetSnapshotter(str(fdir), rank=0, generation=0,
+                                interval=60.0)
+        assert snap.snapshot()
+        obs.emit("train_step", step=2, step_seconds=0.1, loss=1.0)
+        assert snap.snapshot()
+        lines = (fdir / "telemetry-h0" / "events-g0.jsonl") \
+            .read_text().splitlines()
+        steps = [json.loads(ln)["step"] for ln in lines
+                 if json.loads(ln)["event"] == "train_step"]
+        assert steps == [1, 2]  # appended once each, never re-copied
+    finally:
+        obs.disable()
+        obs.REGISTRY.reset()
+
+
+def test_serving_rollup_percentiles(tmp_path):
+    r = Registry()
+    h = r.histogram("ttft_seconds")
+    for v in (0.02, 0.03, 0.04, 0.4):
+        h.observe(v)
+    r.gauge("gen_slot_utilization").set(0.75)
+    r.counter("gen_requests_total").inc(3, reason="eos")
+    _write_snapshot(tmp_path, 0, 0, metrics=r.snapshot(),
+                    events=[_step_event(1, 0.1, 100.1)])
+    report = FleetAggregator(str(tmp_path)).collect()
+    sv = report.serving
+    assert sv["ttft_seconds"]["count"] == 4
+    assert sv["ttft_seconds"]["p50"] is not None
+    assert sv["slot_utilization"] == 0.75
+    assert sv["requests"] == {"eos": 3}
+
+
+# -- fleetreport CLI ---------------------------------------------------------
+def test_fleetreport_cli(tmp_path, capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "fleetreport", os.path.join(os.path.dirname(__file__), "..",
+                                    "tools", "fleetreport.py"))
+    fr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fr)
+
+    assert fr.main([str(tmp_path / "nothing")]) == 1
+    capsys.readouterr()
+
+    for rank in range(2):
+        _write_snapshot(tmp_path, rank, 0, metrics=_step_hist([0.1, 0.2]),
+                        events=[_step_event(1, 0.1, 100.1),
+                                _step_event(2, 0.2, 100.4)])
+    assert fr.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "== fleet report" in out and "-- per-rank" in out
+    assert "-- goodput" in out
+    assert fr.main([str(tmp_path), "--json"]) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert set(s["ranks"]) == {"0", "1"}
+    assert s["goodput"]["buckets"]["train"] > 0
+
+
+# -- telemetry-off hot path stays one bool check (satellite 2) ---------------
+def test_telemetry_off_branch_single_gate():
+    """The telemetry-off step must do exactly one ``_obs.enabled()`` read
+    and touch neither the registry, the event log, nor the fleet
+    snapshot writer."""
+    import inspect
+
+    src = inspect.getsource(TrainStep.__call__)
+    assert src.count("_obs.enabled()") == 1
+    wsrc = inspect.getsource(TrainStep._run_window)
+    assert wsrc.count("_obs.enabled()") == 1
+    # the snapshot writer is never reachable from the TrainStep hot path:
+    # it rides the elastic step-boundary probe / cadence thread instead
+    for fn_src in (src, wsrc):
+        assert "fleet" not in fn_src and "snapshot" not in fn_src
+
+    was_enabled = obs.enabled()
+    obs.disable()
+    try:
+        ts, (x, y) = _dense_step(seed=7)
+        ts(x, y)  # warm + compile outside the probed window
+        before = json.dumps(obs.REGISTRY.snapshot(), sort_keys=True)
+        ts(x, y)
+        after = json.dumps(obs.REGISTRY.snapshot(), sort_keys=True)
+        assert before == after  # zero registry mutation with telemetry off
+        assert fleet_mod.snapshotter() is None
+    finally:
+        if was_enabled:  # this suite runs telemetry-off; stay defensive
+            obs.disable()
+
+
+def test_extra_hot_paths_cover_snapshot_writer():
+    """Lint contract (satellite 2): the fleet snapshot writer is a
+    registered hot path, so JH001/JH002/JH003 hazards in it fail CI."""
+    from mxnet_tpu.analysis.astlint import EXTRA_HOT_PATHS
+
+    quals = EXTRA_HOT_PATHS.get("observability/fleet.py")
+    assert quals, "fleet snapshot writer must be a registered hot path"
+    assert "FleetSnapshotter.maybe_snapshot" in quals
+    assert "FleetSnapshotter.snapshot" in quals
+    for q in quals:  # every registered qualname must actually exist
+        cls_name, meth = q.split(".")
+        assert hasattr(getattr(fleet_mod, cls_name), meth)
+
+
+def test_snapshotter_maybe_snapshot_throttles(tmp_path):
+    snap = FleetSnapshotter(str(tmp_path), rank=0, generation=0,
+                            interval=30.0)
+    assert snap.snapshot()
+    t0 = time.perf_counter()
+    for _ in range(200):
+        assert snap.maybe_snapshot() is False
+    per_call = (time.perf_counter() - t0) / 200
+    assert per_call < 1e-4  # throttled probe: a clock read + compare

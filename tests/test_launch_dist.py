@@ -294,6 +294,11 @@ def _run_drill(tmp, name, elastic_args=(), kill_rank=-1, kill_step=-1):
         "DRILL_KILL_RANK": str(kill_rank), "DRILL_KILL_STEP": str(kill_step),
         # the world-size-agnostic manifest format + a fast failover window
         "MXNET_TPU_CKPT_SHARDED": "1", "MXNET_TPU_ELASTIC_HB_TIMEOUT": "3",
+        # fleet view (ISSUE 9): a test-owned fleet dir (the supervisor's
+        # default lives under its heartbeat tempdir and is removed with
+        # it) + a snapshot cadence fast enough for a 12-step drill
+        "MXNET_TPU_FLEET_DIR": str(d / "fleet"),
+        "MXNET_TPU_FLEET_SNAPSHOT_INTERVAL": "0.5",
     })
     res = subprocess.run(
         [sys.executable, "tools/launch.py", "-n", "4", *elastic_args,
@@ -380,6 +385,166 @@ def test_chaos_elastic_kill_worker(tmp_path, _elastic_baseline, policy,
         assert e["cause"] == "worker_killed:sig9"
         assert (e["old_world"], e["new_world"]) == (4, expect_world)
     assert restore[0]["ckpt_step"] == 6  # killed at 7, saved every 3
+
+
+# Straggler drill child (ISSUE 9, docs/OBSERVABILITY.md "Fleet view"):
+# four ranks train locally (no collectives — the SIGSTOPped rank's own
+# step time is the signal under test, not induced peer waits) with fleet
+# snapshots armed; rank 2 publishes its pid so the TEST can SIGSTOP it
+# mid-run. No elastic context: a stopped rank must look *slow*, not dead.
+_STRAGGLER_CHILD = textwrap.dedent("""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, observability as obs, optimizer
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import TrainStep
+
+    rank = int(os.environ["MXNET_TPU_PROCID"])
+    obs.enable(os.path.join(os.environ["STRAG_OBS"], f"r{rank}"))
+
+    mx.random.seed(5)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(512, in_units=512, activation="relu"),
+            nn.Dense(512, in_units=512))
+    net.initialize()
+    _ = net(nd.ones((2, 512)))
+    ts = TrainStep(net, lambda o, y: ((o - y) ** 2).mean(),
+                   optimizer.SGD(learning_rate=0.01))
+    x = nd.array(np.random.RandomState(0).rand(256, 512).astype("float32"))
+    y = nd.zeros((256, 512))
+
+    STEPS = int(os.environ.get("STRAG_STEPS", "80"))
+    for step in range(1, STEPS + 1):
+        ts(x, y)
+        if step == 5 and rank == 2:
+            # warmed up (compile done): tell the test it may SIGSTOP us
+            with open(os.path.join(os.environ["MXNET_TPU_FLEET_DIR"],
+                                   "pid-r2"), "w") as f:
+                f.write(str(os.getpid()))
+    obs.shutdown()
+    print(f"STRAG-RANK{rank}-DONE", flush=True)
+""")
+
+
+def _fleetreport_json(fleet_dir):
+    import json
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "tools/fleetreport.py", str(fleet_dir), "--json"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=repo_root)
+    assert res.returncode == 0, (res.stdout + res.stderr)[-3000:]
+    return json.loads(res.stdout)
+
+
+@pytest.mark.timeout(420)
+@pytest.mark.slow
+def test_fleet_straggler_sigstop(tmp_path):
+    """`make obsfleet` (ISSUE 9 acceptance): a 4-process launch where the
+    test SIGSTOPs rank 2 for ~1s mid-run twice; the fleet aggregator must
+    flag rank 2 as a straggler from the merged per-step timings, and the
+    elastic supervisor must surface the finding in its own log."""
+    import signal
+    import time
+
+    fleet = tmp_path / "fleet"
+    fleet.mkdir()
+    child = tmp_path / "child.py"
+    child.write_text(_STRAGGLER_CHILD)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = repo_root
+    env["MXNET_TPU_FLEET_DIR"] = str(fleet)
+    env["MXNET_TPU_FLEET_SNAPSHOT_INTERVAL"] = "0.5"
+    env["STRAG_OBS"] = str(tmp_path / "obs")
+    proc = subprocess.Popen(
+        [sys.executable, "tools/launch.py", "-n", "4", "--elastic",
+         "--max-restarts", "0", "--grace", "3",
+         sys.executable, str(child)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=repo_root)
+    try:
+        # wait for rank 2 to report warm, then freeze it twice: a stopped
+        # process's in-flight step spans the pause, so ITS step time blows
+        # past the fleet median while the other ranks keep normal pace
+        pidfile = fleet / "pid-r2"
+        deadline = time.time() + 180
+        while not pidfile.exists():
+            assert proc.poll() is None, proc.communicate()[1][-3000:]
+            assert time.time() < deadline, "rank 2 never reported warm"
+            time.sleep(0.05)
+        pid = int(pidfile.read_text())
+        for _ in range(2):
+            os.kill(pid, signal.SIGSTOP)
+            time.sleep(1.0)
+            os.kill(pid, signal.SIGCONT)
+            time.sleep(0.3)
+        out, err = proc.communicate(timeout=300)
+    except BaseException:
+        proc.kill()
+        raise
+    tail = (out + err)[-3000:]
+    assert proc.returncode == 0, tail
+    for r in range(4):
+        assert f"STRAG-RANK{r}-DONE" in out, tail
+
+    s = _fleetreport_json(fleet)
+    steps = [t for t in s["stragglers"] if t["kind"] == "step"]
+    assert any(t["rank"] == 2 for t in steps), s["stragglers"]
+    worst = max((t for t in steps if t["rank"] == 2),
+                key=lambda t: t["ratio"] or 0)
+    assert worst["ratio"] >= 3.0, worst
+    assert s["skew_timeline"], "skew timeline empty"
+    # supervisor-side surfacing: the elastic log names the slow rank
+    assert "[fleet] straggler: rank=2" in err, tail
+
+
+@pytest.mark.timeout(600)
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_fleet_goodput_reformation(tmp_path):
+    """`make obsfleet` (ISSUE 9 acceptance): on the 4-process elastic
+    chaos drill (SIGKILL rank 2 at step 7), tools/fleetreport.py produces
+    ONE merged report covering all ranks and generations whose goodput
+    buckets sum to wall time (±1%), with the re-formation interval
+    attributed to downtime (goodput < 1.0, nonzero reformation bucket)."""
+    res, out, _losses = _run_drill(
+        tmp_path, "fleet",
+        elastic_args=("--elastic", "--max-restarts", "2", "--grace", "3"),
+        kill_rank=2, kill_step=7)
+    tail = (res.stdout + res.stderr)[-3000:]
+    assert res.returncode == 0, tail
+    assert "[elastic] job complete" in res.stderr, tail
+    assert out is not None and out["gen"] == 1, tail
+    # the supervisor's final fleet pass prints the goodput one-liner
+    assert "[fleet] goodput=" in res.stderr, tail
+
+    s = _fleetreport_json(tmp_path / "fleet" / "fleet")
+    assert sorted(int(r) for r in s["ranks"]) == [0, 1, 2, 3]
+    assert s["generations"] == [0, 1]
+    for r, rs in s["ranks"].items():
+        assert rs["step_seconds"]["count"] > 0, (r, rs)
+    g = s["goodput"]
+    assert g is not None
+    total = sum(g["buckets"].values())
+    assert abs(total - g["wall_seconds"]) <= 0.01 * g["wall_seconds"], g
+    assert g["buckets"]["reformation"] > 0, g
+    assert g["buckets"]["train"] > 0, g
+    assert 0.0 < g["goodput"] < 1.0, g
+    # every rank's FLOPs/step gauge made it into the merged report
+    assert any(rs.get("flops_per_step") for rs in s["ranks"].values()), s
 
 
 @pytest.mark.timeout(300)

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Render the fleet observability report from a shared fleet directory
+(docs/OBSERVABILITY.md "Fleet view").
+
+Reads every rank's ``telemetry-h{rank}/`` snapshots (all generations),
+merges them through :class:`mxnet_tpu.observability.fleet.FleetAggregator`
+and prints one operator-facing summary: per-rank step-time /
+collective-wait distributions, the straggler/skew timeline, the goodput
+ledger (productive train vs checkpoint / restore / re-formation downtime /
+data stalls / idle), MFU, and serving rollups (TTFT + decode-rate
+percentiles, slot utilization).
+
+Usage::
+
+    python tools/fleetreport.py FLEET_DIR            # table
+    python tools/fleetreport.py FLEET_DIR --json     # machine-readable
+
+Exits non-zero when the directory holds no rank telemetry (the
+``make obsfleet`` gate relies on this).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _fmt_s(v):
+    if v is None:
+        return "-"
+    return f"{v * 1e3:.2f} ms" if v < 1.0 else f"{v:.3f} s"
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+
+
+def _fmt_flops(v):
+    if not v:
+        return "-"
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(v) < 1000 or unit == "P":
+            return f"{v:.2f} {unit}FLOP"
+        v /= 1000.0
+
+
+def render(s: dict) -> str:
+    out = []
+    w = out.append
+    w(f"== fleet report: {s['directory']}")
+    w(f"   ranks={len(s['ranks'])} generations={s['generations']} "
+      f"events={s['n_events']} torn_snapshots={s['torn_snapshots']}")
+
+    w("-- per-rank")
+    w(f"   {'rank':>4} {'gens':>6} {'steps':>6} {'step p50':>10} "
+      f"{'step p95':>10} {'wait p50':>10} {'wait p95':>10} "
+      f"{'comm':>10} {'tok/s':>9} {'mfu':>7}")
+    for r, rs in sorted(s["ranks"].items(), key=lambda kv: int(kv[0])):
+        st, wt = rs["step_seconds"], rs["collective_wait_seconds"]
+        comm = sum(rs["comm_bytes"].values())
+        w(f"   {rs['rank']:>4} {','.join(map(str, rs['generations'])):>6} "
+          f"{st['count']:>6} {_fmt_s(st['p50']):>10} {_fmt_s(st['p95']):>10} "
+          f"{_fmt_s(wt['p50']):>10} {_fmt_s(wt['p95']):>10} "
+          f"{_fmt_bytes(comm):>10} "
+          f"{rs['tokens_per_sec'] and round(rs['tokens_per_sec']) or '-':>9} "
+          f"{rs['mfu'] is not None and format(rs['mfu'], '.4g') or '-':>7}")
+
+    if s["stragglers"]:
+        w("-- stragglers")
+        for t in s["stragglers"]:
+            where = (f"gen={t.get('generation')} step={t.get('step')}"
+                     if t["kind"] == "step" else "collective wait")
+            w(f"   rank {t['rank']}: {where} {_fmt_s(t['seconds'])} "
+              f"vs fleet median {_fmt_s(t['median_seconds'])} "
+              f"({t['ratio']}x)")
+    else:
+        w("-- stragglers: none")
+
+    tl = s["skew_timeline"]
+    if tl:
+        worst = sorted(tl, key=lambda t: -t["skew_seconds"])[:5]
+        w("-- skew timeline (worst steps)")
+        for t in worst:
+            w(f"   gen={t['generation']} step={t['step']}: "
+              f"skew={_fmt_s(t['skew_seconds'])} "
+              f"(median {_fmt_s(t['median_seconds'])}, "
+              f"slowest rank {t['slowest_rank']})")
+
+    g = s["goodput"]
+    if g:
+        w("-- goodput")
+        w(f"   wall={g['wall_seconds']:.3f}s  goodput={g['goodput']:.3f}")
+        for cat, v in sorted(g["buckets"].items(), key=lambda kv: -kv[1]):
+            if v > 0:
+                w(f"   {cat:>12}: {v:9.3f}s "
+                  f"({100.0 * v / g['wall_seconds']:5.1f}%)"
+                  if g["wall_seconds"] else f"   {cat:>12}: {v:9.3f}s")
+
+    flops = [rs["flops_per_step"] for rs in s["ranks"].values()
+             if rs.get("flops_per_step")]
+    mfus = [rs["mfu"] for rs in s["ranks"].values()
+            if rs.get("mfu") is not None]
+    if flops or mfus:
+        w("-- mfu")
+        if flops:
+            w(f"   model flops/step: {_fmt_flops(max(flops))}")
+        if mfus:
+            w(f"   train_mfu: mean={sum(mfus) / len(mfus):.4g} "
+              f"max={max(mfus):.4g}")
+
+    sv = s["serving"]
+    if sv:
+        w("-- serving")
+        for name in ("ttft_seconds", "decode_tokens_per_s"):
+            h = sv.get(name)
+            if h:
+                unit = _fmt_s if name == "ttft_seconds" else \
+                    (lambda v: f"{v:.0f}/s" if v is not None else "-")
+                w(f"   {name}: n={h['count']} p50={unit(h['p50'])} "
+                  f"p95={unit(h['p95'])} p99={unit(h['p99'])}")
+        if "slot_utilization" in sv:
+            w(f"   slot utilization: {sv['slot_utilization']:.2f}")
+        if "requests" in sv:
+            w("   requests: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(sv["requests"].items())))
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("fleet_dir",
+                    help="shared fleet directory (telemetry-h{rank}/ dirs)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged report as JSON")
+    ap.add_argument("--straggler-factor", type=float, default=None,
+                    help="override MXNET_TPU_STRAGGLER_FACTOR")
+    ap.add_argument("--peak-flops", type=float, default=None,
+                    help="override MXNET_TPU_PEAK_FLOPS for the MFU line")
+    args = ap.parse_args(argv)
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu.observability.fleet import FleetAggregator
+
+    agg = FleetAggregator(args.fleet_dir,
+                          straggler_factor=args.straggler_factor,
+                          peak_flops=args.peak_flops)
+    report = agg.collect()
+    if report is None:
+        print(f"fleetreport: no rank telemetry under {args.fleet_dir!r} "
+              "(expected telemetry-h{rank}/ snapshot dirs)", file=sys.stderr)
+        return 1
+    s = report.summary()
+    print(json.dumps(s, indent=1, sort_keys=True) if args.json
+          else render(s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

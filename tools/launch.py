@@ -150,7 +150,8 @@ class ElasticSupervisor:
     def __init__(self, n: int, command: list[str], max_restarts: int = 3,
                  policy: str = "replace", min_workers: int = 1,
                  grace: float = 5.0, hb_dir: str | None = None,
-                 poll_interval: float = 0.2):
+                 poll_interval: float = 0.2, fleet_dir: str | None = None,
+                 fleet_poll: float = 3.0):
         self.world = n
         self.command = command
         self.max_restarts = max_restarts
@@ -160,6 +161,15 @@ class ElasticSupervisor:
         self.poll_interval = poll_interval
         self._own_hb = hb_dir is None
         self.hb_base = hb_dir or tempfile.mkdtemp(prefix="mxtpu-elastic-hb-")
+        # fleet observability (docs/OBSERVABILITY.md "Fleet view"): workers
+        # snapshot per-rank telemetry here; the supervisor aggregates it on
+        # a cadence and surfaces stragglers/goodput in its own log, so an
+        # operator sees WHY a generation is slow before it dies
+        self.fleet_dir = (fleet_dir or os.environ.get("MXNET_TPU_FLEET_DIR")
+                          or os.path.join(self.hb_base, "fleet"))
+        self.fleet_poll = fleet_poll
+        self._fleet_agg = None  # lazily built; False = unavailable
+        self._fleet_next = 0.0
         self.generation = 0
         self.reformations = 0
 
@@ -168,12 +178,17 @@ class ElasticSupervisor:
         coord = f"127.0.0.1:{port}"
         gen_hb = os.path.join(self.hb_base, f"gen-{self.generation}")
         os.makedirs(gen_hb, exist_ok=True)
+        try:
+            os.makedirs(self.fleet_dir, exist_ok=True)
+        except OSError:
+            pass
         extra = {
             "MXNET_TPU_ELASTIC": "1",
             "MXNET_TPU_GENERATION": str(self.generation),
             "MXNET_TPU_ELASTIC_CAUSE": cause,
             "MXNET_TPU_PREV_WORLD": str(prev_world),
             "MXNET_TPU_HEARTBEAT_DIR": gen_hb,
+            "MXNET_TPU_FLEET_DIR": self.fleet_dir,
         }
         sys.stderr.write(
             f"[elastic] generation {self.generation}: world={self.world} "
@@ -195,16 +210,69 @@ class ElasticSupervisor:
             return max(self.min_workers, self.world - n_died)
         return self.world
 
+    # -- fleet view (docs/OBSERVABILITY.md "Fleet view") ---------------------
+    def _fleet_aggregator(self):
+        """Lazily import the aggregator; the supervisor must keep working
+        from an environment where the package cannot import (fleet
+        surfacing simply turns off)."""
+        if self._fleet_agg is None:
+            try:
+                sys.path.insert(0, os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))))
+                from mxnet_tpu.observability.fleet import FleetAggregator
+
+                self._fleet_agg = FleetAggregator(self.fleet_dir)
+            except Exception as e:  # no package / no deps: disable quietly
+                sys.stderr.write(f"[fleet] aggregation unavailable: {e}\n")
+                self._fleet_agg = False
+        return self._fleet_agg or None
+
+    def _fleet_check(self, final: bool = False) -> None:
+        """Cadenced aggregation pass: surface NEW stragglers in the
+        supervisor log; on the final pass also print the goodput/MFU
+        one-liner. Never raises — observability must not kill the job."""
+        now = time.time()
+        if not final and now < self._fleet_next:
+            return
+        self._fleet_next = now + self.fleet_poll
+        agg = self._fleet_aggregator()
+        if agg is None:
+            return
+        try:
+            report, new = agg.poll()
+        except Exception as e:
+            sys.stderr.write(f"[fleet] aggregation failed: {e}\n")
+            return
+        for s in new:
+            where = (f"gen={s.get('generation')} step={s.get('step')}"
+                     if s["kind"] == "step" else "collective wait")
+            sys.stderr.write(
+                f"[fleet] straggler: rank={s['rank']} {where} "
+                f"{s['seconds']:.3f}s vs median "
+                f"{s['median_seconds']:.3f}s ({s['ratio']}x)\n")
+        if final and report is not None and report.goodput is not None:
+            g = report.goodput
+            buckets = " ".join(
+                f"{k}={v:.1f}s" for k, v in sorted(g.buckets.items())
+                if v > 0)
+            mfus = [r.mfu for r in report.ranks.values()
+                    if r.mfu is not None]
+            mfu = f" mfu={max(mfus):.4g}" if mfus else ""
+            sys.stderr.write(f"[fleet] goodput={g.goodput:.3f} "
+                             f"wall={g.wall:.1f}s {buckets}{mfu}\n")
+
     def run(self) -> int:
         try:
             return self._run()
         finally:
+            self._fleet_check(final=True)
             if self._own_hb:
                 shutil.rmtree(self.hb_base, ignore_errors=True)
 
     def _run(self) -> int:
         procs = self._spawn(cause="", prev_world=self.world)
         while True:
+            self._fleet_check()
             codes = [p.poll() for p in procs]
             bad = [c for c in codes if c not in (None, 0)]
             if not bad:
@@ -262,6 +330,11 @@ def main():
                     help="floor for --elastic-policy shrink")
     ap.add_argument("--grace", type=float, default=5.0,
                     help="seconds between SIGTERM and SIGKILL at teardown")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="shared fleet-telemetry directory exported to "
+                         "workers as MXNET_TPU_FLEET_DIR (default: env "
+                         "value, else a dir beside the heartbeat base); "
+                         "the supervisor aggregates it and logs stragglers")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     if not args.command:
@@ -271,7 +344,8 @@ def main():
             sup = ElasticSupervisor(
                 args.num_workers, args.command,
                 max_restarts=args.max_restarts, policy=args.elastic_policy,
-                min_workers=args.min_workers, grace=args.grace)
+                min_workers=args.min_workers, grace=args.grace,
+                fleet_dir=args.fleet_dir)
             sys.exit(sup.run())
         sys.exit(launch_local(args.num_workers, args.command,
                               grace=args.grace))
