@@ -109,13 +109,20 @@ obsfleet: native
 perfwin: native
 	$(PY) tools/benchall.py --window 4 --out BENCH_r06.json
 
-# compiled-generation gate (docs/INFERENCE.md): cached KV decode vs the
-# naive re-forward loop on a tiny GPT-2, CPU, median of alternating A/B
-# pairs — FAILS unless tokens match, amortized per-token speedup >= 3x,
-# and exactly (prefill buckets used + 1) programs were lowered; artifact
-# committed as GENBENCH_r01.json
+# compiled-generation gates (docs/INFERENCE.md), tiny GPT-2, CPU, median
+# of alternating A/B pairs, identical greedy tokens required everywhere:
+#   cached vs naive  — >= 3x amortized per-token over the eager re-forward
+#                      loop, exactly (prefill buckets used + 1) programs;
+#   paged vs dense   — >= 4x concurrent sequences at equal cache memory
+#                      (page pool == dense token capacity), bytes-of-cache
+#                      per admitted sequence down accordingly, serving
+#                      tokens/sec up at the high slot count;
+#   spec vs paged    — self-drafting speculative decode >= 1.5x amortized
+#                      tokens/sec over the paged non-speculative engine,
+#                      exactly (buckets + 1 decode + 1 verify) programs.
+# artifact committed as GENBENCH_r02.json
 genbench:
-	$(PY) tools/genbench.py --out GENBENCH_r01.json
+	$(PY) tools/genbench.py --out GENBENCH_r02.json
 
 # compiled mixed-precision gate (docs/PERFORMANCE.md "Mixed precision"):
 # HLO dtype assertions (bf16 dots + f32 master update, f16 loss scaling

@@ -2,13 +2,20 @@
 """Compiled autoregressive generation + continuous-batching demo
 (docs/INFERENCE.md).
 
-Builds a small GPT-2, stands up the two-program generation engine
-(bucketed prefill + one donated decode step), and serves a burst of
-mixed-length requests through the slot-based continuous batcher while
-printing per-request TTFT / throughput. Runs in seconds on CPU:
+Builds a small GPT-2, stands up the generation engine (bucketed prefill +
+one donated decode step), and serves a burst of mixed-length requests
+through the slot-based continuous batcher while printing per-request
+TTFT / throughput. Runs in seconds on CPU:
 
   python examples/generate_gpt2.py
   python examples/generate_gpt2.py --model gpt2_117m --batch-size 8
+  python examples/generate_gpt2.py --paged --num-pages 24
+  python examples/generate_gpt2.py --paged --speculate 4
+
+``--paged`` swaps the dense per-slot cache for the page-pool cache
+(admission bounded by free pages; pages-in-use printed per run) and
+``--speculate k`` adds self-drafting speculative decoding on top (accept
+rate printed; greedy tokens stay identical).
 """
 import argparse
 import os
@@ -38,6 +45,15 @@ def main():
     ap.add_argument("--sampling", default="greedy",
                     choices=["greedy", "temperature", "top_k"])
     ap.add_argument("--temperature", type=float, default=0.8)
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV cache: global page pool + per-row page "
+                         "tables (docs/INFERENCE.md 'Paged cache')")
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--num-pages", type=int, default=None,
+                    help="pool capacity in pages (default: dense-equivalent)")
+    ap.add_argument("--speculate", type=int, default=0, metavar="K",
+                    help="self-drafting speculative decode, K tokens/round "
+                         "(implies --paged, forces greedy)")
     args = ap.parse_args()
 
     mx.random.seed(0)
@@ -46,18 +62,26 @@ def main():
     net.initialize()
     _ = net(nd.array(np.zeros((1, 4)), dtype="int32"))  # materialize params
 
+    paged = args.paged or args.speculate > 0
+    sampling = ("greedy" if args.speculate else
+                SamplingConfig(method=args.sampling,
+                               temperature=args.temperature))
     eng = GenerationEngine(
         net, batch_size=args.batch_size, max_length=args.max_length,
         prefill_buckets=(16, 32, 64), eos_id=None, pad_id=0,
-        sampling=SamplingConfig(method=args.sampling,
-                                temperature=args.temperature))
+        sampling=sampling, paged=paged, page_size=args.page_size,
+        num_pages=args.num_pages,
+        draft_net=net if args.speculate else None,
+        speculate_k=args.speculate)
     bat = ContinuousBatcher(eng)
 
     rs = np.random.RandomState(1)
     reqs = [bat.submit(list(rs.randint(1, args.vocab, rs.randint(4, 48))),
                        max_new_tokens=args.max_new_tokens)
             for _ in range(args.requests)]
-    bat.run_until_idle()
+    peak_pages = 0
+    while bat.step():
+        peak_pages = max(peak_pages, eng.pages_in_use)
 
     for r in reqs:
         toks = r.result()
@@ -65,9 +89,21 @@ def main():
               f"ttft={1e3 * r.ttft:7.1f} ms  generated={len(toks):3d}  "
               f"[{', '.join(map(str, toks[:8]))}{', ...' if len(toks) > 8 else ''}]")
     programs = REGISTRY.get("gen_recompiles_total")
-    print(f"\ncompiled programs: {eng.compiled_programs} "
-          f"(prefill buckets used + 1 decode) — "
+    kind = ("prefill buckets used + 1 draft + 1 verify" if eng.speculative
+            else "prefill buckets used + 1 decode")
+    print(f"\ncompiled programs: {eng.compiled_programs} ({kind}) — "
           f"{int(programs.total()) if programs else 0} counted by telemetry")
+    if paged:
+        print(f"pages: peak {peak_pages}/{eng.num_pages} in use "
+              f"(page_size {eng.page_size}, now {eng.pages_in_use} held)")
+    if eng.speculative:
+        rate = REGISTRY.get("gen_spec_accept_rate")
+        acc = REGISTRY.get("gen_spec_accepted_tokens_total")
+        drf = REGISTRY.get("gen_spec_drafted_tokens_total")
+        overall = (acc.total() / drf.total()) if acc and drf else float("nan")
+        last = rate.value() if rate is not None else float("nan")
+        print(f"speculative k={eng.speculate_k}: accept rate "
+              f"{overall:.2f} overall ({last:.2f} last round)")
 
 
 if __name__ == "__main__":

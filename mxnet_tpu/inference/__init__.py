@@ -2,13 +2,19 @@
 
 Two pieces:
 
-  - :class:`GenerationEngine` — exactly two jitted program families for
+  - :class:`GenerationEngine` — a fixed family of jitted programs for
     token generation: bucketed-length *prefill* (one XLA program per prompt
     bucket) and a single-token *decode step* (one program, donated KV-cache
-    carry, sampling + EOS masking compiled in);
+    carry, sampling + EOS masking compiled in). ``paged=True`` swaps the
+    per-row contiguous cache for a global page pool with per-row int32
+    page tables riding the compiled carry (admission bounded by free
+    pages, not slots); ``draft_net=``/``speculate_k=`` adds draft-model
+    speculative decoding on top (one compiled draft scan + one verify
+    program per round, greedy output token-identical to plain decoding);
   - :class:`ContinuousBatcher` — slot-based continuous batching: queued
     requests are admitted into free rows of the static decode batch at step
-    boundaries, so serving never changes a shape and never recompiles.
+    boundaries (page-bounded on a paged engine), so serving never changes
+    a shape and never recompiles.
 """
 from .engine import GenerationEngine, SamplingConfig  # noqa: F401
 from .batcher import ContinuousBatcher, GenRequest  # noqa: F401

@@ -4,7 +4,21 @@ The decode batch is a fixed (B, …) shape; a *slot* is one row of it.
 Queued requests are admitted into free slots only at step boundaries —
 admission is a batch-1 prefill program writing one cache row, so joining
 traffic never changes a shape and never recompiles anything. Finished rows
-(EOS, token budget, or cache end) free their slot for the next request.
+(EOS, token budget, cache end, or page exhaustion) free their slot — and,
+on a paged engine, their pages — for the next request.
+
+On a **paged** engine (docs/INFERENCE.md "Paged cache") admission is
+bounded by free *pages*, not just free slots: a request is admitted only
+when the pool can cover its prompt; otherwise it stays queued and the
+deferral is counted (``gen_admission_rejects_total{reason="free_pages"}``).
+Prompts that could never fit (no bucket, or more pages than the whole
+pool) are rejected at ``submit`` with the matching reason, instead of
+overflowing mid-decode.
+
+On a **speculative** engine each step is one draft+verify round emitting
+up to ``speculate_k + 1`` tokens per row; outputs are truncated at each
+request's token budget, so results are identical to non-speculative
+serving.
 
 Serving telemetry (docs/OBSERVABILITY.md):
 
@@ -14,7 +28,9 @@ Serving telemetry (docs/OBSERVABILITY.md):
                                 per request;
   - ``gen_queue_depth``       — requests waiting for a slot (gauge);
   - ``gen_active_slots``      — rows currently decoding (gauge);
-  - ``gen_requests_total{reason=...}`` — completions by finish reason.
+  - ``gen_requests_total{reason=...}`` — completions by finish reason;
+  - ``gen_admission_rejects_total{reason=...}`` — submit-time rejects and
+                                page-bounded admission deferrals.
 """
 from __future__ import annotations
 
@@ -37,7 +53,8 @@ class GenRequest:
         self.max_new_tokens = int(max_new_tokens)
         self.output: List[int] = []
         self.slot: Optional[int] = None
-        self.finish_reason: Optional[str] = None  # eos | length | cache_full
+        # eos | length | cache_full | page_exhausted
+        self.finish_reason: Optional[str] = None
         self.submit_t = time.perf_counter()
         self.first_token_t: Optional[float] = None
         self.finish_t: Optional[float] = None
@@ -73,7 +90,21 @@ class ContinuousBatcher:
             raise ValueError("max_new_tokens must be >= 1")
         if len(prompt) < 1:
             raise ValueError("empty prompt")
-        self.engine.bucket_for(len(prompt))  # reject oversize prompts now
+        try:
+            self.engine.bucket_for(len(prompt))  # reject oversize prompts now
+        except ValueError:
+            _obs.counter("gen_admission_rejects_total",
+                         "requests rejected or deferred at admission").inc(
+                             reason="prompt_length")
+            raise
+        if (self.engine.paged
+                and self.engine.pages_for(len(prompt)) > self.engine.num_pages):
+            _obs.counter("gen_admission_rejects_total",
+                         "requests rejected or deferred at admission").inc(
+                             reason="prompt_pages")
+            raise ValueError(
+                f"prompt needs {self.engine.pages_for(len(prompt))} pages; "
+                f"the whole pool holds {self.engine.num_pages}")
         req = GenRequest(next(self._ids), prompt, max_new_tokens)
         self._queue.append(req)
         self._gauges()
@@ -110,12 +141,22 @@ class ContinuousBatcher:
 
     def _admit(self):
         """Step-boundary admission: fill free slots FIFO. Each admission is
-        one bucketed prefill (no shape change for the running rows)."""
+        one bucketed prefill (no shape change for the running rows). On a
+        paged engine a request is only admitted when the pool can cover its
+        prompt — FIFO order is preserved (no later request jumps a parked
+        head-of-queue), the deferral is counted."""
         for slot in range(self.engine.batch_size):
             if not self._queue:
                 break
             if self._slots[slot] is not None:
                 continue
+            if (self.engine.paged
+                    and self.engine.free_pages
+                    < self.engine.pages_for(len(self._queue[0].prompt))):
+                _obs.counter("gen_admission_rejects_total",
+                             "requests rejected or deferred at admission").inc(
+                                 reason="free_pages")
+                break
             req = self._queue.popleft()
             req.slot = slot
             self._slots[slot] = req
@@ -129,27 +170,62 @@ class ContinuousBatcher:
             elif req.max_new_tokens == 1:
                 self._finish(slot, "length")
 
+    def _done_reason(self, slot: int, last_token) -> str:
+        """Why the engine marked this row done: a sampled EOS, a forced
+        cache-end finish, or (paged) a page-pool eviction."""
+        if (self.engine.paged
+                and bool(self.engine.page_exhausted[slot])):
+            return "page_exhausted"
+        if (self.engine.eos_id is not None
+                and last_token == self.engine.eos_id):
+            return "eos"
+        if self.engine.positions[slot] >= self.engine.max_length:
+            return "cache_full"
+        return "eos"
+
     def step(self) -> bool:
-        """Admit, then run one compiled decode step. Returns True while any
-        work (active rows or queued requests) remains."""
+        """Admit, then run one compiled decode step (or one speculative
+        draft+verify round). Returns True while any work (active rows or
+        queued requests) remains."""
         self._admit()
         self._gauges()
         if self.active == 0:
             return bool(self._queue)
         was_active = [s for s, r in enumerate(self._slots) if r is not None]
-        tok, done, _ = self.engine.decode_step()
-        for slot in was_active:
-            req = self._slots[slot]
-            req.output.append(int(tok[slot]))
-            if done[slot]:
-                # distinguish a sampled EOS from a forced cache-end finish
-                hit_end = self.engine.positions[slot] >= self.engine.max_length
-                sampled_eos = (self.engine.eos_id is not None
-                               and req.output[-1] == self.engine.eos_id)
-                self._finish(slot, "eos" if sampled_eos else
-                             ("cache_full" if hit_end else "eos"))
-            elif len(req.output) >= req.max_new_tokens:
-                self._finish(slot, "length")
+        if getattr(self.engine, "speculative", False):
+            toks, counts, done = self.engine.spec_step()
+            for slot in was_active:
+                req = self._slots[slot]
+                n = int(counts[slot])
+                appended = 0
+                for j in range(n):
+                    req.output.append(int(toks[slot, j]))
+                    appended += 1
+                    if len(req.output) >= req.max_new_tokens:
+                        break
+                if appended < n:  # budget hit inside the window
+                    self._finish(slot, "length")
+                elif done[slot]:
+                    self._finish(slot, self._done_reason(
+                        slot, req.output[-1] if req.output else None))
+                elif len(req.output) >= req.max_new_tokens:
+                    self._finish(slot, "length")
+        else:
+            tok, done, _ = self.engine.decode_step()
+            for slot in was_active:
+                req = self._slots[slot]
+                if (self.engine.paged and done[slot]
+                        and bool(self.engine.page_exhausted[slot])):
+                    # evicted BEFORE the dispatch: the row emitted pad this
+                    # step, not a token — finish without appending it
+                    self._finish(slot, "page_exhausted")
+                    continue
+                req.output.append(int(tok[slot]))
+                if done[slot]:
+                    self._finish(slot,
+                                 self._done_reason(slot, req.output[-1]))
+                elif len(req.output) >= req.max_new_tokens:
+                    self._finish(slot, "length")
         self._gauges()
         return bool(self._queue) or self.active > 0
 

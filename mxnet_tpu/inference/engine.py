@@ -4,28 +4,47 @@ The training insight of ``TrainStep.run`` — one donated jit program instead
 of a per-step dispatch storm — applied to decoding. A naive sampling loop
 re-forwards the whole growing sequence every token: O(N·L²) attention
 recompute plus a fresh dispatch (or, hybridized, a fresh *compile* per
-growing shape). This engine runs exactly two compiled program families:
+growing shape). This engine runs a fixed family of compiled programs:
 
   - **prefill** — the prompt, padded to a static bucket length, runs one
     cached causal forward that writes the prompt's K/V into one row of the
-    static decode cache and samples the first new token. One XLA program
-    per bucket length, batch-1 row insert (``lax.dynamic_update_slice`` at
-    the slot index), so admitting a request never touches the other rows.
-  - **decode** — one token for every row of the static batch: cache update
-    via per-row ``dynamic_update_slice``, attention against the full
-    buffers, sampling (greedy / temperature / top-k) and per-row EOS
-    done-masking all compiled in. The cache is a donated carry, so XLA
-    updates it in place.
+    decode cache and samples the first new token. One XLA program per
+    bucket length; admitting a request never touches the other rows.
+  - **decode** — one token for every row of the static batch: cache update,
+    attention against the full history, sampling (greedy / temperature /
+    top-k) and per-row EOS done-masking all compiled in. The cache is a
+    donated carry, so XLA updates it in place.
+
+Two serving-scale extensions ride the same no-shape-change discipline:
+
+  - **paged cache** (``paged=True``) — instead of per-row contiguous
+    (B, H, Tmax, Ch) buffers, K/V live in a global pool of fixed-size
+    pages; each row owns an int32 *page table* riding the compiled carry.
+    Admission is bounded by free pages, not slots, so a batch of short
+    sequences no longer pays ``Tmax − actual_len`` dead memory per row.
+    Pages are reclaimed on ``release_slot``/EOS; a released row's table is
+    cleared in-program and its (masked) writes redirect to a reserved
+    trash page, so reallocated pages can never be corrupted.
+  - **speculative decoding** (``draft_net=`` + ``speculate_k=``) — a small
+    draft model proposes k tokens through its own paged cache in ONE
+    compiled ``lax.scan`` program, and one target-model *verify* program
+    scores all k+1 positions at once: accepted prefixes advance the page
+    table in-place, rejected tails simply don't advance the write frontier
+    (stale entries stay masked and are overwritten next round). Greedy
+    output is token-identical to the non-speculative path; each round costs
+    2 dispatches for up to k+1 tokens.
 
 Nothing in the serving loop changes a shape, so the compiled-program count
-is exactly ``len(buckets used) + 1`` — counted through the observability
-registry (``gen_recompiles_total{reason="prefill_bucket"|"decode"}``), the
-same discipline as ``train_recompiles_total``.
+is exactly ``len(buckets used) + 1`` (+1 verify when speculating) — counted
+through the observability registry (``gen_recompiles_total{reason=
+"prefill_bucket"|"decode"|"verify"}``), the same discipline as
+``train_recompiles_total``.
 """
 from __future__ import annotations
 
 import dataclasses
 import time
+from collections import deque
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -74,23 +93,37 @@ class GenerationEngine:
     Parameters
     ----------
     net : GPT2Model (or any block whose ``hybrid_forward`` threads
-        ``cache=``/``start_pos=`` and that provides ``init_cache``).
+        ``cache=``/``start_pos=`` (and, for paged mode, ``page_table=``)
+        and that provides ``init_cache``/``init_paged_cache``).
         Must be initialized; dropout should be 0 for exact equivalence
         (evaluation mode disables it regardless).
     batch_size : rows of the static decode batch (= serving slots).
-    max_length : KV-cache length per row (default: the net's max_length).
+    max_length : per-row sequence capacity (default: the net's max_length).
     prefill_buckets : ascending prompt-length buckets; each bucket used
         costs one prefill compile. Default: powers of two from 16.
     eos_id : token that finishes a row (compiled into the done-mask);
         None = rows only finish by max_new_tokens.
     pad_id : token emitted by finished rows and used for prompt padding.
     sampling : SamplingConfig (or method string), compiled in.
+    paged : store K/V in a global page pool instead of per-row contiguous
+        buffers (docs/INFERENCE.md "Paged cache").
+    page_size : tokens per page (paged mode).
+    num_pages : pool capacity in pages, excluding the reserved trash page.
+        Default: ``batch_size * ceil(max_length / page_size)`` (the
+        dense-equivalent capacity — size it DOWN to oversubscribe slots).
+    draft_net : small initialized model drafting ``speculate_k`` tokens per
+        round through its own paged cache (requires ``paged=True`` and
+        greedy sampling; pass ``net`` itself to self-draft).
+    speculate_k : draft window length per speculative round.
     """
 
     def __init__(self, net, batch_size: int = 4, max_length: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  eos_id: Optional[int] = None, pad_id: int = 0,
-                 sampling=None, cache_dtype: str = "float32"):
+                 sampling=None, cache_dtype: str = "float32",
+                 paged: bool = False, page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 draft_net=None, speculate_k: int = 0):
         self.net = net
         self.batch_size = int(batch_size)
         self.max_length = int(max_length or net._max_length)
@@ -113,22 +146,106 @@ class GenerationEngine:
             if p._nd is None:
                 raise ValueError(f"parameter {p.name} not initialized; run "
                                  "one forward pass first")
-        #: device state: per-layer (k_buf, v_buf), the donated decode carry
-        self.cache = net.init_cache(self.batch_size, self.max_length,
-                                    dtype=cache_dtype)
+
+        # -- paged / speculative configuration --------------------------------
+        self.paged = bool(paged)
+        self.page_size = int(page_size)
+        self.speculate_k = int(speculate_k)
+        self.draft_net = draft_net
+        if (self.speculate_k > 0) != (draft_net is not None):
+            raise ValueError("speculative decoding needs BOTH draft_net= "
+                             "and speculate_k >= 1")
+        if draft_net is not None and not self.paged:
+            raise ValueError("speculative decoding rides the paged cache; "
+                             "pass paged=True")
+        if self.speculate_k and self.sampling.method != "greedy":
+            raise ValueError("speculative decoding supports greedy sampling "
+                             "only (verification is exact prefix matching)")
+
+        if self.paged:
+            if self.page_size < 1:
+                raise ValueError("page_size must be >= 1")
+            #: page-table width: page slots per row (slot s = positions
+            #: s*ps .. (s+1)*ps - 1)
+            self._n_row_pages = -(-self.max_length // self.page_size)
+            # explicit `is None` check: a computed num_pages that underflows
+            # to 0 must hit the error below, not the dense-equivalent default
+            self.num_pages = int(self.batch_size * self._n_row_pages
+                                 if num_pages is None else num_pages)
+            if self.num_pages < 1:
+                raise ValueError("num_pages must be >= 1")
+            #: device carry: per-row page tables (0 = unallocated/trash)
+            self.page_table = jnp.zeros(
+                (self.batch_size, self._n_row_pages), jnp.int32)
+            #: device carry: per-layer (k_pool, v_pool) page pools
+            self.pools = net.init_paged_cache(self.num_pages, self.page_size,
+                                              dtype=cache_dtype)
+            self.cache = None  # dense-only state
+            # host allocator (authoritative; the device table mirrors it
+            # through compiled update vectors shipped with each program)
+            self._free_pages: deque = deque(range(1, self.num_pages + 1))
+            self._row_pages: List[List[int]] = \
+                [[] for _ in range(self.batch_size)]
+            self._pending_clear: set = set()
+            #: rows force-finished because the pool ran dry (the batcher
+            #: reports these as finish_reason="page_exhausted")
+            self.page_exhausted = np.zeros(self.batch_size, bool)
+            # worst-case NEW pages per row per dispatch (window k spans at
+            # most k//ps + 2 page slots from an arbitrary start offset)
+            self._upd_width = self.speculate_k // self.page_size + 2
+            self._page_gauges()
+        else:
+            #: device state: per-layer (k_buf, v_buf), the donated carry
+            self.cache = net.init_cache(self.batch_size, self.max_length,
+                                        dtype=cache_dtype)
+
+        if draft_net is not None:
+            self._draft_plist = [p for _, p in
+                                 sorted(draft_net.collect_params().items())]
+            for p in self._draft_plist:
+                if p._nd is None:
+                    raise ValueError(f"draft parameter {p.name} not "
+                                     "initialized; run one forward first")
+            if draft_net._max_length < self.max_length:
+                raise ValueError(f"draft_net.max_length "
+                                 f"{draft_net._max_length} < engine "
+                                 f"max_length {self.max_length}")
+            self.draft_pools = draft_net.init_paged_cache(
+                self.num_pages, self.page_size, dtype=cache_dtype)
+
         # host state (tiny (B,) vectors shipped to the device each step —
         # keeping them host-side makes slot admission trivial)
         self.positions = np.zeros(self.batch_size, np.int32)
         self.done = np.ones(self.batch_size, bool)  # empty slots are "done"
         self.last_tokens = np.full(self.batch_size, self.pad_id, np.int32)
 
-        self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(1,),
-                                    static_argnums=())
-        self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(1,))
+        # keep_unused (paged families): flat input positions must be stable
+        # for audit()'s carry_indices even when a program has dead params
+        # (e.g. the spec prefill discards the draft's logits, killing its
+        # final-LN inputs). The dense pair keeps the default — its programs
+        # use every input and its shardcheck goldens predate this knob.
+        if not self.paged:
+            self._prefill_jit = jax.jit(self._prefill_fn, donate_argnums=(1,))
+            self._decode_jit = jax.jit(self._decode_fn, donate_argnums=(1,))
+        elif self.speculative:
+            self._prefill_jit = jax.jit(self._spec_prefill_fn,
+                                        donate_argnums=(2,),
+                                        keep_unused=True)
+            self._draft_jit = jax.jit(self._draft_fn, donate_argnums=(1,),
+                                      keep_unused=True)
+            self._verify_jit = jax.jit(self._verify_fn, donate_argnums=(1,),
+                                       keep_unused=True)
+        else:
+            self._prefill_jit = jax.jit(self._paged_prefill_fn,
+                                        donate_argnums=(1,),
+                                        keep_unused=True)
+            self._decode_jit = jax.jit(self._paged_decode_fn,
+                                       donate_argnums=(1,),
+                                       keep_unused=True)
         # lowered-program fingerprints seen (cf. TrainStep._note_recompile):
         # a miss means XLA compiles a new executable. Reasons are fixed by
-        # contract ("prefill_bucket"/"decode") — the guard supplies the
-        # event plumbing and the program count (docs/ANALYSIS.md).
+        # contract ("prefill_bucket"/"decode"/"verify") — the guard supplies
+        # the event plumbing and the program count (docs/ANALYSIS.md).
         from ..analysis import RecompileGuard
 
         self._recompile_guard = RecompileGuard(
@@ -141,8 +258,12 @@ class GenerationEngine:
     @property
     def compiled_programs(self) -> int:
         """How many XLA executables this engine has lowered (prefill buckets
-        actually used + the decode step)."""
+        actually used + the decode step [+ the verify step])."""
         return len(self._recompile_guard)
+
+    @property
+    def speculative(self) -> bool:
+        return self.speculate_k > 0
 
     def _note_program(self, sig, reason):
         from ..analysis import Fingerprint
@@ -150,6 +271,90 @@ class GenerationEngine:
         self._recompile_guard.observe(Fingerprint.of((), sig=sig),
                                       reason=reason, group=reason,
                                       sig=list(map(str, sig)))
+
+    # -- page accounting (paged mode) ----------------------------------------
+    @property
+    def free_pages(self) -> int:
+        """Unallocated pages in the pool (paged mode)."""
+        return len(self._free_pages) if self.paged else 0
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free_pages) if self.paged else 0
+
+    def pages_for(self, length: int) -> int:
+        """Pages a ``length``-token sequence occupies."""
+        return -(-int(length) // self.page_size)
+
+    def _page_gauges(self):
+        free = len(self._free_pages)
+        _obs.gauge("gen_pages_free",
+                   "free pages in the paged KV pool").set(free)
+        _obs.gauge("gen_pages_in_use",
+                   "allocated pages in the paged KV pool").set(
+                       self.num_pages - free)
+
+    def _reclaim_row(self, slot: int) -> int:
+        pages = self._row_pages[slot]
+        if not pages:
+            return 0
+        self._free_pages.extend(pages)
+        self._row_pages[slot] = []
+        _obs.counter("gen_pages_reclaimed_total",
+                     "pages returned to the free pool").inc(len(pages))
+        self._page_gauges()
+        return len(pages)
+
+    def _grow_pages(self, window: int):
+        """Allocate pages so every active row's table covers positions
+        ``p .. min(p + window, max_length - 1)``; rows that cannot even
+        cover their next write are force-finished (evicted) with
+        ``gen_page_evictions_total``. Returns the (B, U) update vectors the
+        compiled program scatters into the page-table carry."""
+        ps = self.page_size
+        upd_slots = np.zeros((self.batch_size, self._upd_width), np.int32)
+        upd_pages = np.zeros((self.batch_size, self._upd_width), np.int32)
+        allocated = 0
+        for row in range(self.batch_size):
+            if self.done[row]:
+                continue
+            p = int(self.positions[row])
+            need = min(p + window, self.max_length - 1) // ps + 1
+            u = 0
+            while len(self._row_pages[row]) < need:
+                if not self._free_pages:
+                    if len(self._row_pages[row]) * ps <= p:
+                        # cannot write the next token: evict the row
+                        self.done[row] = True
+                        self.page_exhausted[row] = True
+                        _obs.counter(
+                            "gen_page_evictions_total",
+                            "rows force-finished on page exhaustion").inc(
+                                reason="exhausted")
+                    break
+                pid = self._free_pages.popleft()
+                upd_slots[row, u] = len(self._row_pages[row])
+                upd_pages[row, u] = pid
+                self._row_pages[row].append(pid)
+                u += 1
+                allocated += 1
+        if allocated:
+            _obs.counter("gen_page_allocs_total",
+                         "pages taken from the free pool").inc(
+                             allocated, site="decode")
+            self._page_gauges()
+        return upd_slots, upd_pages
+
+    def _take_clear_mask(self):
+        """Rows released since the last dispatch: their device page-table
+        rows are zeroed in-program BEFORE any write, so writes of a
+        released row can never land in a page the allocator has already
+        handed to someone else (they go to the trash page instead)."""
+        clear = np.zeros(self.batch_size, bool)
+        for s in self._pending_clear:
+            clear[s] = True
+        self._pending_clear.clear()
+        return clear
 
     # -- sampling (compiled into both programs) ------------------------------
     def _sample(self, logits2d, key):
@@ -175,7 +380,13 @@ class GenerationEngine:
     def _params(self):
         return tuple(p._nd._data for p in self._plist)
 
-    # -- pure programs -------------------------------------------------------
+    def _draft_params(self):
+        return tuple(p._nd._data for p in self._draft_plist)
+
+    def _cache_nd(self, pools):
+        return [(NDArray(k), NDArray(v)) for k, v in pools]
+
+    # -- pure programs (dense) -----------------------------------------------
     def _prefill_fn(self, params, cache, tokens, slot, length, key):
         """(params, cache, (1, Lb) tokens, slot, real length, key) ->
         (cache', first sampled token, last-prompt-position logits)."""
@@ -214,6 +425,150 @@ class GenerationEngine:
         new_cache = [tuple(b._data for b in layer) for layer in new_cache]
         return new_cache, next_tok.astype(jnp.int32), done, logits
 
+    # -- pure programs (paged) -----------------------------------------------
+    def _apply_table_updates(self, table, upd_slots, upd_pages, clear):
+        """Scatter the host allocator's decisions into the page-table carry:
+        install newly allocated pages ((B, U) slot/page vectors, page 0 =
+        no-op), then zero the rows of released slots."""
+        bidx = jnp.arange(self.batch_size, dtype=jnp.int32)[:, None]
+        cur = table[bidx, upd_slots]
+        table = table.at[bidx, upd_slots].set(
+            jnp.where(upd_pages > 0, upd_pages, cur))
+        return jnp.where(clear[:, None], 0, table)
+
+    def _paged_prefill_fn(self, params, carry, tokens, slot, length,
+                          new_row, key):
+        """Paged admission: install the row's freshly allocated page table,
+        run the cached causal forward through the pools (scatter writes land
+        only in this row's pages + trash), sample the TTFT token."""
+        table, pools = carry
+        table = jax.lax.dynamic_update_slice(table, new_row[None, :],
+                                             (slot, 0))
+        row_table = jax.lax.dynamic_slice(table, (slot, 0),
+                                          (1, self._n_row_pages))
+        start = jnp.zeros((1,), jnp.int32)
+        with _HybridTrace(self._plist, list(params), False, key):
+            logits, new_pools = self.net(
+                NDArray(tokens), cache=self._cache_nd(pools),
+                start_pos=NDArray(start), page_table=NDArray(row_table))
+        logits = logits._data  # (1, Lb, vocab)
+        new_pools = [tuple(b._data for b in layer) for layer in new_pools]
+        last = jax.lax.dynamic_index_in_dim(logits, length - 1, axis=1,
+                                            keepdims=False)[0]
+        tok = self._sample(last[None, :], key)[0].astype(jnp.int32)
+        return (table, new_pools), tok, last
+
+    def _spec_prefill_fn(self, params, dparams, carry, tokens, slot, length,
+                         new_row, key):
+        """Speculative admission: one program writes the prompt's K/V into
+        BOTH the target and the draft page pools (shared page table)."""
+        table, pools, dpools = carry
+        table = jax.lax.dynamic_update_slice(table, new_row[None, :],
+                                             (slot, 0))
+        row_table = jax.lax.dynamic_slice(table, (slot, 0),
+                                          (1, self._n_row_pages))
+        start = jnp.zeros((1,), jnp.int32)
+        with _HybridTrace(self._plist, list(params), False, key):
+            logits, new_pools = self.net(
+                NDArray(tokens), cache=self._cache_nd(pools),
+                start_pos=NDArray(start), page_table=NDArray(row_table))
+        with _HybridTrace(self._draft_plist, list(dparams), False, key):
+            _, new_dpools = self.draft_net(
+                NDArray(tokens), cache=self._cache_nd(dpools),
+                start_pos=NDArray(start), page_table=NDArray(row_table))
+        logits = logits._data
+        new_pools = [tuple(b._data for b in layer) for layer in new_pools]
+        new_dpools = [tuple(b._data for b in layer) for layer in new_dpools]
+        last = jax.lax.dynamic_index_in_dim(logits, length - 1, axis=1,
+                                            keepdims=False)[0]
+        tok = self._sample(last[None, :], key)[0].astype(jnp.int32)
+        return (table, new_pools, new_dpools), tok, last
+
+    def _paged_decode_fn(self, params, carry, tokens, positions, done,
+                         upd_slots, upd_pages, clear, key):
+        """The paged decode step: apply page-table updates, then exactly the
+        dense decode semantics with pool-indirect storage."""
+        table, pools = carry
+        table = self._apply_table_updates(table, upd_slots, upd_pages, clear)
+        with _HybridTrace(self._plist, list(params), False, key):
+            logits, new_pools = self.net(
+                NDArray(tokens.reshape(self.batch_size, 1)),
+                cache=self._cache_nd(pools), start_pos=NDArray(positions),
+                page_table=NDArray(table))
+        logits = logits._data[:, 0]
+        sampled = self._sample(logits, key)
+        next_tok = jnp.where(done, jnp.int32(self.pad_id), sampled)
+        if self.eos_id is not None:
+            done = done | (sampled == self.eos_id)
+        new_pools = [tuple(b._data for b in layer) for layer in new_pools]
+        return (table, new_pools), next_tok.astype(jnp.int32), done, logits
+
+    def _draft_fn(self, dparams, carry, tokens, positions, done,
+                  upd_slots, upd_pages, clear, key):
+        """Draft k tokens greedily through the draft model's paged cache —
+        the whole loop is ONE ``lax.scan`` program (one dispatch per
+        speculative round, not k). The scan runs k+1 steps: step i consumes
+        token i (t0, d1, …) writing its K/V at position p+i, so the LAST
+        drafted token's entry lands at p+k too — on a full accept the
+        frontier advances past it, and a skipped write there would leave a
+        permanent zero-K/V hole below the draft frontier. The k+1-th
+        sampled token is discarded."""
+        table, pools = carry
+        table = self._apply_table_updates(table, upd_slots, upd_pages, clear)
+
+        def step(c, i):
+            pools_c, tok = c
+            with _HybridTrace(self._draft_plist, list(dparams), False, key):
+                logits, new_pools = self.draft_net(
+                    NDArray(tok.reshape(self.batch_size, 1)),
+                    cache=self._cache_nd(pools_c),
+                    start_pos=NDArray(positions + i),
+                    page_table=NDArray(table))
+            new_pools = [tuple(b._data for b in layer)
+                         for layer in new_pools]
+            nxt = jnp.argmax(logits._data[:, 0], axis=-1).astype(jnp.int32)
+            return (new_pools, nxt), nxt
+
+        (pools, _), drafted = jax.lax.scan(
+            step, (pools, tokens),
+            jnp.arange(self.speculate_k + 1, dtype=jnp.int32))
+        return (table, pools), drafted[:self.speculate_k].T  # (B, k)
+
+    def _verify_fn(self, params, carry, tokens, drafted, positions, done,
+                   room, key):
+        """One target forward scores all k+1 positions: the longest drafted
+        prefix the target's own greedy choices agree with is accepted, plus
+        the target's correction token. Emission stops at the first EOS and
+        at ``room`` (remaining page-covered capacity); rejected tails just
+        don't advance the frontier — their K/V entries stay masked and are
+        overwritten next round. Returns (carry', (B, k+1) emitted tokens
+        padded with pad_id, per-row emit counts, done', accept counts)."""
+        table, pools = carry
+        k = self.speculate_k
+        x = jnp.concatenate([tokens[:, None], drafted], axis=1)  # (B, k+1)
+        with _HybridTrace(self._plist, list(params), False, key):
+            logits, new_pools = self.net(
+                NDArray(x), cache=self._cache_nd(pools),
+                start_pos=NDArray(positions), page_table=NDArray(table))
+        logits = logits._data  # (B, k+1, vocab)
+        new_pools = [tuple(b._data for b in layer) for layer in new_pools]
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # greedy next
+        match = (drafted == g[:, :k]).astype(jnp.int32)
+        acc = jnp.cumprod(match, axis=1).sum(axis=1)  # accepted drafts
+        m = acc + 1  # + the target's correction/bonus token
+        if self.eos_id is not None:
+            is_eos = g == self.eos_id
+            first = jnp.argmax(is_eos, axis=1).astype(jnp.int32)
+            m = jnp.minimum(m, jnp.where(is_eos.any(axis=1), first + 1,
+                                         k + 1))
+        m = jnp.minimum(m, jnp.maximum(room, 0))
+        m = jnp.where(done, 0, m)
+        emit = jnp.arange(k + 1, dtype=jnp.int32)[None, :] < m[:, None]
+        out = jnp.where(emit, g, jnp.int32(self.pad_id))
+        if self.eos_id is not None:
+            done = done | (emit & (g == self.eos_id)).any(axis=1)
+        return (table, new_pools), out, m, done, acc
+
     # -- host API ------------------------------------------------------------
     def bucket_for(self, length: int) -> int:
         for b in self.prefill_buckets:
@@ -225,7 +580,10 @@ class GenerationEngine:
     def prefill(self, prompt, slot: int) -> int:
         """Admit a prompt into row ``slot``: write its K/V into the cache,
         sample the first new token (returned as a host int — this sync is
-        the time-to-first-token point). Never touches other rows."""
+        the time-to-first-token point). Never touches other rows. In paged
+        mode, allocates ``pages_for(len(prompt))`` pages up front and raises
+        RuntimeError if the pool cannot cover them (the batcher checks
+        ``free_pages`` before admitting)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         length = prompt.size
         if not 0 < length:
@@ -236,12 +594,52 @@ class GenerationEngine:
         padded = np.full((1, bucket), self.pad_id, np.int32)
         padded[0, :length] = prompt
         t0 = time.perf_counter()
-        self._note_program(("prefill", bucket), "prefill_bucket")
-        cache, tok, last = self._prefill_jit(
-            self._params(), self.cache, jnp.asarray(padded),
-            jnp.asarray(slot, jnp.int32), jnp.asarray(length, jnp.int32),
-            self._next_key())
-        self.cache = cache
+        if self.paged:
+            need = self.pages_for(length)
+            # capacity check BEFORE any allocator mutation: a failed
+            # admission must leave the slot's pending table-clear (and its
+            # reclaimable pages) untouched, or a released row's stale
+            # device table could keep pointing at pages later handed to
+            # someone else (its masked writes would corrupt them)
+            if len(self._free_pages) + len(self._row_pages[slot]) < need:
+                raise RuntimeError(
+                    f"insufficient free pages for a {length}-token prompt "
+                    f"({need} needed, {len(self._free_pages)} free); release "
+                    "slots or raise num_pages")
+            self._reclaim_row(slot)  # previous occupant's pages, if any
+            self._pending_clear.discard(slot)  # the new row replaces it
+            self.page_exhausted[slot] = False
+            pages = [self._free_pages.popleft() for _ in range(need)]
+            self._row_pages[slot] = pages
+            _obs.counter("gen_page_allocs_total",
+                         "pages taken from the free pool").inc(
+                             need, site="prefill")
+            self._page_gauges()
+            new_row = np.zeros(self._n_row_pages, np.int32)
+            new_row[:need] = pages
+            self._note_program(("prefill", bucket), "prefill_bucket")
+            if self.speculative:
+                carry = (self.page_table, self.pools, self.draft_pools)
+                carry, tok, last = self._prefill_jit(
+                    self._params(), self._draft_params(), carry,
+                    jnp.asarray(padded), jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(length, jnp.int32), jnp.asarray(new_row),
+                    self._next_key())
+                self.page_table, self.pools, self.draft_pools = carry
+            else:
+                carry, tok, last = self._prefill_jit(
+                    self._params(), (self.page_table, self.pools),
+                    jnp.asarray(padded), jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(length, jnp.int32), jnp.asarray(new_row),
+                    self._next_key())
+                self.page_table, self.pools = carry
+        else:
+            self._note_program(("prefill", bucket), "prefill_bucket")
+            cache, tok, last = self._prefill_jit(
+                self._params(), self.cache, jnp.asarray(padded),
+                jnp.asarray(slot, jnp.int32), jnp.asarray(length, jnp.int32),
+                self._next_key())
+            self.cache = cache
         tok = int(tok)  # host sync: the first token is ready here
         self.positions[slot] = length
         self.last_tokens[slot] = tok
@@ -257,14 +655,29 @@ class GenerationEngine:
         """One compiled step over the whole batch. Returns
         ``(next_tokens (B,) np.int32, done (B,) np.bool_, logits (B, V)
         device array)``. Rows that were already done emit ``pad_id``."""
+        if self.speculative:
+            raise RuntimeError("speculative engine decodes in rounds; "
+                               "use spec_step()")
         t0 = time.perf_counter()
-        active_in = ~self.done
-        self._note_program(("decode", self.batch_size), "decode")
-        cache, tok, done, logits = self._decode_jit(
-            self._params(), self.cache, jnp.asarray(self.last_tokens),
-            jnp.asarray(self.positions), jnp.asarray(self.done),
-            self._next_key())
-        self.cache = cache
+        if self.paged:
+            upd_slots, upd_pages = self._grow_pages(0)
+            clear = self._take_clear_mask()
+            active_in = ~self.done  # exhaustion may have finished rows
+            self._note_program(("decode", self.batch_size, "paged"), "decode")
+            carry, tok, done, logits = self._decode_jit(
+                self._params(), (self.page_table, self.pools),
+                jnp.asarray(self.last_tokens), jnp.asarray(self.positions),
+                jnp.asarray(self.done), jnp.asarray(upd_slots),
+                jnp.asarray(upd_pages), jnp.asarray(clear), self._next_key())
+            self.page_table, self.pools = carry
+        else:
+            active_in = ~self.done
+            self._note_program(("decode", self.batch_size), "decode")
+            cache, tok, done, logits = self._decode_jit(
+                self._params(), self.cache, jnp.asarray(self.last_tokens),
+                jnp.asarray(self.positions), jnp.asarray(self.done),
+                self._next_key())
+            self.cache = cache
         # np.array (copy): zero-copy views of jax buffers are read-only,
         # and this host state is mutated by release_slot/prefill
         tok = np.array(tok)
@@ -292,34 +705,162 @@ class GenerationEngine:
                            float(active_in.sum()) / self.batch_size)
         return tok, done, logits
 
-    def audit(self, bucket: Optional[int] = None, compile: bool = True):
+    def spec_step(self):
+        """One speculative round: ONE draft dispatch (k tokens through the
+        draft cache, compiled scan) + ONE verify dispatch (target scores all
+        k+1 positions). Returns ``(tokens (B, k+1) np.int32 padded with
+        pad_id, counts (B,) np.int32 emitted per row, done (B,)
+        np.bool_)``. Greedy output is token-identical to decode_step
+        driven to the same length."""
+        if not self.speculative:
+            raise RuntimeError("spec_step() needs draft_net=/speculate_k=")
+        k = self.speculate_k
+        t0 = time.perf_counter()
+        upd_slots, upd_pages = self._grow_pages(k)
+        clear = self._take_clear_mask()
+        active_in = ~self.done  # exhaustion may have finished rows
+        # committed entries may only land in page-covered positions: the
+        # verify program clamps per-row emission to this window
+        room = np.zeros(self.batch_size, np.int32)
+        for row in range(self.batch_size):
+            covered = len(self._row_pages[row]) * self.page_size
+            room[row] = min(covered, self.max_length) \
+                - int(self.positions[row])
+        key = self._next_key()
+        self._note_program(("draft", self.batch_size, k), "decode")
+        (table, dpools), drafted = self._draft_jit(
+            self._draft_params(), (self.page_table, self.draft_pools),
+            jnp.asarray(self.last_tokens), jnp.asarray(self.positions),
+            jnp.asarray(self.done), jnp.asarray(upd_slots),
+            jnp.asarray(upd_pages), jnp.asarray(clear), key)
+        self.draft_pools = dpools
+        self._note_program(("verify", self.batch_size, k), "verify")
+        (table, pools), out, m, done, acc = self._verify_jit(
+            self._params(), (table, self.pools),
+            jnp.asarray(self.last_tokens), drafted,
+            jnp.asarray(self.positions), jnp.asarray(self.done),
+            jnp.asarray(room), key)
+        self.page_table, self.pools = table, pools
+        out = np.array(out)
+        m = np.array(m)
+        done = np.array(done)
+        acc = np.array(acc)
+        self.positions = self.positions + m.astype(np.int32)
+        took = m > 0
+        last = out[np.arange(self.batch_size), np.maximum(m - 1, 0)]
+        self.last_tokens = np.where(took, last,
+                                    self.last_tokens).astype(np.int32)
+        full = active_in & (self.positions >= self.max_length)
+        if full.any():
+            done = done | full
+            _obs.counter("gen_cache_overflow_total",
+                         "rows force-finished at the KV-cache end").inc(
+                             int(full.sum()))
+        self.done = done
+        n_active = int(active_in.sum())
+        _obs.counter("gen_spec_rounds_total",
+                     "speculative draft+verify rounds").inc()
+        if n_active:
+            accepted = int(acc[active_in].sum())
+            _obs.counter("gen_spec_drafted_tokens_total",
+                         "draft tokens proposed").inc(k * n_active)
+            _obs.counter("gen_spec_accepted_tokens_total",
+                         "draft tokens the target accepted").inc(accepted)
+            _obs.counter("gen_spec_emitted_tokens_total",
+                         "tokens emitted by speculative rounds").inc(
+                             int(m.sum()))
+            _obs.gauge("gen_spec_accept_rate",
+                       "accepted/drafted ratio of the last round").set(
+                           accepted / float(k * n_active))
+        if _obs.enabled():
+            _obs.histogram("gen_spec_round_seconds",
+                           "one draft+verify round wall clock",
+                           unit="s").observe(time.perf_counter() - t0)
+            _obs.gauge("gen_slot_utilization",
+                       "fraction of decode slots active this step").set(
+                           float(active_in.sum()) / self.batch_size)
+        return out, m, done
+
+    def audit(self, bucket: Optional[int] = None, compile: bool = True,
+              program: str = "decode"):
         """Structural :class:`~mxnet_tpu.analysis.ProgramAudit` of a
         serving program (docs/ANALYSIS.md). Default: the decode step —
-        ``carry_indices`` are the flat positions of the KV-cache buffers
-        (the donated carry), so ``audit().carry_donation() == 1.0`` is the
-        in-place-cache-update check. With ``bucket=`` the prefill program
-        for that bucket length is audited instead (same donated cache)."""
+        ``carry_indices`` are the flat positions of the cache buffers (the
+        donated carry: KV buffers, or page table + pools in paged mode), so
+        ``audit().carry_donation() == 1.0`` is the in-place-cache-update
+        check. With ``bucket=`` the prefill program for that bucket length
+        is audited instead (same donated cache). On a speculative engine,
+        ``program="decode"`` audits the draft program (its decode-family
+        program) and ``program="verify"`` the verify pass."""
         from .. import analysis as _analysis
 
         params = self._params()
-        n_params = len(jax.tree_util.tree_leaves(params))
-        n_cache = len(jax.tree_util.tree_leaves(self.cache))
+        n_pre = len(jax.tree_util.tree_leaves(params))
         # constant dummy key: lower() never runs the program, and drawing
         # from _next_key() would advance the stochastic-sampling stream —
         # an audit() between decode steps must not change the tokens
         key = jax.random.key(0)
-        if bucket is None:
-            lowered = self._decode_jit.lower(
-                params, self.cache, jnp.asarray(self.last_tokens),
-                jnp.asarray(self.positions), jnp.asarray(self.done), key)
+        toks = jnp.asarray(self.last_tokens)
+        pos = jnp.asarray(self.positions)
+        done = jnp.asarray(self.done)
+        if not self.paged:
+            carry = self.cache
+            if bucket is None:
+                lowered = self._decode_jit.lower(params, carry, toks, pos,
+                                                 done, key)
+            else:
+                bucket = self.bucket_for(bucket)
+                tokens = jnp.full((1, bucket), self.pad_id, jnp.int32)
+                lowered = self._prefill_jit.lower(
+                    params, carry, tokens, jnp.asarray(0, jnp.int32),
+                    jnp.asarray(bucket, jnp.int32), key)
         else:
-            bucket = self.bucket_for(bucket)
-            tokens = jnp.full((1, bucket), self.pad_id, jnp.int32)
-            lowered = self._prefill_jit.lower(
-                params, self.cache, tokens, jnp.asarray(0, jnp.int32),
-                jnp.asarray(bucket, jnp.int32), key)
-        # flat arg order: params leaves, then the cache leaves (argnum 1,
-        # the donated carry)
+            upd_s = jnp.zeros((self.batch_size, self._upd_width), jnp.int32)
+            upd_p = jnp.zeros((self.batch_size, self._upd_width), jnp.int32)
+            clear = jnp.zeros((self.batch_size,), bool)
+            if bucket is not None:
+                bucket = self.bucket_for(bucket)
+                tokens = jnp.full((1, bucket), self.pad_id, jnp.int32)
+                new_row = jnp.zeros((self._n_row_pages,), jnp.int32)
+                if self.speculative:
+                    dparams = self._draft_params()
+                    n_pre += len(jax.tree_util.tree_leaves(dparams))
+                    carry = (self.page_table, self.pools, self.draft_pools)
+                    lowered = self._prefill_jit.lower(
+                        params, dparams, carry, tokens,
+                        jnp.asarray(0, jnp.int32),
+                        jnp.asarray(bucket, jnp.int32), new_row, key)
+                else:
+                    carry = (self.page_table, self.pools)
+                    lowered = self._prefill_jit.lower(
+                        params, carry, tokens, jnp.asarray(0, jnp.int32),
+                        jnp.asarray(bucket, jnp.int32), new_row, key)
+            elif program == "verify":
+                if not self.speculative:
+                    raise ValueError("program='verify' needs a speculative "
+                                     "engine (draft_net=/speculate_k=)")
+                carry = (self.page_table, self.pools)
+                drafted = jnp.zeros((self.batch_size, self.speculate_k),
+                                    jnp.int32)
+                room = jnp.zeros((self.batch_size,), jnp.int32)
+                lowered = self._verify_jit.lower(params, carry, toks,
+                                                 drafted, pos, done, room,
+                                                 key)
+            elif self.speculative:
+                dparams = self._draft_params()
+                n_pre = len(jax.tree_util.tree_leaves(dparams))
+                carry = (self.page_table, self.draft_pools)
+                lowered = self._draft_jit.lower(dparams, carry, toks, pos,
+                                                done, upd_s, upd_p, clear,
+                                                key)
+            else:
+                carry = (self.page_table, self.pools)
+                lowered = self._decode_jit.lower(params, carry, toks, pos,
+                                                 done, upd_s, upd_p, clear,
+                                                 key)
+        n_carry = len(jax.tree_util.tree_leaves(carry))
+        # flat arg order: (params [+ draft params]) leaves, then the cache
+        # leaves (the donated carry)
         lowered_rep = _analysis.audit_lowered(lowered)
         compiled_rep = (_analysis.audit_compiled(lowered.compile())
                         if compile else None)
@@ -330,14 +871,19 @@ class GenerationEngine:
             compiled_rep if compiled_rep is not None else lowered_rep)
         return _analysis.ProgramAudit(
             lowered=lowered_rep, compiled=compiled_rep,
-            carry_indices=tuple(range(n_params, n_params + n_cache)),
+            carry_indices=tuple(range(n_pre, n_pre + n_carry)),
             comm=comm)
 
     def release_slot(self, slot: int) -> None:
         """Mark a row free (emits pad, frontier frozen) — the next prefill
-        into this slot overwrites it."""
+        into this slot overwrites it. In paged mode, the row's pages return
+        to the free pool and its device page-table row is cleared before
+        the next compiled step writes anything."""
         self.done[slot] = True
         self.last_tokens[slot] = self.pad_id
+        if self.paged:
+            self._reclaim_row(slot)
+            self._pending_clear.add(slot)
 
     # -- convenience: whole-batch generation ---------------------------------
     def generate(self, prompts, max_new_tokens: int = 32) -> List[List[int]]:
@@ -347,7 +893,11 @@ class GenerationEngine:
         if len(prompts) > self.batch_size:
             raise ValueError(f"{len(prompts)} prompts > batch_size="
                              f"{self.batch_size}; use ContinuousBatcher")
-        self.done[:] = True  # park unused rows
+        if self.paged:
+            for s in range(self.batch_size):  # park rows + reclaim pages
+                self.release_slot(s)
+        else:
+            self.done[:] = True  # park unused rows
         outs: List[List[int]] = []
         for i, p in enumerate(prompts):
             tok = self.prefill(p, slot=i)
@@ -357,9 +907,24 @@ class GenerationEngine:
                       if not self.done[i] and len(outs[i]) < max_new_tokens]
             if not active:
                 break
-            tok, done, _ = self.decode_step()
-            for i in active:
-                outs[i].append(int(tok[i]))
-                if len(outs[i]) >= max_new_tokens and not self.done[i]:
-                    self.release_slot(i)  # cap reached: stop advancing
+            if self.speculative:
+                toks, counts, _ = self.spec_step()
+                for i in active:
+                    for j in range(int(counts[i])):
+                        if len(outs[i]) >= max_new_tokens:
+                            break
+                        outs[i].append(int(toks[i, j]))
+                    if len(outs[i]) >= max_new_tokens and not self.done[i]:
+                        self.release_slot(i)  # cap reached: stop advancing
+            else:
+                tok, done, _ = self.decode_step()
+                for i in active:
+                    if (self.paged and done[i]
+                            and bool(self.page_exhausted[i])):
+                        # evicted BEFORE the dispatch (pool ran dry): the
+                        # row emitted pad this step, not a token
+                        continue
+                    outs[i].append(int(tok[i]))
+                    if len(outs[i]) >= max_new_tokens and not self.done[i]:
+                        self.release_slot(i)  # cap reached: stop advancing
         return outs

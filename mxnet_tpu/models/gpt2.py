@@ -57,7 +57,7 @@ class GPT2Block(HybridBlock):
                                  weight_initializer=init.Normal(0.02))
             self.drop = nn.Dropout(dropout)
 
-    def hybrid_forward(self, F, x, cache=None, start_pos=None):
+    def hybrid_forward(self, F, x, cache=None, start_pos=None, page_table=None):
         b, t, c = x.shape
         h = self._heads
         y = self.ln1(x)
@@ -66,9 +66,12 @@ class GPT2Block(HybridBlock):
             att = F.multi_head_attention(qkv[0], qkv[1], qkv[2], causal=True)
         else:
             # autoregressive path (docs/INFERENCE.md): only the t new tokens
-            # flow through; K/V history lives in the static-shape cache
+            # flow through; K/V history lives in the static-shape cache —
+            # contiguous (B,H,Tmax,Ch) buffers, or page pools indirected
+            # through the per-row page_table (paged cache)
             att, k_buf, v_buf = F.multi_head_attention(
-                qkv[0], qkv[1], qkv[2], cache=cache, position=start_pos)
+                qkv[0], qkv[1], qkv[2], cache=cache, position=start_pos,
+                page_table=page_table)
         att = att.transpose((0, 2, 1, 3)).reshape((b, t, c))
         x = x + self.drop(self.proj(att))
         y = self.ffn2(F.Activation(self.ffn1(self.ln2(x)), act_type="tanh_gelu"))
@@ -108,7 +111,18 @@ class GPT2Model(HybridBlock):
                               self._units // self._num_heads,
                               self._num_layers, dtype=dtype)
 
-    def hybrid_forward(self, F, token_ids, cache=None, start_pos=None):
+    def init_paged_cache(self, num_pages, page_size, dtype="float32"):
+        """Allocate per-layer ``(k_pool, v_pool)`` page pools of shape
+        (num_pages + 1, H, page_size, Ch) — the paged decode carry; page 0
+        is the reserved trash page (docs/INFERENCE.md "Paged cache")."""
+        from ..ops.attention import alloc_paged_kv_cache
+
+        return alloc_paged_kv_cache(num_pages, self._num_heads, page_size,
+                                    self._units // self._num_heads,
+                                    self._num_layers, dtype=dtype)
+
+    def hybrid_forward(self, F, token_ids, cache=None, start_pos=None,
+                       page_table=None):
         b, t = token_ids.shape
         pos = _chunk_positions(F, t, start_pos)
         x = self.drop(self.word_embed(token_ids) + self.position_embed(pos))
@@ -117,7 +131,8 @@ class GPT2Model(HybridBlock):
             if cache is None:
                 x = blk(x)
             else:
-                x, layer_cache = blk(x, cache=cache[i], start_pos=start_pos)
+                x, layer_cache = blk(x, cache=cache[i], start_pos=start_pos,
+                                     page_table=page_table)
                 new_cache.append(layer_cache)
         x = self.ln_f(x)
         # weight-tied LM head (GPT-2 ties input/output embeddings)

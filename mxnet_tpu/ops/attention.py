@@ -111,6 +111,40 @@ def alloc_kv_cache(batch_size, num_heads, max_length, channels, num_layers,
             for _ in range(int(num_layers))]
 
 
+def alloc_paged_kv_cache(num_pages, num_heads, page_size, channels, num_layers,
+                         dtype="float32"):
+    """Per-layer ``(k_pool, v_pool)`` page pools of shape
+    (num_pages + 1, H, page_size, Ch) — the global block pool of the paged
+    decode cache (docs/INFERENCE.md "Paged cache"). Page 0 is the reserved
+    **trash page**: page-table entries of released / past-capacity rows are
+    0, so their (masked) writes land there instead of in live pages."""
+    from ..base import dtype_np
+
+    shape = (int(num_pages) + 1, int(num_heads), int(page_size), int(channels))
+    return [(jnp.zeros(shape, dtype_np(dtype)), jnp.zeros(shape, dtype_np(dtype)))
+            for _ in range(int(num_layers))]
+
+
+def _frontier_masked_attention(q, k_hist, v_hist, position):
+    """Shared core of the cached paths: every query at row position
+    ``position + i`` attends to history entries ``<= position + i`` —
+    exactly the causal mask of a full forward. Entries past a row's
+    frontier (zeros, stale rejected-draft K/V, trash-page garbage) are
+    masked to -inf before the softmax, so they contribute *exactly* 0.0 —
+    which is what makes the paged layout bit-identical to the contiguous
+    one: both feed this very function."""
+    tq, ch = q.shape[2], q.shape[3]
+    tmax = k_hist.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(ch, jnp.float32))
+    scores = jnp.einsum("bhqc,bhkc->bhqk", q, k_hist).astype(jnp.float32) * scale
+    key_idx = jnp.arange(tmax, dtype=jnp.int32)[None, None, None, :]
+    q_pos = (position[:, None, None, None]
+             + jnp.arange(tq, dtype=jnp.int32)[None, None, :, None])
+    scores = jnp.where(key_idx <= q_pos, scores, -jnp.inf)
+    att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkc->bhqc", att, v_hist)
+
+
 def _cached_mha(q, k_new, v_new, k_buf, v_buf, position):
     """Incremental attention against static max-length K/V buffers.
 
@@ -121,14 +155,10 @@ def _cached_mha(q, k_new, v_new, k_buf, v_buf, position):
                    different positions, no shape change involved).
 
     The new K/V land in the buffers first (vmapped ``dynamic_update_slice``
-    at each row's own offset), then every query attends to buffer entries
-    ``<= position + i`` — which is exactly the causal mask of the full
-    forward, so logits match a from-scratch re-forward to fp tolerance.
-    Buffer slots past a row's frontier hold zeros/stale K/V but are masked
-    to -inf before the softmax, so they contribute exactly 0.
+    at each row's own offset), then :func:`_frontier_masked_attention`
+    reads them back, so logits match a from-scratch re-forward to fp
+    tolerance.
     """
-    b, h, tq, ch = q.shape
-    tmax = k_buf.shape[2]
 
     def write(buf, new, p):  # one row: (H, Tmax, Ch) <- (H, Tq, Ch) at p
         return jax.lax.dynamic_update_slice(buf, new.astype(buf.dtype),
@@ -136,16 +166,51 @@ def _cached_mha(q, k_new, v_new, k_buf, v_buf, position):
 
     k_buf = jax.vmap(write)(k_buf, k_new, position)
     v_buf = jax.vmap(write)(v_buf, v_new, position)
-
-    scale = 1.0 / jnp.sqrt(jnp.asarray(ch, jnp.float32))
-    scores = jnp.einsum("bhqc,bhkc->bhqk", q, k_buf).astype(jnp.float32) * scale
-    key_idx = jnp.arange(tmax, dtype=jnp.int32)[None, None, None, :]
-    q_pos = (position[:, None, None, None]
-             + jnp.arange(tq, dtype=jnp.int32)[None, None, :, None])
-    scores = jnp.where(key_idx <= q_pos, scores, -jnp.inf)
-    att = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum("bhqk,bhkc->bhqc", att, v_buf)
+    out = _frontier_masked_attention(q, k_buf, v_buf, position)
     return out, k_buf, v_buf
+
+
+def _paged_cached_mha(q, k_new, v_new, k_pool, v_pool, page_table, position):
+    """Incremental attention against a paged (block) KV pool.
+
+    q/k_new/v_new: (B, H, Tq, Ch) — the Tq new positions of each row;
+    k_pool/v_pool: (P+1, H, ps, Ch) — the global page pool (page 0 = trash);
+    page_table:    (B, n_pages) int32 — per-row page ids in slot order
+                   (slot s holds sequence positions ``s*ps .. (s+1)*ps-1``;
+                   unallocated slots are 0 and only ever masked);
+    position:      (B,) int32 — per-row start index of this chunk.
+
+    Writes scatter each new token into ``pool[table[pos // ps], :, pos % ps]``
+    (positions past the table's capacity, and any slot a released row's
+    cleared table maps to, redirect to the trash page). Reads gather the
+    whole per-row history ``pool[page_table]`` back into a (B, H, cap, Ch)
+    view and run the shared :func:`_frontier_masked_attention` — masked
+    entries (stale/trash/garbage K/V) get a softmax weight of exactly 0.0,
+    so logits are bit-identical to the contiguous cache.
+    """
+    b, h, tq, ch = q.shape
+    ps = k_pool.shape[2]
+    n_pages = page_table.shape[1]
+    cap = n_pages * ps
+
+    pos = (position[:, None]
+           + jnp.arange(tq, dtype=jnp.int32)[None, :])          # (B, Tq)
+    slot = jnp.clip(pos // ps, 0, n_pages - 1)
+    pid = jnp.take_along_axis(page_table, slot, axis=1)          # (B, Tq)
+    pid = jnp.where(pos < cap, pid, 0)                           # overflow -> trash
+    off = pos % ps
+    pid_f, off_f = pid.reshape(-1), off.reshape(-1)
+    # (B,H,Tq,Ch) -> (B*Tq, H, Ch) token-major values for the scatter
+    vals_k = k_new.transpose(0, 2, 1, 3).reshape(b * tq, h, ch)
+    vals_v = v_new.transpose(0, 2, 1, 3).reshape(b * tq, h, ch)
+    k_pool = k_pool.at[pid_f, :, off_f, :].set(vals_k.astype(k_pool.dtype))
+    v_pool = v_pool.at[pid_f, :, off_f, :].set(vals_v.astype(v_pool.dtype))
+
+    # gather the row histories: (B, n_pages, H, ps, Ch) -> (B, H, cap, Ch)
+    k_hist = k_pool[page_table].transpose(0, 2, 1, 3, 4).reshape(b, h, cap, ch)
+    v_hist = v_pool[page_table].transpose(0, 2, 1, 3, 4).reshape(b, h, cap, ch)
+    out = _frontier_masked_attention(q, k_hist, v_hist, position)
+    return out, k_pool, v_pool
 
 
 # --------------------------------------------------------------------------
@@ -167,7 +232,7 @@ def _reference_mha(q, k, v, mask=None, causal=False):
 
 @register("multi_head_attention", aliases=("_contrib_multi_head_attention",))
 def multi_head_attention(q, k, v, mask=None, causal=False, use_flash="auto",
-                         cache=None, position=None):
+                         cache=None, position=None, page_table=None):
     """Fused scaled-dot-product attention over (B, H, T, Ch) tensors.
 
     ``use_flash='auto'`` picks the Pallas flash kernel on TPU backends when
@@ -186,6 +251,12 @@ def multi_head_attention(q, k, v, mask=None, causal=False, use_flash="auto",
     returns ``(out, k_buf', v_buf')`` instead of just ``out``. ``position``
     is a per-row ``(B,)`` int32 (or scalar) start index; masking enforces
     the same causal structure as ``causal=True`` on the full sequence.
+
+    With ``page_table=`` ((B, n_pages) int32) the cache entries are read as
+    **page pools** ``(P+1, H, page_size, Ch)`` instead of contiguous per-row
+    buffers — the paged variant (docs/INFERENCE.md "Paged cache"): same
+    frontier mask, same return convention, storage indirected through the
+    per-row page table.
     """
     from . import flash_attention as fa
     from ..contrib.amp import cast_inputs
@@ -199,7 +270,12 @@ def multi_head_attention(q, k, v, mask=None, causal=False, use_flash="auto",
         position = jnp.asarray(_unwrap(position), jnp.int32)
         if position.ndim == 0:
             position = jnp.broadcast_to(position, (q.shape[0],))
-        out, k_buf, v_buf = _cached_mha(q, k, v, k_buf, v_buf, position)
+        if page_table is not None:
+            table = jnp.asarray(_unwrap(page_table), jnp.int32)
+            out, k_buf, v_buf = _paged_cached_mha(q, k, v, k_buf, v_buf,
+                                                  table, position)
+        else:
+            out, k_buf, v_buf = _cached_mha(q, k, v, k_buf, v_buf, position)
         return out.astype(orig_dtype), k_buf, v_buf
     if use_flash == "auto":
         use_flash = fa.flash_supported(q, k, v, mask)

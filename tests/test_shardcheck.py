@@ -57,6 +57,19 @@ def test_injected_all_gather_fails_gate(shardcheck, capsys):
     assert "comm bytes" in out and "regressed" in out
 
 
+def test_paged_families_match_goldens(shardcheck, capsys):
+    """ISSUE 11 satellite: the paged decode + speculative verify program
+    families are pinned to committed goldens — zero collectives (the
+    serving contract) and a fully donated page-table + pool carry."""
+    rc = shardcheck.main(["--family", "decode_paged",
+                          "--family", "verify_spec"])
+    row, _ = _verdict(capsys)
+    assert rc == 0 and row["ok"]
+    for fam in ("decode_paged", "verify_spec"):
+        assert row["families"][fam]["collectives"] == {}
+        assert row["families"][fam]["carry_donation"] == 1.0
+
+
 def test_inject_cannot_combine_with_update_golden(shardcheck, capsys):
     """The failure-path hook must never bless the injected census into
     the committed goldens."""

@@ -84,9 +84,21 @@ def decode_engine_section(fails):
     _ = net(nd.array(np.zeros((1, 4), np.int32)))
     eng = GenerationEngine(net, batch_size=2, max_length=64,
                            prefill_buckets=(8, 16))
+    paged = GenerationEngine(net, batch_size=2, max_length=64,
+                             prefill_buckets=(8, 16), paged=True,
+                             page_size=16)
+    spec = GenerationEngine(net, batch_size=2, max_length=64,
+                            prefill_buckets=(8, 16), paged=True,
+                            page_size=16, draft_net=net, speculate_k=4)
     out = {}
-    for name, audit in (("decode", eng.audit()),
-                        ("prefill", eng.audit(bucket=8))):
+    audits = (("decode", eng.audit()),
+              ("prefill", eng.audit(bucket=8)),
+              ("paged_decode", paged.audit()),
+              ("paged_prefill", paged.audit(bucket=8)),
+              ("spec_draft", spec.audit()),
+              ("spec_verify", spec.audit(program="verify")),
+              ("spec_prefill", spec.audit(bucket=8)))
+    for name, audit in audits:
         cov = audit.carry_donation()
         out[name] = {"carry_n": len(audit.carry_indices),
                      "donation_coverage": cov,
@@ -158,8 +170,8 @@ def main():
     ts = row["train_step"]
     print(f"OK: bf16 step/window carry donation 100% "
           f"({ts['step']['carry_n']}+{ts['window']['carry_n']} buffers), "
-          f"0 f64 ops, decode cache donation 100%, shape recompile "
-          f"explained in the event log")
+          f"0 f64 ops, decode/paged/speculative cache donation 100% with "
+          f"zero host transfers, shape recompile explained in the event log")
     return 0
 
 

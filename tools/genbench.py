@@ -1,25 +1,33 @@
 #!/usr/bin/env python
-"""A/B gate for compiled KV-cache generation (`make genbench`).
+"""A/B gates for compiled KV-cache generation (`make genbench`).
 
-Times greedy generation on a tiny GPT-2 (CPU) two ways:
+Three gated sections on a tiny GPT-2 (CPU, greedy, identical token
+streams required everywhere):
 
-  naive  — the only pre-engine option: re-forward the WHOLE growing
-           sequence eagerly for every token (O(L²) attention recompute,
-           a dispatch storm per step);
-  cached — ``GenerationEngine.generate``: bucketed prefill + the single
-           compiled decode step (donated KV-cache carry).
+  1. **cached vs naive** — the engine's bucketed prefill + single compiled
+     decode step against the only pre-engine option: re-forwarding the
+     WHOLE growing sequence eagerly per token. Gate: >= --min-speedup
+     amortized per token, exactly (buckets used + 1) programs.
+  2. **paged vs dense** (docs/INFERENCE.md "Paged cache") — at EQUAL cache
+     memory, the paged engine serves --concurrency-factor x more
+     concurrent sequences than the dense engine (page pool == the dense
+     cache's token capacity, slots oversubscribed), with bit-identical
+     greedy tokens, >= --min-paged-speedup serving throughput at the high
+     slot count, and bytes-of-cache-per-admitted-sequence down
+     accordingly.
+  3. **speculative vs paged** — self-drafting (draft_net = the target,
+     accept rate ~1.0) with k = --speculate-k: one compiled draft scan +
+     one verify dispatch emit up to k+1 tokens/round. Gate: >=
+     --min-spec-speedup amortized tokens/sec over the paged
+     non-speculative engine on the same prompts, tokens identical, and
+     exactly (buckets used + 1 decode + 1 verify) programs.
 
-Methodology mirrors ``make perfwin``: warm both paths first (compiles out
-of the timed region), then alternate naive/cached measurement pairs and
-take the MEDIAN per-pair speedup, so background load hits both sides of a
-pair equally. The gate FAILS unless
+Methodology mirrors ``make perfwin``: warm both sides first (compiles out
+of the timed region), then alternate A/B measurement pairs and take the
+MEDIAN per-pair speedup, so background load hits both sides of a pair
+equally.
 
-  - both paths emit identical token streams (greedy, same params),
-  - the amortized per-token speedup is >= --min-speedup (default 3x),
-  - the engine lowered exactly (prefill buckets used + 1) programs, per
-    the ``gen_recompiles_total`` telemetry.
-
-Artifact: ``GENBENCH_r01.json`` (committed).
+Artifact: ``GENBENCH_r02.json`` (committed).
 """
 from __future__ import annotations
 
@@ -40,16 +48,17 @@ def _utc():
         "%Y-%m-%dT%H:%M:%SZ")
 
 
-def build_net(vocab, max_length):
+def build_net(vocab, max_length, num_layers=2, units=64, num_heads=2, seed=0):
     import numpy as np
 
     import mxnet_tpu as mx
     from mxnet_tpu import nd
     from mxnet_tpu.models import gpt2
 
-    mx.random.seed(0)
-    net = gpt2.get_gpt2("gpt2_tiny", dropout=0.0, vocab_size=vocab,
-                        max_length=max_length)
+    mx.random.seed(seed)
+    net = gpt2.GPT2Model(num_layers=num_layers, units=units,
+                         num_heads=num_heads, max_length=max_length,
+                         vocab_size=vocab, dropout=0.0)
     net.initialize()
     _ = net(nd.array(np.zeros((1, 4)), dtype="int32"))
     return net
@@ -69,25 +78,31 @@ def naive_generate(net, prompt, gen_len):
     return seq[len(prompt):]
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--prompt-len", type=int, default=16)
-    ap.add_argument("--gen-len", type=int, default=64)
-    ap.add_argument("--vocab", type=int, default=2048,
-                    help="trimmed vocab: keeps the naive loop affordable "
-                    "on CPU without changing the asymptotics")
-    ap.add_argument("--max-length", type=int, default=256)
-    ap.add_argument("--pairs", type=int, default=3,
-                    help="alternating naive/cached measurement pairs")
-    ap.add_argument("--min-speedup", type=float, default=3.0)
-    ap.add_argument("--out", default="GENBENCH_r01.json")
-    args = ap.parse_args()
+def cache_bytes(buffers):
+    """Total bytes of a cache pytree (list of per-layer (k, v) arrays)."""
+    return int(sum(b.nbytes for layer in buffers for b in layer))
 
-    import jax
 
-    jax.config.update("jax_platforms", "cpu")
+def serve(engine, prompts, gen_len):
+    """Serve all prompts through a ContinuousBatcher; returns
+    (per-request outputs, elapsed seconds, total tokens, peak active)."""
+    from mxnet_tpu.inference import ContinuousBatcher
+
+    bat = ContinuousBatcher(engine)
+    reqs = [bat.submit(p, max_new_tokens=gen_len) for p in prompts]
+    peak = 0
+    t0 = time.perf_counter()
+    while bat.step():
+        peak = max(peak, bat.active)
+    dt = time.perf_counter() - t0
+    outs = [r.result() for r in reqs]
+    return outs, dt, sum(len(o) for o in outs), peak
+
+
+def section_cached_vs_naive(args, fails):
     import numpy as np
 
+    import jax
     from mxnet_tpu.inference import GenerationEngine
     from mxnet_tpu.observability import REGISTRY
 
@@ -102,9 +117,9 @@ def main():
     warm_cached = eng.generate([prompt], max_new_tokens=args.gen_len)[0]
     warm_naive = naive_generate(net, prompt, args.gen_len)
     if warm_cached != warm_naive:
-        print(f"FAIL: token streams diverge\n cached={warm_cached[:10]}...\n"
-              f" naive ={warm_naive[:10]}...")
-        return 1
+        fails.append(f"cached_vs_naive: token streams diverge "
+                     f"(cached={warm_cached[:8]}... naive={warm_naive[:8]}...)")
+        return {}
 
     pairs = []
     for _ in range(args.pairs):
@@ -119,19 +134,10 @@ def main():
     n_ms = statistics.median(p[0] for p in pairs) * 1e3 / args.gen_len
     c_ms = statistics.median(p[1] for p in pairs) * 1e3 / args.gen_len
     speedup = statistics.median(p[0] / p[1] for p in pairs)
-
-    counter = REGISTRY.get("gen_recompiles_total")
-    programs = int(counter.total()) if counter else 0
+    programs = eng.compiled_programs
     want_programs = 1 + 1  # one bucket used (prompt fits the first) + decode
 
     row = {
-        "ts": _utc(),
-        "bench": "genbench",
-        "model": "gpt2_tiny",
-        "vocab": args.vocab,
-        "prompt_len": args.prompt_len,
-        "gen_len": args.gen_len,
-        "pairs": args.pairs,
         "backend": jax.devices()[0].platform,
         "naive_ms_per_token": round(n_ms, 3),
         "cached_ms_per_token": round(c_ms, 3),
@@ -141,21 +147,243 @@ def main():
         "prefill_buckets": list(buckets),
         "tokens_match_naive": True,
     }
+    if programs != want_programs:
+        fails.append(f"cached_vs_naive: {programs} compiled programs, "
+                     f"expected {want_programs} (per-token recompiles?)")
+    if speedup < args.min_speedup:
+        fails.append(f"cached_vs_naive: {speedup:.2f}x over naive, gate "
+                     f"needs >= {args.min_speedup}x")
+    # keep the registry-counted view honest vs engine-local accounting
+    counter = REGISTRY.get("gen_recompiles_total")
+    row["registry_programs_total"] = int(counter.total()) if counter else 0
+    return row
+
+
+def section_paged_vs_dense(args, fails):
+    import numpy as np
+
+    from mxnet_tpu.inference import GenerationEngine
+
+    net = build_net(args.vocab, args.max_length)
+    rs = np.random.RandomState(11)
+    n_req = args.dense_slots * args.concurrency_factor
+    prompts = [list(rs.randint(1, args.vocab, int(rs.randint(8, 13))))
+               for _ in range(n_req)]
+    gen_len = 12
+
+    dense = GenerationEngine(net, batch_size=args.dense_slots,
+                             max_length=args.max_length,
+                             prefill_buckets=(16,), eos_id=None)
+    # equal cache memory: the page pool holds exactly the dense cache's
+    # token capacity, while the slot count is oversubscribed x concurrency
+    pool_pages = args.dense_slots * args.max_length // args.page_size
+    paged = GenerationEngine(net, batch_size=n_req,
+                             max_length=args.max_length,
+                             prefill_buckets=(16,), eos_id=None,
+                             paged=True, page_size=args.page_size,
+                             num_pages=pool_pages)
+
+    # warm
+    serve(dense, prompts, gen_len)
+    serve(paged, prompts, gen_len)
+    pairs, outs_d, outs_p, peak_d, peak_p = [], None, None, 0, 0
+    for _ in range(args.pairs):
+        outs_d, dt_d, toks_d, peak_d = serve(dense, prompts, gen_len)
+        outs_p, dt_p, toks_p, peak_p = serve(paged, prompts, gen_len)
+        pairs.append((toks_d / dt_d, toks_p / dt_p))
+
+    tps_d = statistics.median(p[0] for p in pairs)
+    tps_p = statistics.median(p[1] for p in pairs)
+    speedup = statistics.median(p[1] / p[0] for p in pairs)
+    dense_bytes = cache_bytes(dense.cache)
+    paged_bytes = cache_bytes(paged.pools)  # includes the trash page
+    per_seq_d = dense_bytes / peak_d if peak_d else float("inf")
+    per_seq_p = paged_bytes / peak_p if peak_p else float("inf")
+    concurrency = peak_p / peak_d if peak_d else 0.0
+
+    row = {
+        "dense_slots": args.dense_slots,
+        "paged_slots": n_req,
+        "page_size": args.page_size,
+        "pool_pages": pool_pages,
+        "gen_len": gen_len,
+        "dense_cache_bytes": dense_bytes,
+        "paged_cache_bytes": paged_bytes,
+        "peak_concurrent_dense": peak_d,
+        "peak_concurrent_paged": peak_p,
+        "concurrency_ratio": round(concurrency, 2),
+        "bytes_per_seq_dense": round(per_seq_d),
+        "bytes_per_seq_paged": round(per_seq_p),
+        "bytes_per_seq_ratio": round(per_seq_d / per_seq_p, 2),
+        "dense_tokens_per_s": round(tps_d, 1),
+        "paged_tokens_per_s": round(tps_p, 1),
+        "throughput_speedup_median_of_pairs": round(speedup, 2),
+        "tokens_identical": outs_d == outs_p,
+        "compiled_programs": {"dense": dense.compiled_programs,
+                              "paged": paged.compiled_programs},
+    }
+    if outs_d != outs_p:
+        fails.append("paged_vs_dense: greedy tokens diverge between the "
+                     "dense and paged engines")
+    if paged_bytes > dense_bytes * 1.1:
+        fails.append(f"paged_vs_dense: paged cache {paged_bytes}B not "
+                     f"within 10% of dense {dense_bytes}B — the equal-"
+                     "memory comparison is broken")
+    if concurrency < args.concurrency_factor:
+        fails.append(f"paged_vs_dense: {peak_p} concurrent sequences vs "
+                     f"dense {peak_d} = {concurrency:.1f}x, gate needs >= "
+                     f"{args.concurrency_factor}x at equal cache memory")
+    if per_seq_d / per_seq_p < args.concurrency_factor - 0.5:
+        fails.append(f"paged_vs_dense: bytes/sequence only improved "
+                     f"{per_seq_d / per_seq_p:.2f}x")
+    if speedup < args.min_paged_speedup:
+        fails.append(f"paged_vs_dense: serving throughput {speedup:.2f}x "
+                     f"over dense, gate needs >= {args.min_paged_speedup}x")
+    if paged.compiled_programs != 2:
+        fails.append(f"paged_vs_dense: paged engine lowered "
+                     f"{paged.compiled_programs} programs, expected 2")
+    return row
+
+
+def section_spec_vs_paged(args, fails):
+    import numpy as np
+
+    from mxnet_tpu.inference import GenerationEngine
+    from mxnet_tpu.observability import REGISTRY
+
+    net = build_net(args.vocab, args.max_length)
+    rs = np.random.RandomState(23)
+    prompts = [list(rs.randint(1, args.vocab, int(rs.randint(8, 13))))
+               for _ in range(4)]
+    gen_len = 64
+    k = args.speculate_k
+
+    base = GenerationEngine(net, batch_size=4, max_length=args.max_length,
+                            prefill_buckets=(16,), eos_id=None,
+                            paged=True, page_size=args.page_size)
+    spec = GenerationEngine(net, batch_size=4, max_length=args.max_length,
+                            prefill_buckets=(16,), eos_id=None,
+                            paged=True, page_size=args.page_size,
+                            draft_net=net, speculate_k=k)
+
+    base.generate(prompts, max_new_tokens=gen_len)  # warm
+    spec.generate(prompts, max_new_tokens=gen_len)
+    a0 = REGISTRY.get("gen_spec_accepted_tokens_total").total()
+    d0 = REGISTRY.get("gen_spec_drafted_tokens_total").total()
+    pairs, outs_b, outs_s = [], None, None
+    for _ in range(args.pairs):
+        t0 = time.perf_counter()
+        outs_b = base.generate(prompts, max_new_tokens=gen_len)
+        t_base = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        outs_s = spec.generate(prompts, max_new_tokens=gen_len)
+        t_spec = time.perf_counter() - t0
+        pairs.append((t_base, t_spec))
+    speedup = statistics.median(p[0] / p[1] for p in pairs)
+    toks = sum(len(o) for o in outs_s)
+    accepted = REGISTRY.get("gen_spec_accepted_tokens_total").total() - a0
+    drafted = REGISTRY.get("gen_spec_drafted_tokens_total").total() - d0
+
+    row = {
+        "speculate_k": k,
+        "draft": "self (tiny-GPT2 self-drafting)",
+        "gen_len": gen_len,
+        "paged_ms_per_token": round(
+            statistics.median(p[0] for p in pairs) * 1e3 / toks, 3),
+        "spec_ms_per_token": round(
+            statistics.median(p[1] for p in pairs) * 1e3 / toks, 3),
+        "speedup_median_of_pairs": round(speedup, 2),
+        "accept_rate": round(accepted / drafted, 3) if drafted else None,
+        "tokens_identical": outs_b == outs_s,
+        "compiled_programs": {"paged": base.compiled_programs,
+                              "spec": spec.compiled_programs},
+    }
+    if outs_b != outs_s:
+        fails.append("spec_vs_paged: speculative tokens diverge from the "
+                     "non-speculative greedy stream")
+    if speedup < args.min_spec_speedup:
+        fails.append(f"spec_vs_paged: {speedup:.2f}x amortized tokens/sec "
+                     f"over paged non-speculative, gate needs >= "
+                     f"{args.min_spec_speedup}x")
+    if spec.compiled_programs != 3:
+        fails.append(f"spec_vs_paged: spec engine lowered "
+                     f"{spec.compiled_programs} programs, expected 3 "
+                     "(1 prefill bucket + 1 draft decode + 1 verify)")
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prompt-len", type=int, default=16)
+    ap.add_argument("--gen-len", type=int, default=64)
+    ap.add_argument("--vocab", type=int, default=512,
+                    help="trimmed vocab: keeps the naive loop affordable "
+                    "on CPU without changing the asymptotics")
+    ap.add_argument("--max-length", type=int, default=128)
+    ap.add_argument("--pairs", type=int, default=3,
+                    help="alternating A/B measurement pairs per section")
+    ap.add_argument("--min-speedup", type=float, default=3.0)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--dense-slots", type=int, default=2)
+    ap.add_argument("--concurrency-factor", type=int, default=4,
+                    help="paged slots per dense slot at equal cache memory")
+    ap.add_argument("--min-paged-speedup", type=float, default=1.2)
+    ap.add_argument("--speculate-k", type=int, default=6)
+    ap.add_argument("--min-spec-speedup", type=float, default=1.5)
+    ap.add_argument("--section", action="append",
+                    choices=["cached", "paged", "spec"],
+                    help="restrict to named sections (repeatable)")
+    ap.add_argument("--out", default="GENBENCH_r02.json")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    fails: list = []
+    sections = args.section or ["cached", "paged", "spec"]
+    row = {
+        "ts": _utc(),
+        "bench": "genbench",
+        "model": "gpt2-tiny-cfg(2x64x2h)",
+        "vocab": args.vocab,
+        "max_length": args.max_length,
+        "pairs": args.pairs,
+        "backend": jax.devices()[0].platform,
+    }
+    if "cached" in sections:
+        row["cached_vs_naive"] = section_cached_vs_naive(args, fails)
+    if "paged" in sections:
+        row["paged_vs_dense"] = section_paged_vs_dense(args, fails)
+    if "spec" in sections:
+        row["spec_vs_paged"] = section_spec_vs_paged(args, fails)
+    row["ok"] = not fails
+    if fails:
+        row["failures"] = fails
+
     out = os.path.join(REPO, args.out)
     with open(out, "w") as f:
         json.dump(row, f, indent=1)
-    print(json.dumps(row))
+    print(json.dumps(row, indent=1))
 
-    if programs != want_programs:
-        print(f"FAIL: {programs} compiled programs, expected {want_programs} "
-              "(per-token recompiles?)")
+    if fails:
+        for msg in fails:
+            print(f"FAIL: {msg}")
         return 1
-    if speedup < args.min_speedup:
-        print(f"FAIL: cached decode {speedup:.2f}x over naive, "
-              f"gate needs >= {args.min_speedup}x")
-        return 1
-    print(f"OK: cached decode {speedup:.2f}x faster per token "
-          f"({c_ms:.2f} vs {n_ms:.2f} ms/token), {programs} programs")
+    bits = []
+    if "cached_vs_naive" in row:
+        c = row["cached_vs_naive"]
+        bits.append(f"cached {c['speedup_median_of_pairs']}x over naive")
+    if "paged_vs_dense" in row:
+        p = row["paged_vs_dense"]
+        bits.append(f"paged {p['concurrency_ratio']}x concurrency at equal "
+                    f"memory ({p['throughput_speedup_median_of_pairs']}x "
+                    "tokens/s)")
+    if "spec_vs_paged" in row:
+        s = row["spec_vs_paged"]
+        bits.append(f"speculative {s['speedup_median_of_pairs']}x at "
+                    f"accept {s['accept_rate']}")
+    print("OK: " + "; ".join(bits))
     return 0
 
 

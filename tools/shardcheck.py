@@ -125,12 +125,49 @@ def family_prefill():
     return _engine().audit(bucket=8)
 
 
+@functools.lru_cache(maxsize=None)
+def _paged_engines():
+    """One paged + one speculative engine over the SAME net as _engine()
+    (separate build: engine caches are engine-local state)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.inference import GenerationEngine
+    from mxnet_tpu.models import gpt2
+
+    mx.random.seed(0)
+    net = gpt2.get_gpt2("gpt2_tiny", dropout=0.0, num_layers=2, units=32,
+                        num_heads=2, max_length=64, vocab_size=64)
+    net.initialize()
+    _ = net(nd.array(np.zeros((1, 4), np.int32)))
+    paged = GenerationEngine(net, batch_size=2, max_length=64,
+                             prefill_buckets=(8, 16), paged=True,
+                             page_size=16)
+    spec = GenerationEngine(net, batch_size=2, max_length=64,
+                            prefill_buckets=(8, 16), paged=True,
+                            page_size=16, draft_net=net, speculate_k=4)
+    return paged, spec
+
+
+def family_decode_paged():
+    """The paged decode step: page-table carry + pools, zero collectives."""
+    return _paged_engines()[0].audit()
+
+
+def family_verify_spec():
+    """The speculative verify pass (k+1 positions, one program)."""
+    return _paged_engines()[1].audit(program="verify")
+
+
 FAMILIES = {
     "step_dp8": family_step_dp8,
     "step_fsdp": family_step_fsdp,
     "window_fsdp": family_window_fsdp,
     "decode": family_decode,
     "prefill": family_prefill,
+    "decode_paged": family_decode_paged,
+    "verify_spec": family_verify_spec,
 }
 
 
