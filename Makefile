@@ -13,12 +13,18 @@
 #             (tools/shardcheck.py): contract violations, accidental
 #             reshards, new collective kinds, comm-byte regressions and
 #             fingerprint drift vs mxnet_tpu/analysis/goldens/
+#   memcheck - golden-program memory gate (tools/memcheck.py): buffer-
+#             liveness peak-residency regressions > 5%, new
+#             materialization classes (KV gather-materialize, f32
+#             upcasts, remat-defeating live ranges), donation drops vs
+#             mxnet_tpu/analysis/goldens/mem_*.json, plus a
+#             memory_analysis() cross-validation of the estimator
 #   native  - build libmxtpu.so (C++ runtime: recordio/jpeg/runtime/c_api)
 #   fast    - pytest without @slow (target < 10 min on 8 virtual CPU devs)
 #   slow    - the @slow remainder (model compiles, 4-process launches)
 #   ci      - sanity + lint + native + fast + audit + shardcheck +
-#             chaos-elastic (the pre-merge gate; chaos-elastic is the
-#             slow 4-process kill-a-worker drill)
+#             memcheck + chaos-elastic (the pre-merge gate;
+#             chaos-elastic is the slow 4-process kill-a-worker drill)
 #   test    - full suite (ci + slow), what the driver effectively runs
 
 PY ?= python
@@ -29,9 +35,9 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity lint audit shardcheck native fast slow test chaos chaos-elastic obs obsfleet perfwin genbench ampbench bench clean
+.PHONY: ci sanity lint audit shardcheck memcheck native fast slow test chaos chaos-elastic obs obsfleet perfwin genbench ampbench bench clean
 
-ci: sanity lint native fast audit shardcheck chaos-elastic obsfleet
+ci: sanity lint native fast audit shardcheck memcheck chaos-elastic obsfleet
 
 sanity:
 	$(PY) -m compileall -q mxnet_tpu tools tests examples bench.py __graft_entry__.py
@@ -56,6 +62,16 @@ audit:
 # `python tools/shardcheck.py --update-golden`
 shardcheck:
 	$(PY) tools/shardcheck.py
+
+# golden-program memory gate (docs/ANALYSIS.md "Memory"): runs the
+# buffer-liveness pass over the same program families and diffs peak
+# residency, materialization classes and donation coverage against the
+# committed mem_*.json goldens; also cross-validates the estimator
+# against jax's memory_analysis() on the mesh-less step/decode programs.
+# Rebless intentional changes with `python tools/memcheck.py
+# --update-golden`
+memcheck:
+	$(PY) tools/memcheck.py
 
 native:
 	$(MAKE) -C native
@@ -126,9 +142,10 @@ genbench:
 
 # compiled mixed-precision gate (docs/PERFORMANCE.md "Mixed precision"):
 # HLO dtype assertions (bf16 dots + f32 master update, f16 loss scaling
-# fully in-graph) + memory_analysis remat delta (>=30% peak temp bytes on
-# the long-context step) + a dispatch-isolated f32-vs-bf16 step-time A/B
-# (recorded, not gated on CPU); artifact committed as AMPBENCH_r01.json
+# fully in-graph) + buffer-liveness remat delta (>=25% MemoryReport
+# temp-peak bytes on the long-context step, the units make memcheck
+# gates) + a dispatch-isolated f32-vs-bf16 step-time A/B (recorded, not
+# gated on CPU); artifact committed as AMPBENCH_r01.json
 ampbench:
 	$(PY) tools/ampbench.py --out AMPBENCH_r01.json
 

@@ -25,11 +25,20 @@ from .hlo_audit import (  # noqa: F401
     ProgramReport,
     RecompileGuard,
     ShardingInfo,
+    ValueDef,
     audit_compiled,
     audit_lowered,
     audit_text,
     fingerprint_diff,
     parse_sharding,
+)
+from .memory import (  # noqa: F401
+    VALIDATION_TOLERANCE,
+    BufferLife,
+    Materialization,
+    MemoryReport,
+    jax_expected_peak,
+    memory_report,
 )
 from .comm import (  # noqa: F401
     CollectiveCost,
@@ -56,7 +65,9 @@ __all__ = [
     "Op", "Collective", "DonationReport", "ProgramReport", "ProgramAudit",
     "audit_text", "audit_lowered", "audit_compiled",
     "Fingerprint", "fingerprint_diff", "RecompileGuard",
-    "ShardingInfo", "parse_sharding",
+    "ShardingInfo", "parse_sharding", "ValueDef",
+    "MemoryReport", "BufferLife", "Materialization", "memory_report",
+    "jax_expected_peak", "VALIDATION_TOLERANCE",
     "CollectiveCost", "CommReport", "Reshard", "comm_report",
     "detect_accidental_reshards",
     "ContractViolation", "check_contract", "expected_tiles",

@@ -24,6 +24,11 @@ Rules (docs/ANALYSIS.md has the full catalog with examples):
   JH004 mutable-default-arg     ``def f(x=[], y={}, z=set())``.
   JH005 unlocked-global-mutation  mutating a module-global dict/list/set
                                 outside any ``with <lock>:`` block.
+  JH007 traced-constant-capture  a jitted/scanned closure reading a name
+                                bound to a host ``np.ndarray`` (or a
+                                large literal) — traced into the program
+                                as a baked constant: silent resident
+                                bytes and a recompile when it changes.
   JH006 unknown-mesh-axis       a ``PartitionSpec``/``P``/``named_sharding``
                                 call site passing an axis-name string
                                 literal outside the MeshConfig vocabulary
@@ -71,6 +76,10 @@ RULES: Dict[str, str] = {
     "JH006": "unknown-mesh-axis: PartitionSpec/named_sharding axis-name "
              "literal not in the MeshConfig vocabulary (dp/fsdp/tp/sp/pp/"
              "ep) — a typo'd axis name silently replicates the tensor",
+    "JH007": "traced-constant-capture: a jitted/scanned function closes "
+             "over a host np.ndarray or large Python literal — it is "
+             "baked into the program as a constant (silent resident "
+             "bytes, and any change recompiles); pass it as an argument",
 }
 
 #: the MeshConfig axis vocabulary (mirror of parallel.mesh.AXES — kept
@@ -120,6 +129,17 @@ _JIT_WRAPPERS = frozenset({
     "grad", "value_and_grad", "custom_vjp", "custom_jvp", "scan",
     "while_loop", "fori_loop", "cond", "switch",
 })
+
+# JH007: numpy constructors that materialize a HOST array — a name bound
+# to one of these and read inside a jitted closure is baked into the
+# program as a constant
+_NP_ARRAY_MAKERS = frozenset({
+    "array", "asarray", "zeros", "ones", "arange", "full", "eye",
+    "linspace", "empty", "identity", "tri", "ascontiguousarray",
+})
+# JH007: a literal list/tuple/dict this big folded into a traced program
+# is a constant worth flagging too
+_LARGE_LITERAL_ELEMS = 32
 
 # JH001: attribute calls that synchronize/copy to host
 _SYNC_ATTRS = frozenset({"item", "asnumpy", "tolist", "__array__"})
@@ -324,6 +344,12 @@ class _Linter(ast.NodeVisitor):
         self._with_lock_depth = 0
         self._module_globals: Set[str] = set()
         self._suppressed_fn_lines: List[int] = []
+        # JH007: names bound to host arrays / large literals, per scope —
+        # module level plus one set per enclosing function (closures)
+        self._module_host_consts: Set[str] = set()
+        self._fn_host_consts: List[Set[str]] = []
+        self._jh007_candidates: List[Set[str]] = []
+        self._jh007_reported: Set[Tuple[int, str]] = set()
 
     # -- context helpers ---------------------------------------------------
     @property
@@ -358,7 +384,38 @@ class _Linter(ast.NodeVisitor):
                      "defaultdict")):
                 for t in targets:
                     self._module_globals.add(t.id)
+            if self._is_host_const_expr(value):
+                for t in targets:
+                    self._module_host_consts.add(t.id)
+            else:
+                # a later rebinding to a non-host expression (the common
+                # `X = np.arange(n); X = jnp.asarray(X)` build-then-
+                # transfer pattern) clears the hazard — the traced read
+                # sees the device array
+                for t in targets:
+                    self._module_host_consts.discard(t.id)
         self.generic_visit(node)
+
+    # -- JH007 helpers -------------------------------------------------------
+    @staticmethod
+    def _is_host_const_expr(value: ast.AST) -> bool:
+        """An expression that materializes a HOST constant a trace would
+        bake in: an ``np.*`` array constructor, or a literal container
+        with >= _LARGE_LITERAL_ELEMS scalar elements."""
+        if isinstance(value, ast.Call):
+            dotted = _dotted(value.func)
+            if dotted.startswith(("np.", "numpy.")) and \
+                    dotted.rsplit(".", 1)[-1] in _NP_ARRAY_MAKERS:
+                return True
+            # method chains stay host arrays: np.arange(n).reshape(a, b)
+            if isinstance(value.func, ast.Attribute):
+                return _Linter._is_host_const_expr(value.func.value)
+            return False
+        if isinstance(value, (ast.List, ast.Tuple, ast.Dict)):
+            n = sum(1 for x in ast.walk(value)
+                    if isinstance(x, ast.Constant))
+            return n >= _LARGE_LITERAL_ELEMS
+        return False
 
     # -- function scope ------------------------------------------------------
     def visit_FunctionDef(self, node):
@@ -375,6 +432,20 @@ class _Linter(ast.NodeVisitor):
         if hot:
             names |= self._traced_args()
         self._hot_args.append(names if hot else set())
+        # JH007: names this hot closure could capture as traced constants
+        # — module-level + enclosing-function host arrays, minus anything
+        # the function itself binds (args or local stores shadow)
+        if hot:
+            local_stores = {n.id for n in ast.walk(node)
+                            if isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Store)}
+            cands = set(self._module_host_consts)
+            for s in self._fn_host_consts:
+                cands |= s
+            self._jh007_candidates.append(cands - names - local_stores)
+        else:
+            self._jh007_candidates.append(set())
+        self._fn_host_consts.append(set())
         # a def inside `with lock:` does NOT run under that lock — it runs
         # whenever the callback is invoked, on whatever thread — so JH005
         # must not inherit the enclosing lock depth into the body
@@ -382,6 +453,8 @@ class _Linter(ast.NodeVisitor):
         self._with_lock_depth = 0
         self.generic_visit(node)
         self._with_lock_depth = saved_lock_depth
+        self._fn_host_consts.pop()
+        self._jh007_candidates.pop()
         self._hot_args.pop()
         self._hot_stack.pop()
         self._fn_stack.pop()
@@ -547,6 +620,17 @@ class _Linter(ast.NodeVisitor):
         return None
 
     def visit_Assign(self, node):
+        # JH007 bookkeeping: a host-array binding in THIS function is a
+        # capture candidate for any closure defined after it; rebinding
+        # the name to a non-host expression clears it again
+        if self._fn_stack and self._fn_host_consts:
+            host = self._is_host_const_expr(node.value)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    if host:
+                        self._fn_host_consts[-1].add(t.id)
+                    else:
+                        self._fn_host_consts[-1].discard(t.id)
         if self._fn_stack and not self._with_lock_depth:
             for t in node.targets:
                 if isinstance(t, ast.Subscript):
@@ -556,6 +640,22 @@ class _Linter(ast.NodeVisitor):
                                     f"unlocked write to module-global "
                                     f"{name!r} (guard with a threading.Lock"
                                     " or suppress if import-time only)")
+        self.generic_visit(node)
+
+    # -- JH007: traced-constant capture --------------------------------------
+    def visit_Name(self, node):
+        if self.in_hot and isinstance(node.ctx, ast.Load) and \
+                self._jh007_candidates and \
+                node.id in self._jh007_candidates[-1]:
+            key = (id(self._fn_stack[-1]), node.id)
+            if key not in self._jh007_reported:
+                self._jh007_reported.add(key)
+                self.report(
+                    "JH007", node,
+                    f"host array {node.id!r} is closed over by a jitted/"
+                    "scanned function and baked into the program as a "
+                    "constant (resident bytes + a recompile when it "
+                    "changes) — pass it as an argument or move it to jnp")
         self.generic_visit(node)
 
     def visit_Delete(self, node):

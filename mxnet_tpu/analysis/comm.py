@@ -45,17 +45,11 @@ import dataclasses
 from collections import Counter as _Counter
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from .hlo_audit import DTYPE_BYTES  # noqa: F401  (canonical home moved)
 from .hlo_audit import Collective, ProgramReport
 
 __all__ = ["CollectiveCost", "CommReport", "Reshard", "comm_report",
            "detect_accidental_reshards", "DTYPE_BYTES"]
-
-#: element width in bytes per HLO dtype token (pred stored as one byte)
-DTYPE_BYTES: Dict[str, int] = {
-    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
-    "s32": 4, "u32": 4, "s64": 8, "u64": 8, "f8e4m3fn": 1, "f8e5m2": 1,
-    "bf16": 2, "f16": 2, "f32": 4, "f64": 8, "c64": 8, "c128": 16,
-}
 
 # byte multiplier per collective kind (see module docstring table)
 _KIND_FACTOR = {

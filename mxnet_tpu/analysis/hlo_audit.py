@@ -38,7 +38,26 @@ from typing import Dict, List, Optional, Sequence, Tuple
 __all__ = ["Op", "Collective", "DonationReport", "ProgramReport",
            "ProgramAudit", "audit_text", "audit_lowered", "audit_compiled",
            "Fingerprint", "fingerprint_diff", "RecompileGuard",
-           "ShardingInfo", "parse_sharding"]
+           "ShardingInfo", "parse_sharding", "ValueDef", "DTYPE_BYTES"]
+
+#: element width in bytes per HLO dtype token (pred stored as one byte).
+#: Lives here (not comm.py, which re-exports it) because both the comm
+#: cost model and the buffer-liveness pass size tensors with it.
+DTYPE_BYTES: Dict[str, int] = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+    "s32": 4, "u32": 4, "s64": 8, "u64": 8, "f8e4m3fn": 1, "f8e5m2": 1,
+    "bf16": 2, "f16": 2, "f32": 4, "f64": 8, "c64": 8, "c128": 16,
+    "i1": 1, "i8": 1, "i16": 2, "i32": 4, "i64": 8, "ui8": 1, "ui16": 2,
+    "ui32": 4, "ui64": 8,
+}
+
+
+def tensor_bytes(dtype: Optional[str], shape: Sequence[int]) -> int:
+    """Logical bytes of one tensor (4-byte fallback for unknown dtypes)."""
+    n = 1
+    for d in shape:
+        n *= d
+    return n * DTYPE_BYTES.get(dtype or "", 4)
 
 # ops that move data between host and device (either dialect's spelling,
 # normalized): the serving/training hot loops must never contain one
@@ -193,12 +212,44 @@ class Collective(Op):
 
 
 @dataclasses.dataclass
+class ValueDef:
+    """One SSA value definition — the def/use record the buffer-liveness
+    pass (:mod:`~mxnet_tpu.analysis.memory`) sweeps. Unlike :class:`Op`
+    (the census view, which filters structural noise), every instruction
+    that *defines* a value lands here — constants, copies, tuples,
+    get-tuple-elements included — because each is a potential allocation.
+
+    ``bytes`` is the full result allocation: tuple results (async
+    collective starts, variadic all-reduces, ``while`` carries) sum every
+    element, with the per-element ``(dtype, shape)`` list kept in
+    ``results`` so donated-alias exclusion can subtract exactly the
+    carried element that shares a donated input's buffer."""
+
+    vid: str                   # SSA id, no leading % ("" for return lines)
+    op: str                    # normalized op name
+    bytes: int                 # full result allocation, tuple elems summed
+    results: Tuple[Tuple[str, Tuple[int, ...]], ...]  # per result element
+    uses: Tuple[str, ...]      # SSA ids this instruction reads
+    line: int
+    callees: Tuple[str, ...] = ()   # subcomputations (while body, calls=)
+    param: Optional[int] = None     # parameter number (op == "parameter")
+    gte_index: Optional[int] = None  # get_tuple_element tuple index
+
+    def __repr__(self):
+        return f"ValueDef(%{self.vid}: {self.op} {self.bytes}B @L{self.line})"
+
+
+@dataclasses.dataclass
 class DonationReport:
     """Which flat program inputs are aliased to outputs (donation made it
     through to the executable)."""
 
     n_inputs: int
     aliased: Dict[int, str]  # flat input index -> "may-alias"|"must-alias"
+    # flat OUTPUT index -> flat input index it aliases (the direction the
+    # liveness pass needs: a donated carry's output element costs zero
+    # extra bytes because it writes the input's buffer in place)
+    out_alias: Dict[int, int] = dataclasses.field(default_factory=dict)
 
     @property
     def n_aliased(self) -> int:
@@ -239,9 +290,15 @@ _HLO_DTYPES = frozenset({"pred", "s4", "s8", "s16", "s32", "s64", "u4", "u8",
 # group — quoted attr values like `mhlo.sharding = "{replicated}"` contain
 # `}` and would truncate the capture before tf.aliasing_output
 _MLIR_ARG = re.compile(r"%arg(\d+):\s*tensor<([^>]*)>")
-_MLIR_ALIAS = re.compile(r"tf\.aliasing_output")
+_MLIR_ALIAS = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
 # donation, compiled: input_output_alias={ {0}: (0, {}, may-alias), ... }
-_HLO_ALIAS_ENTRY = re.compile(r"\((\d+),\s*\{[^}]*\},\s*(may-alias|must-alias)\)")
+# — the brace key is the OUTPUT tuple index, the first paren int the
+# input. A single-(non-tuple)-output program spells the key `{}` (empty
+# index path = the output itself), so the digits are optional and an
+# empty capture means output 0
+_HLO_ALIAS_ENTRY = re.compile(r"\{\s*(\d*)[\d,\s]*\}:\s*"
+                              r"\((\d+),\s*\{[^}]*\},\s*"
+                              r"(may-alias|must-alias)\)")
 
 
 def _alias_header_body(line: str) -> str:
@@ -455,6 +512,17 @@ class ProgramReport:
     # lowered mhlo.sharding arg attr / the compiled parameter sharding=)
     arg_shardings: Dict[int, ShardingInfo] = \
         dataclasses.field(default_factory=dict)
+    # -- def/use tables for the buffer-liveness pass (analysis.memory) ------
+    # main-computation (ENTRY / @main) value defs in program order; the
+    # compiled dialect is scheduled text, so this order IS the schedule
+    values: List[ValueDef] = dataclasses.field(default_factory=list)
+    # every other computation (fusion bodies, while body/cond regions,
+    # func.call targets) keyed by name, leading % stripped
+    subcomputations: Dict[str, List[ValueDef]] = \
+        dataclasses.field(default_factory=dict)
+    # the returned SSA tokens per flat output, in output order; MLIR
+    # tuple-element refs keep their "#k" suffix ("1#2")
+    output_ids: Tuple[str, ...] = ()
 
     # -- census --------------------------------------------------------------
     def op_census(self) -> Dict[str, int]:
@@ -555,32 +623,128 @@ class ProgramReport:
         }
 
 
+# MLIR value-def syntax: `%2 = ...` / `%8:2 = ...` (multi-result)
+_MLIR_RESULT = re.compile(r"^%([A-Za-z0-9_$.]+)(?::(\d+))?\s*=")
+# region-arg bindings in a while header: `%iterArg_1 = %arg0`
+_MLIR_REGION_ARG = re.compile(r"%([A-Za-z0-9_$.]+)\s*=\s*%[A-Za-z0-9_$.]+")
+_MLIR_USE = re.compile(r"%([A-Za-z0-9_$.]+)")
+# output tokens on a bare `return %1#2, %5 : ...` line keep the #k suffix
+_MLIR_OUT_TOKEN = re.compile(r"%([A-Za-z0-9_$.]+(?:#\d+)?)")
+_MLIR_CALLEE = re.compile(r"call\s+@([A-Za-z0-9_$.]+)")
+_FUNC_NAME = re.compile(r"func\.func\s+(?:public\s+|private\s+)?"
+                        r"@([A-Za-z0-9_$.]+)")
+
+
+def _mlir_result_tensors(s: str) -> List[Tuple[str, Tuple[int, ...]]]:
+    """The result-type tensors of one MLIR op line: everything after the
+    last ``->`` (functional form), else after the last `` : `` (pretty
+    form — ``%1 = stablehlo.tanh %0 : tensor<4x16xf32>``, a ``while``'s
+    trailing carry-type list)."""
+    arrow = s.rfind("->")
+    if arrow >= 0:
+        return _mlir_tensors(s[arrow:])
+    colon = s.rfind(" : ")
+    if colon >= 0:
+        return _mlir_tensors(s[colon:])
+    return []
+
+
 def _parse_stablehlo(text: str) -> ProgramReport:
     ops: List[Op] = []
     collectives: List[Collective] = []
     custom_calls: List[str] = []
     inputs: List[Tuple[str, Tuple[int, ...]]] = []
     aliased: Dict[int, str] = {}
+    out_alias: Dict[int, int] = {}
     arg_shardings: Dict[int, ShardingInfo] = {}
+    funcs: Dict[str, List[ValueDef]] = {}
+    fn_outputs: Dict[str, Tuple[str, ...]] = {}
+    cur_fn: Optional[str] = None
     lines = text.splitlines()
-    in_main_sig = False
-    sig_buf = []
+    in_sig = False
+    sig_fn: Optional[str] = None
+    sig_buf: List[str] = []
+    main_sig = ""
+
+    def _close_sig(i: int):
+        """Sig buffered to completion: emit parameter ValueDefs for the
+        function (zero-cost aliases for callees; the liveness pass pins
+        @main's inputs separately via ``report.inputs``)."""
+        nonlocal main_sig
+        sig = " ".join(sig_buf)
+        if sig_fn == "main":
+            main_sig = sig
+        vals = funcs.setdefault(sig_fn or "?", [])
+        for m in _MLIR_ARG.finditer(sig):
+            idx = int(m.group(1))
+            tm = re.match(r"([0-9x]*)((?:[a-z][a-z0-9]*))$", m.group(2))
+            if tm:
+                dims, dt = tm.groups()
+                shape = tuple(int(d) for d in dims.split("x") if d) \
+                    if dims else ()
+            else:
+                dt, shape = "?", ()
+            vals.append(ValueDef(vid=f"arg{idx}", op="parameter",
+                                 bytes=tensor_bytes(dt, shape),
+                                 results=((dt, shape),), uses=(), line=i,
+                                 param=idx))
+
+    def _value_of(s: str, i: int, name: str) -> None:
+        """Record the def/use ValueDef(s) of one op line."""
+        vals = funcs.setdefault(cur_fn or "?", [])
+        rm = _MLIR_RESULT.match(s)
+        rest = s[rm.end():] if rm else s
+        region_defs = list(dict.fromkeys(_MLIR_REGION_ARG.findall(rest)))
+        uses = tuple(u for u in _MLIR_USE.findall(rest)
+                     if u not in region_defs)
+        callees = tuple(_MLIR_CALLEE.findall(s))
+        results = tuple(_mlir_result_tensors(s))
+        if rm is None:
+            # region/return lines define nothing but their uses still
+            # extend operand live ranges
+            vals.append(ValueDef(vid="", op=name, bytes=0, results=(),
+                                 uses=uses, line=i))
+            return
+        vals.append(ValueDef(
+            vid=rm.group(1), op=name,
+            bytes=sum(tensor_bytes(dt, sh) for dt, sh in results),
+            results=results, uses=uses, line=i, callees=callees))
+        for g in region_defs:
+            vals.append(ValueDef(vid=g, op="region_arg", bytes=0,
+                                 results=(), uses=(), line=i))
+
     for i, line in enumerate(lines, 1):
         s = line.strip()
-        # the @main signature may span lines; buffer until the body opens
-        if "func.func" in s and "@main" in s:
-            in_main_sig = True
-        if in_main_sig:
+        # a func signature may span lines; buffer until the body opens
+        if "func.func" in s:
+            in_sig = True
+            fm = _FUNC_NAME.search(s)
+            sig_fn = fm.group(1) if fm else "?"
+            sig_buf = []
+            cur_fn = sig_fn
+        if in_sig:
             sig_buf.append(s)
             if s.endswith("{"):
-                in_main_sig = False
+                in_sig = False
+                _close_sig(i)
             continue
-        if not s or s.startswith(("module", "func.func", "return", "}", "^")):
+        if s.startswith("return"):
+            # the function's own return: record output tokens (tuple-
+            # element refs keep their #k suffix for alias exclusion)
+            if cur_fn is not None:
+                fn_outputs[cur_fn] = tuple(_MLIR_OUT_TOKEN.findall(s))
+            continue
+        if not s or s.startswith(("module", "func.func", "}", "^")):
             continue
         name = _mlir_line_op(s)
         if name is None:
+            # func.call defines values and reaches a subcomputation, but
+            # is not a stablehlo op — value table only, census untouched
+            if _MLIR_CALLEE.search(s):
+                _value_of(s, i, "call")
             continue
         name = _normalize_op(name)
+        _value_of(s, i, name)
         if name in _ASYNC_DONE:
             continue
         tensors = _mlir_tensors(s)
@@ -627,7 +791,7 @@ def _parse_stablehlo(text: str) -> ProgramReport:
             meta = _conv_meta(s, "stablehlo")
         ops.append(Op(name, rdt, rshape, dtypes, i, shapes=shapes,
                       sharding=op_sharding, dot_meta=meta))
-    sig = " ".join(sig_buf)
+    sig = main_sig
     matches = list(_MLIR_ARG.finditer(sig))
     for k, m in enumerate(matches):
         idx = int(m.group(1))
@@ -646,16 +810,32 @@ def _parse_stablehlo(text: str) -> ProgramReport:
         # braces, so a brace-bounded capture would truncate before
         # tf.aliasing_output
         end = matches[k + 1].start() if k + 1 < len(matches) else len(sig)
-        if _MLIR_ALIAS.search(sig, m.end(), end):
+        am = _MLIR_ALIAS.search(sig, m.end(), end)
+        if am:
             aliased[idx] = "may-alias"
+            out_alias[int(am.group(1))] = idx
         shm = _MLIR_SHARDING.search(sig[m.end():end])
         if shm:
             arg_shardings[idx] = parse_sharding(shm.group(1))
+    values = funcs.pop("main", [])
     return ProgramReport(
         dialect="stablehlo", ops=ops, collectives=collectives,
         custom_calls=custom_calls,
-        donation=DonationReport(n_inputs=len(inputs), aliased=aliased),
-        inputs=inputs, n_lines=len(lines), arg_shardings=arg_shardings)
+        donation=DonationReport(n_inputs=len(inputs), aliased=aliased,
+                                out_alias=out_alias),
+        inputs=inputs, n_lines=len(lines), arg_shardings=arg_shardings,
+        values=values, subcomputations=funcs,
+        output_ids=fn_outputs.get("main", ()))
+
+
+# HLO value-def syntax: `%add.5 = ...` / `ROOT %tuple.3 = ...` (names may
+# contain dots and dashes: `%dynamic-slice_bitcast_fusion`)
+_HLO_RESULT = re.compile(r"^(ROOT\s+)?%([\w.\-]+)\s*=")
+_HLO_USE = re.compile(r"%([\w.\-]+)")
+_HLO_CALLEE = re.compile(r"(?:calls|body|condition|to_apply)=%([\w.\-]+)")
+_HLO_BRANCHES = re.compile(r"branch_computations=\{([^}]*)\}")
+# computation header: `%region_0.19 (args...) -> type {` / `ENTRY %main (..`
+_HLO_COMP = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(")
 
 
 def _parse_hlo(text: str) -> ProgramReport:
@@ -664,24 +844,68 @@ def _parse_hlo(text: str) -> ProgramReport:
     custom_calls: List[str] = []
     inputs: List[Tuple[str, Tuple[int, ...]]] = []
     aliased: Dict[int, str] = {}
+    out_alias: Dict[int, int] = {}
     arg_shardings: Dict[int, ShardingInfo] = {}
+    comps: Dict[str, List[ValueDef]] = {}
+    entry_name: Optional[str] = None
+    cur_comp: Optional[str] = None
+    output_ids: Tuple[str, ...] = ()
     lines = text.splitlines()
     entry_params: Dict[int, Tuple[str, Tuple[int, ...]]] = {}
     in_entry = False
     for i, line in enumerate(lines, 1):
         s = line.strip()
         if s.startswith("HloModule"):
-            for pnum, kind in _HLO_ALIAS_ENTRY.findall(_alias_header_body(s)):
+            for onum, pnum, kind in _HLO_ALIAS_ENTRY.findall(
+                    _alias_header_body(s)):
                 aliased[int(pnum)] = kind
+                out_alias[int(onum) if onum else 0] = int(pnum)
             continue
-        if s.startswith("ENTRY"):
-            in_entry = True
+        if s.endswith("{") and _HLO_RESULT.match(s) is None and \
+                (s.startswith("%") or s.startswith("ENTRY")):
+            cm = _HLO_COMP.match(s)
+            cur_comp = cm.group(1) if cm else "?"
+            if s.startswith("ENTRY"):
+                in_entry = True
+                entry_name = cur_comp
+            continue
+        if s == "}":
+            cur_comp = None
+            in_entry = False
+            continue
         if not s or s.startswith(("//", "#")):
             continue
         m = _HLO_OP.search(s)
         if m is None:
             continue
         name = m.group(1)
+        norm = _normalize_op(name)
+        # -- value table (liveness pass): EVERY defining instruction,
+        # before the census filters drop the structural ops — a copy IS
+        # an allocation, a big constant IS resident bytes
+        rm = _HLO_RESULT.match(s)
+        if rm is not None:
+            callees = tuple(_HLO_CALLEE.findall(s))
+            bm = _HLO_BRANCHES.search(s)
+            if bm:
+                callees += tuple(_HLO_USE.findall(bm.group(1)))
+            results = tuple(_hlo_tensors(s[rm.end():m.start(1)]))
+            uses = tuple(u for u in _HLO_USE.findall(s[m.end(1):])
+                         if u not in callees)
+            pm_ = re.search(r"parameter\((\d+)\)", s)
+            gm_ = (re.search(r"index=(\d+)", s)
+                   if norm == "get_tuple_element" else None)
+            v = ValueDef(
+                vid=rm.group(2), op=norm,
+                bytes=sum(tensor_bytes(dt, sh) for dt, sh in results),
+                results=results, uses=uses, line=i, callees=callees,
+                param=int(pm_.group(1)) if pm_ else None,
+                gte_index=int(gm_.group(1)) if gm_ else None)
+            comps.setdefault(cur_comp or "?", []).append(v)
+            if rm.group(1) and cur_comp == entry_name:
+                # the ENTRY root: output j = operand j of the root tuple
+                # (or the root itself for single-output programs)
+                output_ids = uses if norm == "tuple" else (v.vid,)
         if name in ("parameter",):
             tensors = _hlo_tensors(s)
             if in_entry and tensors:
@@ -692,7 +916,7 @@ def _parse_hlo(text: str) -> ProgramReport:
                     if sh is not None:
                         arg_shardings[int(pm.group(1))] = parse_sharding(sh)
             continue
-        name = _normalize_op(name)
+        name = norm
         if name in ("constant", "tuple", "get_tuple_element", "bitcast",
                     "copy"):
             # structural noise: layout/plumbing ops drown the census —
@@ -736,11 +960,14 @@ def _parse_hlo(text: str) -> ProgramReport:
     n_inputs = (max(entry_params) + 1) if entry_params else 0
     for idx in range(n_inputs):
         inputs.append(entry_params.get(idx, ("?", ())))
+    values = comps.pop(entry_name, []) if entry_name else []
     return ProgramReport(
         dialect="hlo", ops=ops, collectives=collectives,
         custom_calls=custom_calls,
-        donation=DonationReport(n_inputs=n_inputs, aliased=aliased),
-        inputs=inputs, n_lines=len(lines), arg_shardings=arg_shardings)
+        donation=DonationReport(n_inputs=n_inputs, aliased=aliased,
+                                out_alias=out_alias),
+        inputs=inputs, n_lines=len(lines), arg_shardings=arg_shardings,
+        values=values, subcomputations=comps, output_ids=output_ids)
 
 
 @dataclasses.dataclass
@@ -761,6 +988,9 @@ class ProgramAudit:
     # communication cost model over the program's collectives
     # (analysis.comm.CommReport), None when not computed
     comm: Optional[object] = None
+    # buffer-liveness residency estimate (analysis.memory.MemoryReport):
+    # peak bytes, timeline, category attribution, materializations
+    memory: Optional[object] = None
 
     def carry_donation(self) -> float:
         """Donation coverage of the carry (params/opt-state for TrainStep,
@@ -783,6 +1013,8 @@ class ProgramAudit:
             out["compiled"] = self.compiled.summary()
         if self.comm is not None:
             out["comm"] = self.comm.summary()
+        if self.memory is not None:
+            out["memory"] = self.memory.summary()
         return out
 
 

@@ -1,0 +1,498 @@
+"""Static buffer-liveness & peak-residency analysis (docs/ANALYSIS.md).
+
+The analysis subsystem already prices compute (the FLOPs model in
+``observability.goodput``) and communication (:mod:`.comm`); this module
+prices **memory** — the resource that actually caps batch size, window
+length and page-pool size. It sweeps the def/use tables the HLO auditor
+parses (:class:`~mxnet_tpu.analysis.hlo_audit.ValueDef`, both dialects)
+in program order and computes, per instruction, the set of live buffers:
+
+  - a value is live from its defining instruction to its last use;
+  - program inputs are pinned for the whole program (the caller owns
+    them), categorized by the flat-input category map the audit entry
+    points provide (params / opt_state / kv_pages / batch / ...);
+  - **donation-aware**: an output that aliases a donated input
+    (``input_output_alias`` / ``tf.aliasing_output``) writes the input's
+    buffer in place and costs zero extra bytes — for a scan carry the
+    aliased *element* of the ``while`` result is subtracted, so donated
+    carries are never double-counted;
+  - in-place ops (``while``, ``dynamic-update-slice``,
+    ``optimization-barrier``) free their dying operands *before* the
+    result is counted — XLA reuses the buffer, the sweep must too;
+  - structural ops (``tuple`` / ``get-tuple-element`` / ``bitcast`` /
+    ``reshape`` / non-entry ``parameter``) are zero-cost aliases;
+  - control-flow subcomputations (``while`` body/cond, ``conditional``
+    branches, ``func.call`` targets) contribute their own *internal*
+    liveness peak at the call instruction (recursively); **fusion bodies
+    do not** — fused intermediates live in registers, which is exactly
+    the materialization boundary of arXiv:2301.13062.
+
+The result is a :class:`MemoryReport`: estimated ``peak_bytes``, the
+residency ``timeline``, ``largest_buffers(n)``, an at-peak ``by_category``
+breakdown, and the **materialization detectors**:
+
+  ``kv_gather_materialize``  a gather whose result is pool-sized — the
+                             XLA gather-materialize of the paged KV cache
+                             the ROADMAP's Pallas decode kernel removes
+  ``f32_upcast``             a large f32 copy converted from a
+                             bf16-stored tensor (the AMP storage win
+                             silently undone at compute time)
+  ``long_lived_temp``        a big non-input buffer live across most of
+                             the program — the remat-defeating pattern
+                             (an activation ``jax.checkpoint`` was
+                             supposed to drop is being kept anyway)
+
+Compiled-dialect text is scheduled (``is_scheduled=true``), so text order
+is the schedule and the sweep is faithful; the lowered dialect gives a
+pre-fusion upper bound. The estimate is cross-validated against
+``jax.stages.Compiled.memory_analysis()`` on CPU: ``peak_bytes`` must
+agree with ``arguments + outputs + temps − aliased`` within
+:data:`VALIDATION_TOLERANCE` (tests/test_memory.py, ``make memcheck``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter as _Counter
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .hlo_audit import ProgramReport, ValueDef, _ASYNC_DONE, tensor_bytes
+
+__all__ = ["BufferLife", "Materialization", "MemoryReport", "memory_report",
+           "jax_expected_peak", "VALIDATION_TOLERANCE"]
+
+#: documented tolerance of the cross-validation against
+#: ``Compiled.memory_analysis()`` on CPU: the liveness estimate and XLA's
+#: buffer assignment must agree on peak residency within this relative
+#: error on the gated step/decode programs (measured: +6.4% on the MLP
+#: Adam step, +7.6% on the dense decode step, +10/15% on the T=1024
+#: GPT-2 step without/with remat). The gap is real, not noise: XLA pads
+#: and aligns allocations, shares same-sized buffers the sweep keeps
+#: distinct, and schedules fusions the text can't see inside. Fused
+#: k-step window (scan) programs sit outside this bound by design — the
+#: sweep counts the body working set against the carry without modeling
+#: XLA's in-loop buffer sharing, an upper bound the goldens pin instead.
+VALIDATION_TOLERANCE = 0.25
+
+# result is an alias/view of an existing buffer — zero allocation
+ZERO_COST_OPS = frozenset({
+    "parameter", "region_arg", "tuple", "get_tuple_element", "bitcast",
+    "reshape", "return", "after_all", "partition_id", "replica_id",
+})
+
+# in-place ops: the result reuses the storage of operands dying at the
+# same instruction (XLA compiles while carries and top-level DUS in place)
+ALIAS_OPS = frozenset({"while", "dynamic_update_slice",
+                       "optimization_barrier", "opt_barrier"})
+
+# ops whose subcomputations' internal temps are live at the call point
+# (fusion deliberately NOT here: fused intermediates are registers)
+RECURSE_OPS = frozenset({"while", "conditional", "case", "call"})
+
+# KV-cache input categories the gather-materialize detector watches
+_KV_CATEGORIES = frozenset({"kv_pages", "kv_cache", "draft_pages"})
+
+
+@dataclasses.dataclass
+class BufferLife:
+    """One allocated buffer's life: the liveness engine's per-value view
+    (zero-cost aliases excluded)."""
+
+    vid: str
+    op: str
+    bytes: int       # allocation charged to this value (alias-reduced)
+    category: str
+    line: int        # source line of the defining instruction
+    t_def: int       # timeline index of the def
+    t_end: int       # timeline index of the last use (inclusive)
+
+    @property
+    def span(self) -> int:
+        return self.t_end - self.t_def
+
+    def describe(self) -> str:
+        return (f"%{self.vid} ({self.op}, {self.category}): {self.bytes} B"
+                f" live [{self.t_def}, {self.t_end}]")
+
+
+@dataclasses.dataclass
+class Materialization:
+    """One detected materialization hazard (see module docstring)."""
+
+    kind: str
+    bytes: int
+    line: int
+    detail: str
+
+    def __str__(self):
+        return f"{self.kind} @L{self.line}: {self.detail}"
+
+
+@dataclasses.dataclass
+class MemoryReport:
+    """Estimated memory residency of one program (docs/ANALYSIS.md)."""
+
+    dialect: str
+    peak_bytes: int          # max resident bytes (inputs pinned + live)
+    temp_peak_bytes: int     # max live bytes EXCLUDING the pinned inputs
+    peak_index: int          # timeline index of the peak
+    peak_line: int           # source line of the peak instruction
+    timeline: List[Tuple[int, int, int]]  # (line, total, non-input) per t
+    buffers: List[BufferLife]             # allocations, program order
+    by_category: Dict[str, int]           # live bytes per category AT peak
+    input_bytes: int
+    output_bytes: int
+    donated_bytes: int       # input bytes whose outputs write in place
+    materializations: List[Materialization]
+    n_values: int
+
+    def largest_buffers(self, n: int = 10) -> List[BufferLife]:
+        """The ``n`` biggest allocations, descending — where the peak
+        actually lives."""
+        return sorted(self.buffers, key=lambda b: -b.bytes)[:n]
+
+    def materialization_kinds(self) -> Dict[str, int]:
+        return dict(_Counter(m.kind for m in self.materializations))
+
+    def category_share(self, category: str) -> float:
+        if not self.peak_bytes:
+            return 0.0
+        return self.by_category.get(category, 0) / self.peak_bytes
+
+    def summary(self) -> dict:
+        """JSON-safe digest (what tools/memcheck.py snapshots)."""
+        return {
+            "dialect": self.dialect,
+            "peak_bytes": self.peak_bytes,
+            "temp_peak_bytes": self.temp_peak_bytes,
+            "peak_line": self.peak_line,
+            "input_bytes": self.input_bytes,
+            "output_bytes": self.output_bytes,
+            "donated_bytes": self.donated_bytes,
+            "by_category": dict(self.by_category),
+            "top_buffers": [[b.op, b.bytes]
+                            for b in self.largest_buffers(5)],
+            "materializations": self.materialization_kinds(),
+            "n_values": self.n_values,
+        }
+
+
+class _Inst:
+    """One live instance of an SSA value (regions re-bind short names, so
+    instances — not vids — are the liveness unit)."""
+
+    __slots__ = ("v", "t_def", "t_end", "cost", "category", "is_output")
+
+    def __init__(self, v: ValueDef, t: int):
+        self.v = v
+        self.t_def = t
+        self.t_end = t
+        self.cost = 0
+        self.category = ""
+        self.is_output = False
+
+
+def _zero_cost(v: ValueDef) -> bool:
+    return (v.op in ZERO_COST_OPS or v.op in _ASYNC_DONE
+            or v.param is not None)
+
+
+def _build_instances(values: Sequence[ValueDef]):
+    """(instances, final vid->instance map) with def/last-use indices."""
+    instances: List[_Inst] = []
+    cur: Dict[str, _Inst] = {}
+    for t, v in enumerate(values):
+        for u in v.uses:
+            inst = cur.get(u)
+            if inst is not None:
+                inst.t_end = t
+        if v.vid:
+            inst = _Inst(v, t)
+            instances.append(inst)
+            cur[v.vid] = inst
+    return instances, cur
+
+
+def _subcomp_peak(name: str, subs: Dict[str, List[ValueDef]],
+                  memo: Dict[str, int], visiting: frozenset) -> int:
+    """Internal liveness peak of one subcomputation: its own temps (its
+    parameters alias caller buffers and cost nothing) plus any nested
+    control-flow contribution."""
+    if name in memo:
+        return memo[name]
+    values = subs.get(name)
+    if values is None or name in visiting:
+        return 0
+    visiting = visiting | {name}
+    instances, _ = _build_instances(values)
+    by_def = {inst.t_def: inst for inst in instances}
+    expiring: Dict[int, List[_Inst]] = {}
+    for inst in instances:
+        inst.cost = 0 if _zero_cost(inst.v) else inst.v.bytes
+        expiring.setdefault(inst.t_end, []).append(inst)
+    live = 0
+    peak = 0
+    for t, v in enumerate(values):
+        callee_extra = 0
+        if v.callees and v.op in RECURSE_OPS:
+            callee_extra = max(
+                _subcomp_peak(c, subs, memo, visiting) for c in v.callees)
+        inst = by_def.get(t)
+        released = 0
+        if inst is not None and v.op in ALIAS_OPS:
+            for d in expiring.get(t, ()):
+                if d is not inst:
+                    live -= d.cost
+            released = 1
+        if inst is not None:
+            live += inst.cost
+        peak = max(peak, live + callee_extra)
+        if not released:
+            for d in expiring.get(t, ()):
+                live -= d.cost
+    memo[name] = peak
+    return peak
+
+
+def memory_report(report: ProgramReport,
+                  categories: Optional[Dict[int, str]] = None,
+                  default_category: str = "activations",
+                  detect: bool = True,
+                  gather_frac: float = 0.75,
+                  upcast_min_bytes: int = 1 << 20,
+                  long_lived_min_bytes: int = 1 << 20,
+                  long_lived_frac: float = 0.5) -> MemoryReport:
+    """Sweep ``report``'s def/use tables into a :class:`MemoryReport`.
+
+    ``categories`` maps flat input index -> category label (``params`` /
+    ``opt_state`` / ``kv_pages`` / ``batch`` ...); unmapped inputs land
+    under ``"inputs"`` and every non-input allocation under
+    ``default_category``. The detector thresholds are keyword-tunable;
+    defaults are sized so tiny CI programs stay quiet (1 MiB floors) while
+    real serving/training programs are caught.
+    """
+    categories = categories or {}
+    values = report.values
+    n = len(values)
+    inputs = report.inputs
+    pinned = sum(tensor_bytes(dt, sh) for dt, sh in inputs)
+    instances, cur = _build_instances(values)
+
+    # -- pass-through carries: a while whose carry element k is fed
+    # directly by an entry parameter aliases that pinned buffer (XLA
+    # compiles the loop in place; had the body needed a private copy, the
+    # operand would BE a copy instruction, which allocates and is counted)
+    # — without this, a scan that threads its stacked batch through the
+    # carry double-counts the whole batch
+    reductions: Dict[int, int] = {}  # id(inst) -> bytes to subtract
+    passthrough: set = set()         # (id(while inst), element k) covered
+    for inst in instances:
+        if inst.v.op != "while":
+            continue
+        elems: List[str] = list(inst.v.uses)
+        if len(elems) == 1:
+            opnd = cur.get(elems[0])
+            if opnd is not None and opnd.v.op == "tuple":
+                elems = list(opnd.v.uses)
+        for k, u in enumerate(elems):
+            src = cur.get(u)
+            if src is None or src.v.param is None:
+                continue
+            if k < len(inst.v.results):
+                b = tensor_bytes(*inst.v.results[k])
+            elif src.v.results:
+                b = tensor_bytes(*src.v.results[0])
+            else:
+                continue
+            reductions[id(inst)] = reductions.get(id(inst), 0) + b
+            passthrough.add((id(inst), k))
+
+    # -- donated-alias exclusion: output j writing input i's buffer ------
+    donated_bytes = 0
+    out_ids = report.output_ids
+    for out_idx, param_idx in sorted(report.donation.out_alias.items()):
+        if param_idx < len(inputs):
+            donated_bytes += tensor_bytes(*inputs[param_idx])
+        if out_idx >= len(out_ids):
+            continue
+        token = out_ids[out_idx]
+        base, sep, elem = token.partition("#")
+        inst = cur.get(base)
+        if inst is None:
+            continue
+        key = id(inst)
+        if sep and elem.isdigit() and int(elem) < len(inst.v.results):
+            # MLIR tuple-element ref: subtract exactly the carried element
+            # (unless the pass-through rule above already zeroed it)
+            if (key, int(elem)) in passthrough:
+                continue
+            reductions[key] = reductions.get(key, 0) + \
+                tensor_bytes(*inst.v.results[int(elem)])
+        elif inst.v.op == "get_tuple_element" and inst.v.uses:
+            src = cur.get(inst.v.uses[0])
+            if src is not None:
+                k = inst.v.gte_index
+                if k is not None and (id(src), k) in passthrough:
+                    continue
+                reductions[id(src)] = reductions.get(id(src), 0) + \
+                    inst.v.bytes
+        else:
+            reductions[key] = reductions.get(key, 0) + inst.v.bytes
+
+    # -- output bytes + keep outputs live to the end ---------------------
+    output_bytes = 0
+    for token in out_ids:
+        base, sep, elem = token.partition("#")
+        inst = cur.get(base)
+        if inst is None:
+            continue
+        inst.t_end = n  # never expires inside the sweep
+        inst.is_output = True
+        if sep and elem.isdigit() and int(elem) < len(inst.v.results):
+            output_bytes += tensor_bytes(*inst.v.results[int(elem)])
+        else:
+            output_bytes += inst.v.bytes
+
+    # -- per-instance cost & category ------------------------------------
+    by_def: Dict[int, _Inst] = {}
+    expiring: Dict[int, List[_Inst]] = {}
+    for inst in instances:
+        by_def[inst.t_def] = inst
+        if _zero_cost(inst.v):
+            inst.cost = 0
+        else:
+            inst.cost = max(0, inst.v.bytes - reductions.get(id(inst), 0))
+        inst.category = default_category
+        expiring.setdefault(inst.t_end, []).append(inst)
+
+    cat_live: _Counter = _Counter()
+    for i, (dt, sh) in enumerate(inputs):
+        cat_live[categories.get(i, "inputs")] += tensor_bytes(dt, sh)
+
+    # -- the sweep --------------------------------------------------------
+    memo: Dict[str, int] = {}
+    subs = report.subcomputations
+    live_temp = 0
+    peak = pinned
+    peak_idx = -1
+    peak_line = 0
+    peak_cats = dict(cat_live)
+    timeline: List[Tuple[int, int, int]] = []
+    temp_peak = 0
+    for t, v in enumerate(values):
+        callee_extra = 0
+        if v.callees and v.op in RECURSE_OPS:
+            callee_extra = max(
+                _subcomp_peak(c, subs, memo, frozenset()) for c in v.callees)
+        inst = by_def.get(t)
+        released = False
+        if inst is not None and v.op in ALIAS_OPS:
+            # in-place: dying operands are freed BEFORE the result exists
+            for d in expiring.get(t, ()):
+                if d is not inst:
+                    live_temp -= d.cost
+                    cat_live[d.category] -= d.cost
+            released = True
+        if inst is not None:
+            live_temp += inst.cost
+            cat_live[inst.category] += inst.cost
+        total = pinned + live_temp + callee_extra
+        timeline.append((v.line, total, live_temp + callee_extra))
+        temp_peak = max(temp_peak, live_temp + callee_extra)
+        if total > peak:
+            peak = total
+            peak_idx = t
+            peak_line = v.line
+            peak_cats = dict(cat_live)
+            if callee_extra:
+                peak_cats[default_category] = \
+                    peak_cats.get(default_category, 0) + callee_extra
+        if not released:
+            for d in expiring.get(t, ()):
+                live_temp -= d.cost
+                cat_live[d.category] -= d.cost
+
+    buffers = [BufferLife(vid=i.v.vid, op=i.v.op, bytes=i.cost,
+                          category=i.category, line=i.v.line,
+                          t_def=i.t_def, t_end=min(i.t_end, n))
+               for i in instances if i.cost > 0]
+
+    mats: List[Materialization] = []
+    if detect:
+        mats = _detect_materializations(
+            report, categories, buffers, n,
+            gather_frac=gather_frac, upcast_min_bytes=upcast_min_bytes,
+            long_lived_min_bytes=long_lived_min_bytes,
+            long_lived_frac=long_lived_frac)
+
+    peak_cats = {k: v for k, v in peak_cats.items() if v > 0}
+    return MemoryReport(
+        dialect=report.dialect, peak_bytes=peak,
+        temp_peak_bytes=temp_peak, peak_index=peak_idx,
+        peak_line=peak_line, timeline=timeline, buffers=buffers,
+        by_category=peak_cats, input_bytes=pinned,
+        output_bytes=output_bytes, donated_bytes=donated_bytes,
+        materializations=mats, n_values=n)
+
+
+def _detect_materializations(report: ProgramReport,
+                             categories: Dict[int, str],
+                             buffers: List[BufferLife], n: int, *,
+                             gather_frac: float, upcast_min_bytes: int,
+                             long_lived_min_bytes: int,
+                             long_lived_frac: float
+                             ) -> List[Materialization]:
+    mats: List[Materialization] = []
+    # KV gather-materialize: a gather result the size of a whole pool —
+    # the decode path is reading the paged cache by materializing it
+    kv_max = 0
+    for i, (dt, sh) in enumerate(report.inputs):
+        if categories.get(i) in _KV_CATEGORIES:
+            kv_max = max(kv_max, tensor_bytes(dt, sh))
+    if kv_max:
+        for o in report.ops:
+            if o.name not in ("gather", "dynamic_gather"):
+                continue
+            rb = tensor_bytes(o.dtype, o.shape)
+            if rb >= gather_frac * kv_max:
+                mats.append(Materialization(
+                    "kv_gather_materialize", rb, o.line,
+                    f"gather materializes {rb} B against a {kv_max} B "
+                    "KV pool (the XLA gather-materialize the Pallas "
+                    "decode kernel is meant to remove)"))
+    # f32 upcast of bf16-stored tensors: the storage dtype's memory win
+    # silently undone by a full-size convert copy
+    for o in report.ops:
+        if o.name != "convert" or o.dtype not in ("f32", "f64"):
+            continue
+        if "bf16" not in o.dtypes and "f16" not in o.dtypes:
+            continue
+        rb = tensor_bytes(o.dtype, o.shape)
+        if rb >= upcast_min_bytes:
+            src = "bf16" if "bf16" in o.dtypes else "f16"
+            mats.append(Materialization(
+                "f32_upcast", rb, o.line,
+                f"{src}-stored tensor upcast into a {rb} B {o.dtype} "
+                "copy"))
+    # remat-defeating long-lived temps: a big non-input buffer held
+    # across most of the program (forward→backward) — exactly what
+    # jax.checkpoint was supposed to drop
+    if n >= 16:
+        for b in buffers:
+            if b.bytes >= long_lived_min_bytes and \
+                    b.span >= long_lived_frac * n:
+                mats.append(Materialization(
+                    "long_lived_temp", b.bytes, b.line,
+                    f"%{b.vid} ({b.op}) holds {b.bytes} B across "
+                    f"{b.span}/{n} instructions — a remat-defeating "
+                    "live range"))
+    return sorted(mats, key=lambda m: (m.line, m.kind))
+
+
+def jax_expected_peak(ma) -> int:
+    """The resident-bytes figure ``Compiled.memory_analysis()`` implies:
+    arguments + outputs + temps − aliased (an aliased output reuses its
+    donated argument's buffer). This is what :func:`memory_report`'s
+    ``peak_bytes`` is validated against, within
+    :data:`VALIDATION_TOLERANCE`."""
+    return int(ma.argument_size_in_bytes + ma.output_size_in_bytes
+               + ma.temp_size_in_bytes - ma.alias_size_in_bytes)

@@ -791,7 +791,15 @@ class GenerationEngine:
         check. With ``bucket=`` the prefill program for that bucket length
         is audited instead (same donated cache). On a speculative engine,
         ``program="decode"`` audits the draft program (its decode-family
-        program) and ``program="verify"`` the verify pass."""
+        program) and ``program="verify"`` the verify pass.
+
+        ``audit(...).memory`` is the buffer-liveness residency estimate:
+        cache bytes appear under the ``kv_pages`` (paged) / ``kv_cache``
+        (dense) category, model weights under ``params``, and the
+        program's own temporaries under ``activations`` /
+        ``draft_temp`` / ``verify_temp`` — including the
+        ``kv_gather_materialize`` detector for the paged decode's XLA
+        gather of the pool (docs/ANALYSIS.md)."""
         from .. import analysis as _analysis
 
         params = self._params()
@@ -867,12 +875,31 @@ class GenerationEngine:
         # serving programs run mesh-less today, so the comm report is the
         # "no collectives crept into the decode path" check — any priced
         # collective here is a regression tools/shardcheck.py catches
-        comm = _analysis.comm_report(
-            compiled_rep if compiled_rep is not None else lowered_rep)
+        rep = compiled_rep if compiled_rep is not None else lowered_rep
+        comm = _analysis.comm_report(rep)
+        # residency estimate with serving categories: the donated cache
+        # carry is "kv_pages" (page table + pools) in paged mode and
+        # "kv_cache" (per-layer K/V buffers) in dense mode, so genbench's
+        # "equal cache memory" claim reads auditor-attributed bytes; the
+        # draft/verify programs tag their temporaries distinctly
+        kv_cat = "kv_pages" if self.paged else "kv_cache"
+        mem_cats = {i: "params" for i in range(n_pre)}
+        mem_cats.update({i: kv_cat
+                         for i in range(n_pre, n_pre + n_carry)})
+        for i in range(n_pre + n_carry, len(rep.inputs)):
+            mem_cats[i] = "io"
+        if program == "verify":
+            default_cat = "verify_temp"
+        elif self.speculative and bucket is None:
+            default_cat = "draft_temp"
+        else:
+            default_cat = "activations"
+        memory = _analysis.memory_report(rep, categories=mem_cats,
+                                         default_category=default_cat)
         return _analysis.ProgramAudit(
             lowered=lowered_rep, compiled=compiled_rep,
             carry_indices=tuple(range(n_pre, n_pre + n_carry)),
-            comm=comm)
+            comm=comm, memory=memory)
 
     def release_slot(self, slot: int) -> None:
         """Mark a row free (emits pad, frontier frozen) — the next prefill

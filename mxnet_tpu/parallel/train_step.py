@@ -1107,7 +1107,15 @@ class TrainStep:
         compiled replicated`` — and ``audit.comm`` prices every
         collective into a :class:`~mxnet_tpu.analysis.CommReport`
         (per-axis logical bytes, accidental-reshard flags; the intended
-        ZeRO compute gathers are exempt)."""
+        ZeRO compute gathers are exempt).
+
+        ``audit.memory`` is the buffer-liveness residency estimate
+        (:class:`~mxnet_tpu.analysis.MemoryReport`): peak bytes with the
+        donated carry counted once, a residency timeline, and category
+        attribution — ``params`` / ``opt_state`` leaves of the carry,
+        ``batch`` for the data inputs, everything the program
+        materializes under ``activations`` (``make memcheck`` gates
+        these per program family)."""
         from .. import analysis as _analysis
 
         if window:
@@ -1117,11 +1125,23 @@ class TrainStep:
             lowered = self.lower_hlo(*batch)
         # flat arg order is tree_flatten order: params dict leaves first,
         # then opt-state leaves — exactly the donated (0, 1) argnums
+        n_params = len(jax.tree_util.tree_leaves(self.params))
         n_carry = len(jax.tree_util.tree_leaves((self.params,
                                                  self.opt_state)))
         lowered_rep = _analysis.audit_lowered(lowered)
         compiled_rep = (_analysis.audit_compiled(lowered.compile())
                         if compile else None)
+        # memory truth follows the same precedence as donation: the
+        # compiled executable (scheduled, fused) when available
+        mem_rep = compiled_rep if compiled_rep is not None else lowered_rep
+        mem_cats = {i: ("params" if i < n_params else "opt_state")
+                    for i in range(n_carry)}
+        # past the carry: step count, optional amp carry, then the batch
+        # arrays, key and scalar hyperparams — everything array-shaped
+        # there is batch data, the scalars are noise either way
+        for i in range(n_carry, len(mem_rep.inputs)):
+            mem_cats[i] = "batch"
+        memory = _analysis.memory_report(mem_rep, categories=mem_cats)
         contract: list = []
         comm = None
         if self.mesh is not None:
@@ -1148,4 +1168,4 @@ class TrainStep:
         return _analysis.ProgramAudit(
             lowered=lowered_rep, compiled=compiled_rep,
             carry_indices=tuple(range(n_carry)),
-            contract=contract, comm=comm)
+            contract=contract, comm=comm, memory=memory)

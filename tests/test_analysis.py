@@ -889,6 +889,69 @@ def test_lint_unknown_mesh_axis_jh006():
     assert astlint._MESH_AXES == frozenset(AXES)
 
 
+def test_lint_traced_constant_capture_jh007():
+    """ISSUE 12 satellite: a jitted/scanned closure reading a name bound
+    to a host np.ndarray (module global or enclosing-function local) —
+    the trace bakes it into the program as a constant. Shadowing and
+    inline suppression are respected."""
+    src = textwrap.dedent("""\
+        import jax
+        import numpy as np
+
+        TABLE = np.arange(1000).reshape(10, 100)
+
+        def build():
+            scale = np.ones((64,))
+            def step(x):
+                return x @ TABLE + scale      # JH007 x2
+            return jax.jit(step)
+
+        def cold(x):
+            return x @ TABLE                  # ok: not a hot path
+
+        def shadowed():
+            def step(x, TABLE):
+                return x @ TABLE              # ok: parameter shadows
+            return jax.jit(step)
+        """)
+    vs = astlint.lint_source(src, "mxnet_tpu/x.py")
+    assert _rules(vs) == ["JH007", "JH007"]
+    assert {"TABLE", "scale"} == {v.message.split("'")[1] for v in vs}
+    sup = src.replace("return x @ TABLE + scale",
+                      "return x @ TABLE + scale  # lint: disable=JH007")
+    assert astlint.lint_source(sup, "mxnet_tpu/x.py") == []
+    # jnp arrays are device values, not baked host constants
+    ok = textwrap.dedent("""\
+        import jax
+        import jax.numpy as jnp
+
+        TABLE = jnp.arange(1000)
+
+        def f(x):
+            return x + TABLE
+        g = jax.jit(f)
+        """)
+    assert astlint.lint_source(ok, "mxnet_tpu/x.py") == []
+    # the build-then-transfer idiom: a later rebinding to a non-host
+    # expression clears the hazard (module level AND function level)
+    rebound = textwrap.dedent("""\
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        X = np.arange(100000)
+        X = jnp.asarray(X)
+
+        def build():
+            y = np.ones((64,))
+            y = jnp.asarray(y)
+            def step(v):
+                return v + X + y
+            return jax.jit(step)
+        """)
+    assert astlint.lint_source(rebound, "mxnet_tpu/x.py") == []
+
+
 def test_lint_changed_diffs_merge_base(tmp_path):
     """ISSUE 8 satellite: --changed diffs against the merge-base of main,
     so a pre-commit run late in a branch still sees the files committed

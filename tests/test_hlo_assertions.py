@@ -203,25 +203,30 @@ def test_fp16_loss_scaling_fully_in_graph():
 
 
 def test_remat_cuts_peak_temp_bytes_on_long_context_step():
-    """ISSUE 5 acceptance: ``hybridize(remat=...)`` on the GPT-2 block
-    stack reduces ``compiled.memory_analysis()`` peak temp-buffer bytes by
-    >= 30% on a long-context (T=1024) LM train step — the deliberate
-    flops-for-memory trade, measured structurally so it runs on CPU CI."""
+    """ISSUE 5 acceptance, re-expressed in ISSUE 12's units:
+    ``hybridize(remat=...)`` on the GPT-2 block stack cuts the
+    buffer-liveness ``MemoryReport.temp_peak_bytes`` of the long-context
+    (T=1024) LM train step by >= 25% — the same auditor units ``make
+    memcheck`` gates (measured ~31% in these units; the historical
+    ``memory_analysis()`` figure was 40.8%, the difference being the
+    liveness estimator's conservatism on the un-remat'd baseline —
+    see docs/ANALYSIS.md "Memory")."""
     from test_amp_policy import _tiny_gpt2_step
 
     def temp_bytes(remat):
         ts, batch = _tiny_gpt2_step(remat=remat, num_layers=3, units=64,
                                     num_heads=2, max_length=1024,
                                     vocab_size=128, batch=1, seq=1024)
-        return ts.lower_hlo(*batch).compile().memory_analysis() \
-            .temp_size_in_bytes
+        mem = ts.audit(*batch).memory
+        assert mem is not None and mem.dialect == "hlo"
+        return mem.temp_peak_bytes
 
     plain = temp_bytes(False)
     remat = temp_bytes(True)
     assert plain > 0
     saved = 1.0 - remat / plain
-    assert saved >= 0.30, (
-        f"remat saved only {saved:.1%} of peak temp bytes "
+    assert saved >= 0.25, (
+        f"remat saved only {saved:.1%} of liveness temp-peak bytes "
         f"({plain} -> {remat})")
 
 

@@ -1,0 +1,115 @@
+"""Golden-program memory gate (ISSUE 12, docs/ANALYSIS.md "Memory"):
+`make memcheck` as a test — the committed mem_* goldens match the current
+programs, an injected >5% peak regression fails the build, the known
+paged-decode gather-materialize class is pinned (not failing), and the
+--update-golden rebless workflow round-trips.
+
+Runs tools/memcheck.py in-process (importlib) so each case can pick one
+cheap program family and capture the JSON verdict without a subprocess
+per family.
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def memcheck():
+    spec = importlib.util.spec_from_file_location(
+        "memcheck_mod", os.path.join(REPO, "tools", "memcheck.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _verdict(capsys):
+    out = capsys.readouterr().out
+    row, _ = json.JSONDecoder().raw_decode(out, out.index("{"))
+    return row, out
+
+
+def test_gate_matches_committed_goldens(memcheck, capsys):
+    """ISSUE 12 acceptance: the committed goldens describe the current
+    programs — peak residency within tolerance, donation intact, no new
+    materialization classes."""
+    rc = memcheck.main(["--family", "step_fsdp", "--skip-validate"])
+    row, _ = _verdict(capsys)
+    assert rc == 0 and row["ok"]
+    fam = row["families"]["step_fsdp"]
+    assert fam["carry_donation"] == 1.0
+    assert fam["peak_bytes"] > 0
+    assert fam["materializations"] == {}
+    # the fsdp step's carry categories are per-device shards
+    assert set(fam["by_category"]) >= {"params", "opt_state",
+                                       "activations", "batch"}
+
+
+def test_injected_peak_regression_fails_gate(memcheck, capsys):
+    """ISSUE 12 acceptance: a synthetic +20% peak (the --inject test
+    hook) must fail the build as a >5% residency regression."""
+    rc = memcheck.main(["--family", "step_dp8", "--inject-peak-regression",
+                        "--skip-validate"])
+    _, out = _verdict(capsys)
+    assert rc == 1
+    assert "peak residency regressed" in out
+
+
+def test_paged_gather_materialize_is_pinned_not_failing(memcheck, capsys):
+    """The paged decode's XLA gather-materialize of the pool (ROADMAP:
+    removed by the future Pallas decode kernel) is a KNOWN class recorded
+    in the golden — the gate passes while still pinning it, so a NEW
+    class elsewhere would fail."""
+    rc = memcheck.main(["--family", "decode_paged", "--skip-validate"])
+    row, _ = _verdict(capsys)
+    assert rc == 0 and row["ok"]
+    fam = row["families"]["decode_paged"]
+    assert fam["materializations"].get("kv_gather_materialize", 0) > 0
+    assert fam["by_category"]["kv_pages"] > 0
+    assert fam["carry_donation"] == 1.0
+
+
+def test_validation_cross_checks_memory_analysis(memcheck, capsys):
+    """The estimator self-check: liveness peak vs memory_analysis() on
+    the mesh-less step and decode programs, within the documented
+    tolerance, reported in the gate output."""
+    rc = memcheck.main(["--family", "decode"])
+    row, _ = _verdict(capsys)
+    assert rc == 0 and row["ok"]
+    progs = row["validation"]["programs"]
+    tol = row["validation"]["tolerance"]
+    assert set(progs) == {"step", "decode"}
+    for name, p in progs.items():
+        assert abs(p["rel_err"]) <= tol, (name, p)
+
+
+def test_inject_cannot_combine_with_update_golden(memcheck, capsys):
+    """The failure-path hook must never bless inflated peaks into the
+    committed goldens."""
+    with pytest.raises(SystemExit) as exc:
+        memcheck.main(["--update-golden", "--inject-peak-regression"])
+    assert exc.value.code == 2
+    assert "cannot be combined" in capsys.readouterr().err
+
+
+def test_update_golden_rebless_roundtrip(memcheck, capsys, monkeypatch,
+                                         tmp_path):
+    """--update-golden writes a fresh golden the plain gate then passes
+    against; with no golden at all the gate fails with the rebless
+    instruction instead of crashing."""
+    monkeypatch.setattr(memcheck, "GOLDEN_DIR", str(tmp_path))
+    rc = memcheck.main(["--family", "decode", "--skip-validate"])
+    _, out = _verdict(capsys)
+    assert rc == 1 and "no committed golden" in out
+    assert "--update-golden" in out
+    rc = memcheck.main(["--family", "decode", "--update-golden"])
+    assert rc == 0
+    golden = json.loads((tmp_path / "mem_decode.json").read_text())
+    assert golden["carry_donation"] == 1.0
+    assert golden["by_category"]["kv_cache"] > 0
+    rc = memcheck.main(["--family", "decode", "--skip-validate"])
+    row, _ = _verdict(capsys)
+    assert rc == 0 and row["ok"]

@@ -10,10 +10,12 @@ Three sections, all hardware-free (CPU CI):
            stay f32; lower the float16-policy step and assert the dynamic
            loss scaling is fully in-graph (f16 dots + is_finite + a
            conditional update, scale carry as program I/O — no host sync).
-  remat  — ``compiled.memory_analysis()`` peak temp-buffer bytes for the
-           long-context (T=1024) GPT-2 step, with and without
+  remat  — buffer-liveness temp-peak bytes (``TrainStep.audit().memory``,
+           the units ``make memcheck`` gates — docs/ANALYSIS.md "Memory")
+           for the long-context (T=1024) GPT-2 step, with and without
            ``hybridize(remat=True)``: the gate FAILS unless remat saves
-           >= --min-remat-saving (default 30%).
+           >= --min-remat-saving (default 25%; measured ~31% in these
+           units, 40.8% in the historical memory_analysis() units).
   timing — dispatch-isolated step-time A/B of the f32 vs bf16-policy step
            (device-resident batches, alternating pairs, median). Recorded,
            NOT gated: the CPU backend legalizes bf16 GEMMs back to f32 (and
@@ -128,24 +130,34 @@ def hlo_section(fails):
 
 
 def remat_section(args, fails):
-    """memory_analysis() temp-bytes delta on the long-context step."""
-    def temp_bytes(remat):
+    """Buffer-liveness temp-peak delta on the long-context step —
+    ``MemoryReport.temp_peak_bytes`` from ``TrainStep.audit()``, the same
+    auditor units ``make memcheck`` gates (ISSUE 12; the historical
+    ``memory_analysis()`` figure for this cut was 40.8%, re-measured as
+    ~31% in liveness units — the estimator is more conservative on the
+    un-remat'd baseline)."""
+    def mem_of(remat):
         ts, batch = build_step(seq=args.seq, layers=args.layers, units=64,
                                heads=2, vocab=128, batch=1, amp=None,
                                remat=remat)
-        return ts.lower_hlo(*batch).compile().memory_analysis() \
-            .temp_size_in_bytes
+        return ts.audit(*batch).memory
 
-    plain = temp_bytes(None)
-    remat = temp_bytes(True)
-    saved = 1.0 - remat / plain if plain else 0.0
+    plain = mem_of(None)
+    remat = mem_of(True)
+    saved = 1.0 - remat.temp_peak_bytes / plain.temp_peak_bytes \
+        if plain.temp_peak_bytes else 0.0
     out = {"seq": args.seq, "layers": args.layers,
-           "temp_bytes_plain": int(plain), "temp_bytes_remat": int(remat),
-           "remat_bytes_saved": int(plain - remat),
-           "remat_saving_frac": round(saved, 4)}
+           "temp_bytes_plain": int(plain.temp_peak_bytes),
+           "temp_bytes_remat": int(remat.temp_peak_bytes),
+           "peak_bytes_plain": int(plain.peak_bytes),
+           "peak_bytes_remat": int(remat.peak_bytes),
+           "remat_bytes_saved": int(plain.temp_peak_bytes
+                                    - remat.temp_peak_bytes),
+           "remat_saving_frac": round(saved, 4),
+           "units": "MemoryReport.temp_peak_bytes (liveness estimate)"}
     if saved < args.min_remat_saving:
-        fails.append(f"remat saved {saved:.1%} of peak temp bytes, gate "
-                     f"needs >= {args.min_remat_saving:.0%}")
+        fails.append(f"remat saved {saved:.1%} of liveness temp-peak "
+                     f"bytes, gate needs >= {args.min_remat_saving:.0%}")
     return out
 
 
@@ -191,7 +203,7 @@ def main():
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--pairs", type=int, default=5)
-    ap.add_argument("--min-remat-saving", type=float, default=0.30)
+    ap.add_argument("--min-remat-saving", type=float, default=0.25)
     args = ap.parse_args()
 
     import jax

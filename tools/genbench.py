@@ -14,7 +14,10 @@ streams required everywhere):
      cache's token capacity, slots oversubscribed), with bit-identical
      greedy tokens, >= --min-paged-speedup serving throughput at the high
      slot count, and bytes-of-cache-per-admitted-sequence down
-     accordingly.
+     accordingly. Cache bytes are read from the memory auditor's
+     category attribution (``engine.audit().memory.by_category`` —
+     docs/ANALYSIS.md "Memory"), cross-checked against the live buffers'
+     nbytes, so the equal-memory claim is auditor-verified.
   3. **speculative vs paged** — self-drafting (draft_net = the target,
      accept rate ~1.0) with k = --speculate-k: one compiled draft scan +
      one verify dispatch emit up to k+1 tokens/round. Gate: >=
@@ -195,8 +198,16 @@ def section_paged_vs_dense(args, fails):
     tps_d = statistics.median(p[0] for p in pairs)
     tps_p = statistics.median(p[1] for p in pairs)
     speedup = statistics.median(p[1] / p[0] for p in pairs)
-    dense_bytes = cache_bytes(dense.cache)
-    paged_bytes = cache_bytes(paged.pools)  # includes the trash page
+    # cache bytes come from the memory auditor's category attribution
+    # (docs/ANALYSIS.md "Memory"), not hand-rolled pool arithmetic — the
+    # "equal cache memory" gate below is auditor-verified; the raw nbytes
+    # sums stay as a cross-check that attribution covers the real buffers
+    dense_mem = dense.audit().memory
+    paged_mem = paged.audit().memory
+    dense_bytes = dense_mem.by_category.get("kv_cache", 0)
+    paged_bytes = paged_mem.by_category.get("kv_pages", 0)
+    dense_nbytes = cache_bytes(dense.cache)
+    paged_nbytes = cache_bytes(paged.pools) + paged.page_table.nbytes
     per_seq_d = dense_bytes / peak_d if peak_d else float("inf")
     per_seq_p = paged_bytes / peak_p if peak_p else float("inf")
     concurrency = peak_p / peak_d if peak_d else 0.0
@@ -209,6 +220,11 @@ def section_paged_vs_dense(args, fails):
         "gen_len": gen_len,
         "dense_cache_bytes": dense_bytes,
         "paged_cache_bytes": paged_bytes,
+        "cache_bytes_source": "MemoryReport.by_category (auditor)",
+        "dense_cache_nbytes": dense_nbytes,
+        "paged_cache_nbytes": paged_nbytes,
+        "paged_peak_bytes": paged_mem.peak_bytes,
+        "paged_materializations": paged_mem.materialization_kinds(),
         "peak_concurrent_dense": peak_d,
         "peak_concurrent_paged": peak_p,
         "concurrency_ratio": round(concurrency, 2),
@@ -229,6 +245,12 @@ def section_paged_vs_dense(args, fails):
         fails.append(f"paged_vs_dense: paged cache {paged_bytes}B not "
                      f"within 10% of dense {dense_bytes}B — the equal-"
                      "memory comparison is broken")
+    if abs(dense_bytes - dense_nbytes) > dense_nbytes * 0.02 or \
+            abs(paged_bytes - paged_nbytes) > paged_nbytes * 0.02:
+        fails.append(f"paged_vs_dense: auditor cache attribution "
+                     f"(dense {dense_bytes}B / paged {paged_bytes}B) "
+                     f"diverges from the live buffers' nbytes "
+                     f"({dense_nbytes}B / {paged_nbytes}B)")
     if concurrency < args.concurrency_factor:
         fails.append(f"paged_vs_dense: {peak_p} concurrent sequences vs "
                      f"dense {peak_d} = {concurrency:.1f}x, gate needs >= "
