@@ -19,11 +19,16 @@
 #             upcasts, remat-defeating live ranges), donation drops vs
 #             mxnet_tpu/analysis/goldens/mem_*.json, plus a
 #             memory_analysis() cross-validation of the estimator
+#   schedcheck - golden-program schedule gate (tools/schedcheck.py):
+#             critical-path latency regressions > 5%, overlap-fraction
+#             drops, newly exposed collectives and exposed-comm-byte
+#             regressions per mesh axis vs
+#             mxnet_tpu/analysis/goldens/sched_*.json
 #   native  - build libmxtpu.so (C++ runtime: recordio/jpeg/runtime/c_api)
 #   fast    - pytest without @slow (target < 10 min on 8 virtual CPU devs)
 #   slow    - the @slow remainder (model compiles, 4-process launches)
 #   ci      - sanity + lint + native + fast + audit + shardcheck +
-#             memcheck + chaos-elastic (the pre-merge gate;
+#             memcheck + schedcheck + chaos-elastic (the pre-merge gate;
 #             chaos-elastic is the slow 4-process kill-a-worker drill)
 #   test    - full suite (ci + slow), what the driver effectively runs
 
@@ -35,9 +40,9 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity lint audit shardcheck memcheck native fast slow test chaos chaos-elastic obs obsfleet perfwin genbench ampbench bench clean
+.PHONY: ci sanity lint audit shardcheck memcheck schedcheck native fast slow test chaos chaos-elastic obs obsfleet perfwin genbench ampbench bench clean
 
-ci: sanity lint native fast audit shardcheck memcheck chaos-elastic obsfleet
+ci: sanity lint native fast audit shardcheck memcheck schedcheck chaos-elastic obsfleet
 
 sanity:
 	$(PY) -m compileall -q mxnet_tpu tools tests examples bench.py __graft_entry__.py
@@ -72,6 +77,15 @@ shardcheck:
 # --update-golden`
 memcheck:
 	$(PY) tools/memcheck.py
+
+# golden-program schedule gate (docs/ANALYSIS.md "Schedule & overlap"):
+# runs the static critical-path + overlap model over the same program
+# families and diffs critical-path latency, overlap fraction, the
+# exposed-collective census and exposed comm bytes per mesh axis against
+# the committed sched_*.json goldens. Rebless intentional changes with
+# `python tools/schedcheck.py --update-golden`
+schedcheck:
+	$(PY) tools/schedcheck.py
 
 native:
 	$(MAKE) -C native

@@ -1,6 +1,8 @@
 """Static-analysis subsystem (docs/ANALYSIS.md).
 
-Two passes over two different artifacts:
+Passes over two different artifacts — program text (the HLO auditor and
+the comm/memory/schedule models layered on its tables) and Python source
+(the AST linter):
 
   - :mod:`~mxnet_tpu.analysis.hlo_audit` — structural analysis of the
     *programs* XLA lowers/compiles: op/dtype census, dot-precision
@@ -40,6 +42,12 @@ from .memory import (  # noqa: F401
     jax_expected_peak,
     memory_report,
 )
+from .schedule import (  # noqa: F401
+    CollectiveSpan,
+    ScheduleReport,
+    SerializationPoint,
+    schedule_report,
+)
 from .comm import (  # noqa: F401
     CollectiveCost,
     CommReport,
@@ -68,6 +76,8 @@ __all__ = [
     "ShardingInfo", "parse_sharding", "ValueDef",
     "MemoryReport", "BufferLife", "Materialization", "memory_report",
     "jax_expected_peak", "VALIDATION_TOLERANCE",
+    "ScheduleReport", "CollectiveSpan", "SerializationPoint",
+    "schedule_report",
     "CollectiveCost", "CommReport", "Reshard", "comm_report",
     "detect_accidental_reshards",
     "ContractViolation", "check_contract", "expected_tiles",

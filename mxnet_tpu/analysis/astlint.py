@@ -29,6 +29,13 @@ Rules (docs/ANALYSIS.md has the full catalog with examples):
                                 large literal) — traced into the program
                                 as a baked constant: silent resident
                                 bytes and a recompile when it changes.
+  JH008 sync-per-dispatch       a driver loop calling a jitted/compiled
+                                callable and immediately materializing
+                                its result (``block_until_ready``,
+                                ``.item()``, ``float()``, ``np.asarray``,
+                                ``device_get``) inside the loop body —
+                                the host blocks on every step, so async
+                                dispatch pipelining is defeated.
   JH006 unknown-mesh-axis       a ``PartitionSpec``/``P``/``named_sharding``
                                 call site passing an axis-name string
                                 literal outside the MeshConfig vocabulary
@@ -80,6 +87,12 @@ RULES: Dict[str, str] = {
              "over a host np.ndarray or large Python literal — it is "
              "baked into the program as a constant (silent resident "
              "bytes, and any change recompiles); pass it as an argument",
+    "JH008": "sync-per-dispatch: a driver loop calls a jitted/compiled "
+             "callable and immediately materializes its result "
+             "(block_until_ready/.item()/float()/np.asarray/device_get) "
+             "in the same loop body — the host blocks on every step and "
+             "async dispatch pipelining is defeated; keep results as "
+             "device futures and materialize once after the loop",
 }
 
 #: the MeshConfig axis vocabulary (mirror of parallel.mesh.AXES — kept
@@ -143,6 +156,13 @@ _LARGE_LITERAL_ELEMS = 32
 
 # JH001: attribute calls that synchronize/copy to host
 _SYNC_ATTRS = frozenset({"item", "asnumpy", "tolist", "__array__"})
+# JH008: jit-wrapper leaves whose call result is a compiled dispatchable
+# (vmap/grad et al. stay out: calling them returns a transform, and the
+# hazard is the per-step dispatch of a COMPILED callable)
+_DISPATCH_WRAPPERS = frozenset({"jit", "pjit", "pmap"})
+# JH008: attribute calls that force the dispatched result on host (the
+# sync attrs plus jax's explicit blocking call)
+_JH008_SYNC_ATTRS = _SYNC_ATTRS | {"block_until_ready"}
 # JH001: numpy namespace calls that materialize on host
 _NP_HOST_FNS = frozenset({"asarray", "array", "asnumpy", "ascontiguousarray"})
 _BUILTIN_SYNCS = frozenset({"float", "int", "bool"})
@@ -350,6 +370,11 @@ class _Linter(ast.NodeVisitor):
         self._fn_host_consts: List[Set[str]] = []
         self._jh007_candidates: List[Set[str]] = []
         self._jh007_reported: Set[Tuple[int, str]] = set()
+        # JH008: names bound to a compiled dispatchable (jax.jit(...)
+        # assignment targets, file-scoped heuristic) and, per enclosing
+        # driver loop, the names holding a dispatch's device result
+        self._compiled_names: Set[str] = set()
+        self._loop_results: List[Set[str]] = []
 
     # -- context helpers ---------------------------------------------------
     @property
@@ -501,6 +526,8 @@ class _Linter(ast.NodeVisitor):
         # assignment RHS (`h = _REG.setdefault(k, [])`), return value —
         # the mutation happens regardless of what the result feeds
         self._visit_mutating_call(node)
+        # JH008: a materializer on a dispatch result inside a driver loop
+        self._check_jh008(node, dotted, leaf)
         # JH006: axis-name literals at PartitionSpec construction sites
         if leaf in _SPEC_CALLS:
             args = node.args
@@ -598,7 +625,72 @@ class _Linter(ast.NodeVisitor):
                 self.report("JH002", node,
                             f"Python `while` on traced argument {name!r} "
                             "(use lax.while_loop)")
+        self._loop_results.append(set())
         self.generic_visit(node)
+        self._loop_results.pop()
+
+    def visit_For(self, node):
+        self._loop_results.append(set())
+        self.generic_visit(node)
+        self._loop_results.pop()
+
+    visit_AsyncFor = visit_For
+
+    # -- JH008: sync-per-dispatch driver loops -------------------------------
+    def _is_compiled_callee(self, func_expr: ast.AST) -> bool:
+        """Does this call expression dispatch a compiled program? A name/
+        attribute assigned from ``jax.jit(...)`` (tracked file-wide), a
+        leaf name containing ``jit`` (the ``self._decode_jit`` naming
+        convention), or a direct ``jax.jit(f)(x)`` immediate call."""
+        if isinstance(func_expr, ast.Call):
+            inner = _dotted(func_expr.func).rsplit(".", 1)[-1]
+            return inner in _DISPATCH_WRAPPERS
+        leaf = _dotted(func_expr).rsplit(".", 1)[-1]
+        if not leaf:
+            return False
+        return "jit" in leaf or leaf in self._compiled_names
+
+    def _expr_is_dispatch(self, expr: ast.AST) -> bool:
+        """Is ``expr`` (the materializer's operand) a compiled dispatch's
+        result — a tracked result name from an enclosing loop, or the
+        dispatch call itself (``float(step(x))``)?"""
+        if isinstance(expr, ast.Call) and self._is_compiled_callee(expr.func):
+            return True
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and any(n.id in frame for frame in self._loop_results):
+                return True
+        return False
+
+    def _check_jh008(self, node: ast.Call, dotted: str, leaf: str):
+        """Materializer applied to a dispatch result inside a driver
+        loop: the host blocks on every step — async dispatch pipelining
+        (the whole point of the compiled step/decode programs) is gone."""
+        if not self._loop_results or self.in_hot:
+            return
+        hit = None
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _JH008_SYNC_ATTRS:
+            if self._expr_is_dispatch(node.func.value):
+                hit = f".{node.func.attr}()"
+        elif dotted in ("jax.device_get", "device_get") and node.args and \
+                self._expr_is_dispatch(node.args[0]):
+            hit = "jax.device_get"
+        elif isinstance(node.func, ast.Name) and \
+                node.func.id in _BUILTIN_SYNCS and node.args and \
+                self._expr_is_dispatch(node.args[0]):
+            hit = f"{node.func.id}()"
+        elif dotted.startswith(("np.", "numpy.")) and \
+                leaf in _NP_HOST_FNS and node.args and \
+                self._expr_is_dispatch(node.args[0]):
+            hit = dotted
+        if hit:
+            self.report(
+                "JH008", node,
+                f"{hit} materializes a compiled dispatch's result inside "
+                "the driver loop — the host blocks every iteration, "
+                "defeating async dispatch pipelining; keep the device "
+                "future and materialize once after the loop")
 
     # -- JH005: global registry mutation -------------------------------------
     def visit_With(self, node):
@@ -620,6 +712,25 @@ class _Linter(ast.NodeVisitor):
         return None
 
     def visit_Assign(self, node):
+        # JH008 bookkeeping: `fn = jax.jit(...)` / `self._x_jit =
+        # jax.jit(...)` marks a compiled dispatchable; inside a driver
+        # loop, a call to one marks its result names as device futures
+        if isinstance(node.value, ast.Call):
+            vleaf = _dotted(node.value.func).rsplit(".", 1)[-1]
+            if vleaf in _DISPATCH_WRAPPERS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self._compiled_names.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        self._compiled_names.add(t.attr)
+            elif self._loop_results and not self.in_hot and \
+                    self._is_compiled_callee(node.value.func):
+                for t in node.targets:
+                    elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                        else [t]
+                    for e in elts:
+                        if isinstance(e, ast.Name):
+                            self._loop_results[-1].add(e.id)
         # JH007 bookkeeping: a host-array binding in THIS function is a
         # capture candidate for any closure defined after it; rebinding
         # the name to a non-host expression clears it again

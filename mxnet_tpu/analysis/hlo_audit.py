@@ -991,6 +991,9 @@ class ProgramAudit:
     # buffer-liveness residency estimate (analysis.memory.MemoryReport):
     # peak bytes, timeline, category attribution, materializations
     memory: Optional[object] = None
+    # static schedule model (analysis.schedule.ScheduleReport): critical
+    # path, exposed vs hidden collective time, overlap fraction, MFU bound
+    schedule: Optional[object] = None
 
     def carry_donation(self) -> float:
         """Donation coverage of the carry (params/opt-state for TrainStep,
@@ -1015,6 +1018,8 @@ class ProgramAudit:
             out["comm"] = self.comm.summary()
         if self.memory is not None:
             out["memory"] = self.memory.summary()
+        if self.schedule is not None:
+            out["schedule"] = self.schedule.summary()
         return out
 
 

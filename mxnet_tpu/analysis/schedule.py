@@ -1,0 +1,546 @@
+"""Static schedule analysis: critical-path latency + compute/communication
+overlap (docs/ANALYSIS.md "Schedule & overlap").
+
+The analysis subsystem prices bytes (:mod:`.comm`) and peak memory
+(:mod:`.memory`) but was blind to *time*: it could not say whether a
+collective sits exposed on the critical path or hides behind compute.
+This module closes that gap with a dependency-DAG scheduler over the
+same :class:`~mxnet_tpu.analysis.hlo_audit.ValueDef` def/use tables the
+liveness pass sweeps (both dialects; ``while``/scan subcomputations
+recursed; fusion priced as one node — the materialization-boundary cost
+unit of arXiv:2301.13062).
+
+Every node gets a **roofline** duration:
+
+  - *compute* ops: ``max(flops / peak_flops, hbm_bytes / hbm_bw)`` —
+    FLOPs from the dot census (:func:`~mxnet_tpu.observability.goodput.
+    op_flops`, fusion bodies summed recursively), HBM traffic as the
+    node's operand + result bytes (fused intermediates are registers and
+    move nothing);
+  - *collectives*: logical comm bytes (:mod:`.comm`'s per-kind pricing,
+    the 2x all-reduce factor included — the ring time ``2S/B``) over the
+    configured per-axis link speed: ``ici_gbps`` by default, ``dcn_gbps``
+    for collectives spanning an axis named in ``dcn_axes``;
+  - structural ops (tuple/gte/bitcast/parameter/constant/...) are free.
+
+Two complementary results:
+
+  - **critical path** — the DAG longest path (``finish(v) = max(finish
+    of deps) + dur(v)``). An async collective contributes its time on
+    the start→done *edge*, so independent compute accumulates in
+    parallel — overlap falls out of the dependency structure. The
+    reported ``critical_path_seconds`` lower bound is
+    ``max(dag critical path, serial compute + exposed comm)``: one
+    device serializes its compute, and only communication overlaps it.
+  - **exposed vs hidden** per collective — the compiled dialect's text
+    is scheduled (``is_scheduled=true``), so whatever the scheduler
+    placed between an async start and its done is by construction
+    independent of the result: that compute *hides* the collective, up
+    to the collective's own duration. Each compute node's duration can
+    hide at most one collective (overlapping in-flight spans share,
+    never double-count). A sync collective hides nothing — fully
+    exposed. ``hidden + exposed == total`` per span by construction.
+
+From those: ``overlap_fraction`` (hidden / total comm time), per-axis
+exposed/hidden rollups, the top **serialization points** (zero-slack
+critical-path nodes ranked by duration — removal shortens the path by at
+most that duration), and a **static MFU upper bound**
+``flops_total / (peak_flops x critical_path_seconds)`` — ≤ 1 by
+construction since the bound is at least the serial compute time.
+
+The model constants are deliberately simple, documented, and
+env-tunable (``MXNET_TPU_SCHED_*``; defaults sized to one TPU v5e chip).
+Absolute seconds are a *model*, not a measurement — the value is in the
+ratios (overlap fraction, exposed share, MFU bound) and in diffing the
+same program against itself over time, which is exactly what
+``tools/schedcheck.py`` gates. A ``lax.scan``/``while`` body appears
+once in the text and is costed once: the report is a static
+per-dispatch census, like the comm and memory passes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter as _Counter
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .comm import comm_report
+from .hlo_audit import (COLLECTIVE_OPS, DOT_OPS, ProgramReport, ValueDef,
+                        _ASYNC_DONE)
+from .memory import ZERO_COST_OPS
+
+__all__ = ["CollectiveSpan", "SerializationPoint", "ScheduleReport",
+           "schedule_report", "DEFAULT_PEAK_FLOPS", "DEFAULT_HBM_GBPS",
+           "DEFAULT_ICI_GBPS", "DEFAULT_DCN_GBPS"]
+
+#: default model constants — one TPU v5e chip (bf16 peak, HBM2 bandwidth)
+#: and one ICI link / a DCN NIC share. Overridable per call and via the
+#: ``sched_*`` config knobs (``MXNET_TPU_SCHED_*`` env).
+DEFAULT_PEAK_FLOPS = 1.97e14
+DEFAULT_HBM_GBPS = 819.0
+DEFAULT_ICI_GBPS = 90.0
+DEFAULT_DCN_GBPS = 25.0
+
+#: a span counts as "exposed" when more than this fraction of its time
+#: could not be hidden (jitter guard for the golden gate's census)
+EXPOSED_FRAC_EPS = 0.01
+
+# ops that take no schedule time at all (aliases/bookkeeping): the
+# liveness pass's zero-cost set plus values that materialize without
+# touching the compute units in any modeled way
+_FREE_OPS = ZERO_COST_OPS | {"constant", "call", "custom_call_done"}
+
+# control-flow ops whose callees' schedules fold in at the call node
+# (fusion is NOT here — it is priced as one roofline node; its body
+# moves no HBM bytes)
+_RECURSE_OPS = frozenset({"while", "conditional", "case", "call"})
+
+
+@dataclasses.dataclass
+class CollectiveSpan:
+    """One priced collective with its overlap verdict: how much of its
+    time hides behind compute schedulable inside the start→done span
+    (async), and how much is exposed on the timeline (all of it, for a
+    sync collective)."""
+
+    kind: str
+    line: int
+    axes: Tuple[str, ...]
+    bytes: int               # logical comm bytes (per-kind factor applied)
+    seconds: float           # bytes / link bandwidth
+    exposed_seconds: float
+    hidden_seconds: float
+    is_async: bool
+    t_start: int             # node index of the start (== done for sync)
+    t_done: int
+
+    @property
+    def axis_key(self) -> str:
+        return "×".join(self.axes) if self.axes else "?"
+
+    @property
+    def is_exposed(self) -> bool:
+        """More than :data:`EXPOSED_FRAC_EPS` of this collective's time
+        is NOT hidden behind compute."""
+        return self.exposed_seconds > EXPOSED_FRAC_EPS * self.seconds \
+            and self.seconds > 0
+
+    def describe(self) -> str:
+        state = "sync" if not self.is_async else (
+            "exposed" if self.is_exposed else "hidden")
+        return (f"{self.kind}@L{self.line} [{self.axis_key}] "
+                f"{self.bytes} B {self.seconds:.3e}s ({state}, "
+                f"exposed {self.exposed_seconds:.3e}s)")
+
+
+@dataclasses.dataclass
+class SerializationPoint:
+    """One zero-slack node of the dependency DAG — every schedule must
+    run it end-to-end on the longest chain, so removing (or shrinking)
+    it shortens the critical path by up to ``seconds``."""
+
+    op: str
+    line: int
+    seconds: float
+    kind: str  # "compute" | "collective" | "subcomputation"
+
+    def describe(self) -> str:
+        return f"{self.op}@L{self.line}: {self.seconds:.3e}s ({self.kind})"
+
+
+@dataclasses.dataclass
+class _CompSched:
+    """Per-computation fold: internal critical path, serial compute time,
+    flops/hbm totals and the collective spans found inside."""
+
+    crit: float = 0.0
+    compute: float = 0.0
+    flops: float = 0.0
+    hbm_bytes: float = 0.0
+    spans: List[CollectiveSpan] = dataclasses.field(default_factory=list)
+    n_nodes: int = 0
+
+
+@dataclasses.dataclass
+class ScheduleReport:
+    """Static schedule model of one program (docs/ANALYSIS.md
+    "Schedule & overlap")."""
+
+    dialect: str
+    critical_path_seconds: float   # max(dag path, compute + exposed comm)
+    dag_critical_seconds: float    # dependency-only longest path
+    compute_seconds: float         # serial roofline compute time
+    comm_seconds: float            # total collective time
+    exposed_comm_seconds: float
+    hidden_comm_seconds: float
+    flops_total: float
+    hbm_bytes: float
+    spans: List[CollectiveSpan]
+    serialization_points: List[SerializationPoint]
+    mfu_bound: float               # static upper bound on achievable MFU
+    constants: Dict[str, float]    # the roofline constants used
+    n_nodes: int
+
+    @property
+    def overlap_fraction(self) -> float:
+        """Hidden / total collective time — 1.0 means every byte of
+        communication hides behind compute (a comm-free program counts
+        as fully hidden: nothing is exposed)."""
+        if self.comm_seconds <= 0:
+            return 1.0
+        return self.hidden_comm_seconds / self.comm_seconds
+
+    def by_axis(self) -> Dict[str, Dict[str, float]]:
+        """Per mesh-axis rollup: total/exposed/hidden seconds and
+        logical/exposed bytes (exposed bytes scale with the exposed time
+        share of each span)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for s in self.spans:
+            d = out.setdefault(s.axis_key, {
+                "seconds": 0.0, "exposed_seconds": 0.0,
+                "hidden_seconds": 0.0, "bytes": 0, "exposed_bytes": 0})
+            d["seconds"] += s.seconds
+            d["exposed_seconds"] += s.exposed_seconds
+            d["hidden_seconds"] += s.hidden_seconds
+            d["bytes"] += s.bytes
+            if s.seconds > 0:
+                d["exposed_bytes"] += int(
+                    round(s.bytes * s.exposed_seconds / s.seconds))
+        return out
+
+    def exposed_collectives(self) -> Dict[str, int]:
+        """Census of collectives with meaningful exposed time, by kind —
+        what the golden gate pins (a new entry = a collective fell off
+        the overlap path)."""
+        return dict(_Counter(s.kind for s in self.spans if s.is_exposed))
+
+    def exposed_spans(self) -> List[CollectiveSpan]:
+        return [s for s in self.spans if s.is_exposed]
+
+    def summary(self) -> dict:
+        """JSON-safe digest (what tools/schedcheck.py snapshots)."""
+        return {
+            "dialect": self.dialect,
+            "critical_path_seconds": self.critical_path_seconds,
+            "dag_critical_seconds": self.dag_critical_seconds,
+            "compute_seconds": self.compute_seconds,
+            "comm_seconds": self.comm_seconds,
+            "exposed_comm_seconds": self.exposed_comm_seconds,
+            "hidden_comm_seconds": self.hidden_comm_seconds,
+            "overlap_fraction": round(self.overlap_fraction, 6),
+            "by_axis": self.by_axis(),
+            "exposed_collectives": self.exposed_collectives(),
+            "serialization_points": [
+                [p.op, p.line, p.seconds, p.kind]
+                for p in self.serialization_points],
+            "flops_total": self.flops_total,
+            "hbm_bytes": self.hbm_bytes,
+            "mfu_bound": round(self.mfu_bound, 6),
+            "n_nodes": self.n_nodes,
+            "constants": dict(self.constants),
+        }
+
+
+def _knob(name: str, default: float) -> float:
+    from .. import config as _config
+
+    try:
+        v = float(_config.get(name))
+    except (KeyError, TypeError, ValueError):
+        return default
+    return v if v > 0 else default
+
+
+def _resolve_constants(peak_flops, hbm_gbps, ici_gbps, dcn_gbps, dcn_axes):
+    """(peak, hbm_Bps, ici_Bps, dcn_Bps, dcn_axes) from explicit args >
+    ``sched_*`` config knobs > module defaults. ``sched_peak_flops``
+    falls back to the fleet ``peak_flops`` knob before the v5e default,
+    so the MFU bound and ``train_mfu`` share one denominator when the
+    operator configured it."""
+    from .. import config as _config
+
+    if peak_flops is None:
+        peak_flops = _knob("sched_peak_flops",
+                           _knob("peak_flops", DEFAULT_PEAK_FLOPS))
+    hbm = (hbm_gbps if hbm_gbps is not None
+           else _knob("sched_hbm_gbps", DEFAULT_HBM_GBPS)) * 1e9
+    ici = (ici_gbps if ici_gbps is not None
+           else _knob("sched_ici_gbps", DEFAULT_ICI_GBPS)) * 1e9
+    dcn = (dcn_gbps if dcn_gbps is not None
+           else _knob("sched_dcn_gbps", DEFAULT_DCN_GBPS)) * 1e9
+    if dcn_axes is None:
+        try:
+            raw = str(_config.get("sched_dcn_axes"))
+        except KeyError:
+            raw = ""
+        dcn_axes = tuple(a.strip() for a in raw.split(",") if a.strip())
+    return float(peak_flops), hbm, ici, dcn, tuple(dcn_axes)
+
+
+def _dot_flops(op) -> float:
+    from ..observability.goodput import op_flops
+
+    f = op_flops(op)
+    return float(f) if f else 0.0
+
+
+class _Scheduler:
+    """One program's schedule model: shared per-line op/collective joins
+    and memoized per-computation folds."""
+
+    def __init__(self, report: ProgramReport, mesh, peak, hbm, ici, dcn,
+                 dcn_axes, comm=None):
+        self.report = report
+        self.peak = peak
+        self.hbm = hbm
+        self.ici = ici
+        self.dcn = dcn
+        self.dcn_axes = frozenset(dcn_axes)
+        # per-line joins: ops/collectives are a global census over every
+        # computation in the text, ValueDefs are per-computation — the
+        # source line is the shared key. A caller that already priced
+        # the collectives (the audit entry points build a CommReport
+        # over the same report) hands it in instead of re-pricing.
+        self.op_at = {o.line: o for o in report.ops}
+        if comm is None:
+            comm = comm_report(report, mesh)
+        self.cost_at = {c.line: c for c in comm.costs}
+        self.memo: Dict[str, _CompSched] = {}
+        self.fusion_memo: Dict[str, float] = {}
+
+    # -- fusion pricing ------------------------------------------------------
+    def _fusion_flops(self, name: str, visiting: frozenset) -> float:
+        """Dot FLOPs inside one fusion body (nested callees included) —
+        the fusion node's compute side; its intermediates move no HBM."""
+        if name in self.fusion_memo:
+            return self.fusion_memo[name]
+        values = self.report.subcomputations.get(name)
+        if values is None or name in visiting:
+            return 0.0
+        visiting = visiting | {name}
+        total = 0.0
+        for v in values:
+            op = self.op_at.get(v.line)
+            if op is not None and op.name in DOT_OPS:
+                total += _dot_flops(op)
+            for c in v.callees:
+                total += self._fusion_flops(c, visiting)
+        self.fusion_memo[name] = total
+        return total
+
+    def _link_bw(self, axes: Tuple[str, ...]) -> float:
+        return self.dcn if any(a in self.dcn_axes for a in axes) else self.ici
+
+    # -- the per-computation fold --------------------------------------------
+    def analyze(self, values: Sequence[ValueDef],
+                visiting: frozenset = frozenset(),
+                collect_points: bool = False):
+        """Fold one computation's ValueDef list into a :class:`_CompSched`
+        (and, for the entry computation, the per-node duration/dependency
+        arrays the serialization-point pass needs)."""
+        comp = _CompSched()
+        n = len(values)
+        comp.n_nodes = n
+        dur = [0.0] * n           # DAG duration per node
+        kind = [""] * n           # for serialization-point labels
+        cur: Dict[str, int] = {}  # vid -> defining node index
+        coll_at_t: Dict[int, Tuple[float, object]] = {}  # start t -> (s, cost)
+        done_of: Dict[int, int] = {}                     # start t -> done t
+        compute_nodes: List[int] = []   # indices with hideable compute time
+
+        # pass 1: per-node durations + async span endpoints
+        for t, v in enumerate(values):
+            if v.vid:
+                cur[v.vid] = t
+            if v.op in _ASYNC_DONE:
+                # find the start among the uses; its collective time lands
+                # on this edge (start -> done) so independent compute can
+                # proceed in parallel in the DAG
+                for u in v.uses:
+                    s = cur.get(u)
+                    if s is not None and s in coll_at_t:
+                        done_of[s] = t
+                        dur[t] = coll_at_t[s][0]
+                        kind[t] = "collective"
+                        break
+                continue
+            if v.param is not None or v.op in _FREE_OPS and not v.callees:
+                continue
+            cost = self.cost_at.get(v.line)
+            if cost is not None and v.op in COLLECTIVE_OPS:
+                secs = cost.bytes / self._link_bw(cost.axes) \
+                    if cost.bytes else 0.0
+                coll_at_t[t] = (secs, cost)
+                kind[t] = "collective"
+                # sync for now; pass-1 completion may rebind via done_of
+                dur[t] = secs
+                continue
+            if v.callees and v.op in _RECURSE_OPS:
+                # a while/conditional/call node runs its (largest) callee
+                # end-to-end: the callee's own schedule folds in here
+                best = _CompSched()
+                for c in v.callees:
+                    sub = self._callee(c, visiting)
+                    if sub.crit >= best.crit:
+                        best = sub
+                dur[t] = best.crit
+                kind[t] = "subcomputation"
+                comp.compute += best.compute
+                comp.flops += best.flops
+                comp.hbm_bytes += best.hbm_bytes
+                comp.spans.extend(best.spans)
+                comp.n_nodes += best.n_nodes
+                continue
+            # roofline compute node: flops vs HBM bytes. A fusion's flops
+            # are its body's dots; its HBM traffic its own operands +
+            # results (body intermediates are registers)
+            flops = 0.0
+            if v.op == "fusion":
+                flops = sum(self._fusion_flops(c, visiting)
+                            for c in v.callees)
+            else:
+                op = self.op_at.get(v.line)
+                if op is not None and op.name in DOT_OPS:
+                    flops = _dot_flops(op)
+            hbm_bytes = v.bytes + sum(
+                values[cur[u]].bytes for u in v.uses if u in cur)
+            secs = max(flops / self.peak if self.peak else 0.0,
+                       hbm_bytes / self.hbm if self.hbm else 0.0)
+            dur[t] = secs
+            kind[t] = "compute"
+            comp.compute += secs
+            comp.flops += flops
+            comp.hbm_bytes += hbm_bytes
+            if secs > 0:
+                compute_nodes.append(t)
+
+        # async rebind: a start with a matching done has zero duration
+        # itself — its time rides the start->done edge (set in pass 1)
+        for s in done_of:
+            dur[s] = 0.0
+
+        # pass 2: exposed vs hidden. The compiled text is scheduled, so
+        # compute between start and done is schedulable under the span;
+        # each compute node's time hides at most one collective (shared
+        # windows drain a per-node budget, never double-hide)
+        remaining = {t: dur[t] for t in compute_nodes}
+        spans: List[CollectiveSpan] = []
+        for s, (secs, cost) in sorted(coll_at_t.items()):
+            d = done_of.get(s)
+            if d is None:
+                spans.append(CollectiveSpan(
+                    kind=cost.kind, line=cost.line, axes=cost.axes,
+                    bytes=cost.bytes, seconds=secs, exposed_seconds=secs,
+                    hidden_seconds=0.0, is_async=False, t_start=s,
+                    t_done=s))
+                continue
+            hidden = 0.0
+            for t in compute_nodes:
+                if t <= s:
+                    continue
+                if t >= d:
+                    break
+                take = min(remaining[t], secs - hidden)
+                if take > 0:
+                    remaining[t] -= take
+                    hidden += take
+                if hidden >= secs:
+                    break
+            spans.append(CollectiveSpan(
+                kind=cost.kind, line=cost.line, axes=cost.axes,
+                bytes=cost.bytes, seconds=secs,
+                exposed_seconds=max(0.0, secs - hidden),
+                hidden_seconds=hidden, is_async=True, t_start=s, t_done=d))
+        comp.spans.extend(spans)
+
+        # pass 3: the dependency longest path (forward sweep in text
+        # order — defs precede uses in both dialects)
+        cur2: Dict[str, int] = {}
+        est = [0.0] * n
+        finish = [0.0] * n
+        consumers: Dict[int, List[int]] = {}
+        for t, v in enumerate(values):
+            e = 0.0
+            for u in v.uses:
+                p = cur2.get(u)
+                if p is not None:
+                    e = max(e, finish[p])
+                    consumers.setdefault(p, []).append(t)
+            est[t] = e
+            finish[t] = e + dur[t]
+            if v.vid:
+                cur2[v.vid] = t
+        comp.crit = max(finish) if n else 0.0
+
+        if not collect_points:
+            return comp, None
+
+        # backward sweep: tail(t) = dur(t) + longest downstream chain;
+        # zero-slack nodes (est + tail == crit) are the serialization
+        # points — removal shortens the path by at most dur(t)
+        tail = [0.0] * n
+        for t in range(n - 1, -1, -1):
+            down = max((tail[c] for c in consumers.get(t, ())), default=0.0)
+            tail[t] = dur[t] + down
+        eps = comp.crit * 1e-9
+        points = [
+            SerializationPoint(op=values[t].op, line=values[t].line,
+                               seconds=dur[t], kind=kind[t] or "compute")
+            for t in range(n)
+            if dur[t] > 0 and est[t] + tail[t] >= comp.crit - eps]
+        points.sort(key=lambda p: -p.seconds)
+        return comp, points
+
+    def _callee(self, name: str, visiting: frozenset) -> _CompSched:
+        if name in self.memo:
+            return self.memo[name]
+        values = self.report.subcomputations.get(name)
+        if values is None or name in visiting:
+            return _CompSched()
+        comp, _ = self.analyze(values, visiting | {name})
+        self.memo[name] = comp
+        return comp
+
+
+def schedule_report(report: ProgramReport, mesh=None, *,
+                    comm=None,
+                    peak_flops: Optional[float] = None,
+                    hbm_gbps: Optional[float] = None,
+                    ici_gbps: Optional[float] = None,
+                    dcn_gbps: Optional[float] = None,
+                    dcn_axes: Optional[Sequence[str]] = None,
+                    top_points: int = 5) -> ScheduleReport:
+    """Build the :class:`ScheduleReport` of one program. ``mesh`` (a
+    ``jax.sharding.Mesh``, optional) enables per-axis attribution of
+    collective time, exactly like :func:`~mxnet_tpu.analysis.comm.
+    comm_report` — or pass ``comm=`` (a :class:`CommReport` already
+    built over the SAME report) to reuse its pricing instead of running
+    it again. The roofline constants resolve explicit args > ``sched_*``
+    config knobs (``MXNET_TPU_SCHED_*``) > v5e defaults."""
+    peak, hbm, ici, dcn, dcn_ax = _resolve_constants(
+        peak_flops, hbm_gbps, ici_gbps, dcn_gbps, dcn_axes)
+    sched = _Scheduler(report, mesh, peak, hbm, ici, dcn, dcn_ax,
+                       comm=comm)
+    comp, points = sched.analyze(report.values, collect_points=True)
+    comm_s = sum(s.seconds for s in comp.spans)
+    exposed = sum(s.exposed_seconds for s in comp.spans)
+    hidden = sum(s.hidden_seconds for s in comp.spans)
+    crit = max(comp.crit, comp.compute + exposed)
+    mfu_bound = (comp.flops / (peak * crit)) if (peak > 0 and crit > 0) \
+        else 0.0
+    return ScheduleReport(
+        dialect=report.dialect,
+        critical_path_seconds=crit,
+        dag_critical_seconds=comp.crit,
+        compute_seconds=comp.compute,
+        comm_seconds=comm_s,
+        exposed_comm_seconds=exposed,
+        hidden_comm_seconds=hidden,
+        flops_total=comp.flops,
+        hbm_bytes=comp.hbm_bytes,
+        spans=comp.spans,
+        serialization_points=(points or [])[:top_points],
+        mfu_bound=min(1.0, mfu_bound),
+        constants={"peak_flops": peak, "hbm_gbps": hbm / 1e9,
+                   "ici_gbps": ici / 1e9, "dcn_gbps": dcn / 1e9,
+                   "dcn_axes": ",".join(dcn_ax)},
+        n_nodes=comp.n_nodes)

@@ -126,6 +126,26 @@ _KNOBS: Dict[str, tuple] = {
     "peak_flops": (float, 0.0, ("MXNET_TPU_PEAK_FLOPS",),
                    "accelerator peak FLOP/s per process for train_mfu "
                    "(e.g. 1.97e14 for one v5e chip); 0 = MFU not computed"),
+    # -- schedule auditor roofline constants (docs/ANALYSIS.md
+    # "Schedule & overlap"); 0/empty = the analysis.schedule defaults
+    # (one v5e chip), sched_peak_flops falls back to peak_flops first ----
+    "sched_peak_flops": (float, 0.0, ("MXNET_TPU_SCHED_PEAK_FLOPS",),
+                         "peak FLOP/s the schedule auditor's roofline "
+                         "prices compute at; 0 = peak_flops, else the "
+                         "v5e default"),
+    "sched_hbm_gbps": (float, 0.0, ("MXNET_TPU_SCHED_HBM_GBPS",),
+                       "HBM bandwidth (GB/s) for the roofline's memory "
+                       "side; 0 = the v5e default"),
+    "sched_ici_gbps": (float, 0.0, ("MXNET_TPU_SCHED_ICI_GBPS",),
+                       "ICI link bandwidth (GB/s) collectives are priced "
+                       "at; 0 = the v5e default"),
+    "sched_dcn_gbps": (float, 0.0, ("MXNET_TPU_SCHED_DCN_GBPS",),
+                       "DCN bandwidth (GB/s) for collectives spanning a "
+                       "sched_dcn_axes axis; 0 = the default"),
+    "sched_dcn_axes": (str, "", ("MXNET_TPU_SCHED_DCN_AXES",),
+                       "comma-separated mesh axes priced at DCN speed by "
+                       "the schedule auditor (e.g. 'dp' on a multi-pod "
+                       "fleet); empty = every collective rides ICI"),
 }
 
 _values: Dict[str, Any] = {}

@@ -799,7 +799,10 @@ class GenerationEngine:
         program's own temporaries under ``activations`` /
         ``draft_temp`` / ``verify_temp`` — including the
         ``kv_gather_materialize`` detector for the paged decode's XLA
-        gather of the pool (docs/ANALYSIS.md)."""
+        gather of the pool (docs/ANALYSIS.md). ``audit(...).schedule``
+        is the static schedule model (critical-path latency, overlap,
+        MFU bound — serving programs are collective-free by contract, so
+        its exposed-comm census must stay empty)."""
         from .. import analysis as _analysis
 
         params = self._params()
@@ -896,10 +899,14 @@ class GenerationEngine:
             default_cat = "activations"
         memory = _analysis.memory_report(rep, categories=mem_cats,
                                          default_category=default_cat)
+        # static schedule model over the same (scheduled) report: serving
+        # programs are mesh-less today so comm time is zero by contract —
+        # the critical path and MFU bound still price the decode step
+        schedule = _analysis.schedule_report(rep, comm=comm)
         return _analysis.ProgramAudit(
             lowered=lowered_rep, compiled=compiled_rep,
             carry_indices=tuple(range(n_pre, n_pre + n_carry)),
-            comm=comm, memory=memory)
+            comm=comm, memory=memory, schedule=schedule)
 
     def release_slot(self, slot: int) -> None:
         """Mark a row free (emits pad, frontier frozen) — the next prefill

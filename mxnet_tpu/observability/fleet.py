@@ -325,6 +325,11 @@ class RankStats:
     tokens_per_sec: Optional[float] = None
     flops_per_step: Optional[float] = None
     mfu: Optional[float] = None
+    # the schedule auditor's static bound + exposed-comm share
+    # (train_mfu_bound / train_comm_exposed_share gauges, set by
+    # TrainStep.audit — docs/ANALYSIS.md "Schedule & overlap")
+    mfu_bound: Optional[float] = None
+    comm_exposed_share: Optional[float] = None
     last_ts: Optional[float] = None
 
     def summary(self) -> dict:
@@ -336,6 +341,8 @@ class RankStats:
                 "queue_depths": dict(self.queue_depths),
                 "tokens_per_sec": self.tokens_per_sec,
                 "flops_per_step": self.flops_per_step, "mfu": self.mfu,
+                "mfu_bound": self.mfu_bound,
+                "comm_exposed_share": self.comm_exposed_share,
                 "last_ts": self.last_ts}
 
 
@@ -579,7 +586,10 @@ class FleetAggregator:
                 stats.queue_depths[key] = float(s["value"])
         for name, attr in (("train_tokens_per_sec", "tokens_per_sec"),
                            ("train_model_flops_per_step", "flops_per_step"),
-                           ("train_mfu", "mfu")):
+                           ("train_mfu", "mfu"),
+                           ("train_mfu_bound", "mfu_bound"),
+                           ("train_comm_exposed_share",
+                            "comm_exposed_share")):
             for s in series(name):
                 setattr(stats, attr, float(s["value"]))
         ts = meta.get("ts")

@@ -1115,7 +1115,16 @@ class TrainStep:
         attribution — ``params`` / ``opt_state`` leaves of the carry,
         ``batch`` for the data inputs, everything the program
         materializes under ``activations`` (``make memcheck`` gates
-        these per program family)."""
+        these per program family).
+
+        ``audit.schedule`` is the static schedule model
+        (:class:`~mxnet_tpu.analysis.ScheduleReport`): critical-path
+        latency lower bound, per-axis exposed vs hidden collective time,
+        overlap fraction, top serialization points and a static MFU
+        upper bound — exported as the ``train_mfu_bound`` /
+        ``train_comm_exposed_share`` gauges so fleet observability can
+        print achieved MFU next to what the schedule permits
+        (``make schedcheck`` gates these per program family)."""
         from .. import analysis as _analysis
 
         if window:
@@ -1165,7 +1174,28 @@ class TrainStep:
             # that crept into a single-device program is still priced
             comm = _analysis.comm_report(
                 compiled_rep if compiled_rep is not None else lowered_rep)
+        # schedule truth follows the same precedence as memory: the
+        # compiled executable is scheduled text (async pairs, fusions);
+        # comm= reuses the pricing just computed over the same report
+        schedule = _analysis.schedule_report(mem_rep, self.mesh, comm=comm)
+        self._record_schedule_bound(schedule)
         return _analysis.ProgramAudit(
             lowered=lowered_rep, compiled=compiled_rep,
             carry_indices=tuple(range(n_carry)),
-            contract=contract, comm=comm, memory=memory)
+            contract=contract, comm=comm, memory=memory,
+            schedule=schedule)
+
+    def _record_schedule_bound(self, schedule) -> None:
+        """Export the schedule auditor's static bound next to the live
+        ``train_mfu`` gauge (docs/OBSERVABILITY.md): the fleet report
+        prints achieved MFU against what the compiled schedule permits,
+        and how much collective time is exposed on the critical path."""
+        _obs.gauge("train_mfu_bound",
+                   "static MFU upper bound from the schedule auditor's "
+                   "critical-path model").set(schedule.mfu_bound)
+        share = (schedule.exposed_comm_seconds
+                 / schedule.critical_path_seconds
+                 if schedule.critical_path_seconds > 0 else 0.0)
+        _obs.gauge("train_comm_exposed_share",
+                   "exposed collective seconds / critical-path seconds "
+                   "(schedule auditor)").set(share)

@@ -952,6 +952,84 @@ def test_lint_traced_constant_capture_jh007():
     assert astlint.lint_source(rebound, "mxnet_tpu/x.py") == []
 
 
+def test_lint_sync_per_dispatch_jh008():
+    """ISSUE 13 satellite: a driver loop that dispatches a compiled
+    callable and immediately materializes its result blocks the host
+    every step — async dispatch pipelining is gone. Recognized compiled
+    callees: jax.jit(...) assignment targets (name or attribute) and the
+    *_jit naming convention; materializers: block_until_ready/.item()/
+    float()/np.asarray/device_get. Deferred materialization after the
+    loop is the fix and stays clean; inline suppression is honored."""
+    src = textwrap.dedent("""\
+        import jax
+        import numpy as np
+
+        step = jax.jit(lambda x: x + 1)
+
+        def drive(xs):
+            out = []
+            for x in xs:
+                y = step(x)
+                out.append(float(y))           # JH008
+            return out
+
+        def drive_direct(xs):
+            while xs:
+                step(xs.pop()).block_until_ready()   # JH008
+            return 1
+
+        class Engine:
+            def __init__(self):
+                self._decode_jit = jax.jit(lambda x: x)
+
+            def loop(self, xs):
+                for x in xs:
+                    r = self._decode_jit(x)
+                    np.asarray(r)              # JH008
+        """)
+    vs = astlint.lint_source(src, "mxnet_tpu/driver.py")
+    assert _rules(vs) == ["JH008", "JH008", "JH008"]
+    assert "defeating async dispatch" in vs[0].message
+    # the fix: keep device futures, materialize ONCE after the loop
+    ok = textwrap.dedent("""\
+        import jax
+
+        step = jax.jit(lambda x: x + 1)
+
+        def drive(xs):
+            futs = [ ]
+            for x in xs:
+                futs.append(step(x))
+            last = futs[-1]
+            last.block_until_ready()
+            return [float(f) for f in futs]
+
+        def plain(xs):
+            for x in xs:
+                y = helper(x)     # not a compiled callee
+                float(y)
+        """)
+    assert astlint.lint_source(ok, "mxnet_tpu/driver.py") == []
+    # inside a jitted hot path the rule stays quiet (that's JH001's turf)
+    hot = textwrap.dedent("""\
+        import jax
+
+        inner = jax.jit(lambda x: x)
+
+        def traced(xs):
+            for x in xs:
+                y = inner(x)
+            return y
+        g = jax.jit(traced)
+        """)
+    assert "JH008" not in _rules(astlint.lint_source(
+        hot, "mxnet_tpu/driver.py"))
+    sup = src.replace("out.append(float(y))           # JH008",
+                      "out.append(float(y))  # lint: disable=JH008")
+    assert _rules(astlint.lint_source(sup, "mxnet_tpu/driver.py")) == \
+        ["JH008", "JH008"]
+
+
 def test_lint_changed_diffs_merge_base(tmp_path):
     """ISSUE 8 satellite: --changed diffs against the merge-base of main,
     so a pre-commit run late in a branch still sees the files committed

@@ -1,0 +1,123 @@
+"""Golden-program schedule gate (ISSUE 13, docs/ANALYSIS.md "Schedule &
+overlap"): `make schedcheck` as a test — the committed sched_* goldens
+match the current programs, an injected exposed collective fails the
+build, the --update-golden rebless workflow round-trips (and refuses the
+inject hook), and the family builders are the SAME shared definition the
+shardcheck/memcheck gates consume (tools/families.py — no drift).
+
+Runs tools/schedcheck.py in-process (importlib) so each case can pick one
+cheap program family and capture the JSON verdict without a subprocess
+per family.
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_mod", os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def schedcheck():
+    return _load("schedcheck")
+
+
+def _verdict(capsys):
+    out = capsys.readouterr().out
+    row, _ = json.JSONDecoder().raw_decode(out, out.index("{"))
+    return row, out
+
+
+def test_gate_matches_committed_goldens(schedcheck, capsys):
+    """ISSUE 13 acceptance: the committed goldens describe the current
+    programs — critical path within tolerance, overlap intact, the
+    CPU-sync exposed census unchanged."""
+    rc = schedcheck.main(["--family", "step_fsdp"])
+    row, _ = _verdict(capsys)
+    assert rc == 0 and row["ok"]
+    fam = row["families"]["step_fsdp"]
+    assert fam["critical_path_seconds"] > 0
+    assert fam["comm_seconds"] > 0
+    # CPU compiles sync collectives: the fsdp baseline is fully exposed
+    # — exactly what the async-overlap work will be diffed against
+    assert fam["overlap_fraction"] == 0.0
+    assert fam["exposed_collectives"].get("all_reduce", 0) > 0
+    assert set(fam["exposed_by_axis_bytes"]) == {"fsdp", "dp×fsdp"}
+    assert fam["carry_donation"] == 1.0
+
+
+def test_injected_exposed_collective_fails_gate(schedcheck, capsys):
+    """ISSUE 13 acceptance: a synthetic exposed all-gather (the --inject
+    test hook) must fail the build — as a newly exposed collective, an
+    exposed-byte regression, and a critical-path regression."""
+    rc = schedcheck.main(["--family", "step_dp8",
+                          "--inject-exposed-collective"])
+    _, out = _verdict(capsys)
+    assert rc == 1
+    assert "newly exposed collective" in out
+    assert "critical-path latency regressed" in out
+    assert "exposed comm bytes" in out
+
+
+def test_serving_families_have_no_exposed_comm(schedcheck, capsys):
+    """The serving contract seen through the schedule lens: zero
+    collective time, overlap vacuously perfect, a positive MFU bound."""
+    rc = schedcheck.main(["--family", "decode"])
+    row, _ = _verdict(capsys)
+    assert rc == 0 and row["ok"]
+    fam = row["families"]["decode"]
+    assert fam["comm_seconds"] == 0.0
+    assert fam["overlap_fraction"] == 1.0
+    assert fam["exposed_collectives"] == {}
+    assert 0 < fam["mfu_bound"] <= 1.0
+
+
+def test_inject_cannot_combine_with_update_golden(schedcheck, capsys):
+    """The failure-path hook must never bless the injected exposure into
+    the committed goldens."""
+    with pytest.raises(SystemExit) as exc:
+        schedcheck.main(["--update-golden", "--inject-exposed-collective"])
+    assert exc.value.code == 2
+    assert "cannot be combined" in capsys.readouterr().err
+
+
+def test_update_golden_rebless_roundtrip(schedcheck, capsys, monkeypatch,
+                                         tmp_path):
+    """--update-golden writes a fresh golden the plain gate then passes
+    against; with no golden at all the gate fails with the rebless
+    instruction instead of crashing."""
+    monkeypatch.setattr(schedcheck, "GOLDEN_DIR", str(tmp_path))
+    rc = schedcheck.main(["--family", "prefill"])
+    _, out = _verdict(capsys)
+    assert rc == 1 and "no committed golden" in out
+    assert "--update-golden" in out
+    rc = schedcheck.main(["--family", "prefill", "--update-golden"])
+    assert rc == 0
+    golden = json.loads((tmp_path / "sched_prefill.json").read_text())
+    assert golden["comm_seconds"] == 0.0
+    assert golden["critical_path_seconds"] > 0
+    assert golden["constants"]["ici_gbps"] > 0
+    rc = schedcheck.main(["--family", "prefill"])
+    row, _ = _verdict(capsys)
+    assert rc == 0 and row["ok"]
+
+
+def test_families_are_the_shared_definition(schedcheck):
+    """ISSUE 13 satellite: shardcheck, memcheck and schedcheck all
+    consume tools/families.py — the SAME memoized module instance, so a
+    family change cannot drift between gates."""
+    shardcheck = _load("shardcheck")
+    memcheck = _load("memcheck")
+    assert schedcheck.families() is shardcheck.FAMILIES
+    assert memcheck.families() is shardcheck.FAMILIES
+    assert set(schedcheck.FAMILY_NAMES) == set(shardcheck.FAMILIES)
+    assert schedcheck.FAMILY_NAMES == memcheck.FAMILY_NAMES

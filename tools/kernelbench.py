@@ -73,6 +73,8 @@ def _timeit(fn, args, reps):
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
+        # timing harness: one blocking fetch per reps-step window —
+        # lint: disable=JH008 -- the per-iteration sync IS the measurement
         np.asarray(jax.device_get(chained(*args)))
         times.append((time.perf_counter() - t0) / reps)
     return sorted(times)[1]

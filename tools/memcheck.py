@@ -49,13 +49,18 @@ GOLDEN_DIR = os.path.join(REPO, "mxnet_tpu", "analysis", "goldens")
 
 
 def _shardcheck():
-    """The program-family builders are shardcheck's — one definition of
-    what 'the representative programs' are, two gates over them."""
+    """The shared program-family builders (tools/families.py) — one
+    definition of what 'the representative programs' are, every gate
+    (shardcheck / memcheck / schedcheck) audits the same seven. Loaded
+    under families.load()'s stable module name so the memoized model
+    builds are shared per process. (Name kept: validate() reads
+    ``_engine`` off it, as it always did off shardcheck.)"""
     spec = importlib.util.spec_from_file_location(
-        "shardcheck_families", os.path.join(REPO, "tools", "shardcheck.py"))
+        "memcheck_families_loader", os.path.join(REPO, "tools",
+                                                 "families.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return mod
+    return mod.load()
 
 
 _FAMILIES = None
@@ -68,8 +73,8 @@ def families():
     return _FAMILIES
 
 
-FAMILY_NAMES = ("step_dp8", "step_fsdp", "window_fsdp", "prefill",
-                "decode", "decode_paged", "verify_spec")
+# gate-facing family order — ONE definition, owned by tools/families.py
+FAMILY_NAMES = _shardcheck().FAMILY_NAMES
 
 
 # -- snapshot / diff ---------------------------------------------------------

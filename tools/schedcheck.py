@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""Golden-program schedule gate (``make schedcheck``; docs/ANALYSIS.md
+"Schedule & overlap", ISSUE 13).
+
+Lowers the same representative program families as ``make shardcheck`` /
+``make memcheck`` (tools/families.py — one definition, three gates), runs
+the static schedule auditor (:mod:`mxnet_tpu.analysis.schedule`) over
+each, and diffs the result against the committed goldens in
+``mxnet_tpu/analysis/goldens/sched_*.json``. The gate FAILS when:
+
+  - **critical-path latency regresses** beyond ``--tolerance`` (default
+    5%) — the modeled lower bound on step/decode time grew;
+  - the **overlap fraction drops** (more than 0.01 absolute below the
+    golden) — collective time that used to hide behind compute is now
+    exposed;
+  - a **collective becomes newly exposed** — the per-kind census of
+    exposed collectives gained an entry or grew (the regression the
+    unified-parallelism overlap work must never reintroduce);
+  - **exposed comm bytes regress** beyond tolerance on any mesh axis;
+  - the **static MFU bound drops** beyond tolerance (the schedule
+    permits less utilization than it used to).
+
+Latency *improvements*, overlap gains and newly-hidden collectives pass
+but are reported so wins can be locked in by reblessing. The modeled
+seconds come from fixed roofline constants (``MXNET_TPU_SCHED_*`` env
+knobs; the gate runs on the defaults, and notes when a golden was
+blessed under different constants) — absolute values are a model, the
+gate diffs the same model against itself.
+
+Intentional changes are reblessed with ``--update-golden`` (commit the
+rewritten JSON with the change that caused it); ``--family`` restricts
+the run; ``--inject-exposed-collective`` is a test hook that adds a
+synthetic exposed all-gather to every current snapshot so the failure
+path itself stays tested (tests/test_schedcheck.py).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+GOLDEN_DIR = os.path.join(REPO, "mxnet_tpu", "analysis", "goldens")
+
+#: absolute overlap-fraction drop tolerated before the gate fails (the
+#: fraction is already a ratio; a relative tolerance would let a mostly
+#: exposed program silently lose its last hidden collective)
+OVERLAP_DROP_TOL = 0.01
+
+
+def _families():
+    """The shared golden-family builders (tools/families.py) — one
+    definition of the representative programs for every gate."""
+    spec = importlib.util.spec_from_file_location(
+        "schedcheck_families_loader", os.path.join(REPO, "tools",
+                                                   "families.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.load()
+
+
+_FAMILIES = None
+
+
+def families():
+    global _FAMILIES
+    if _FAMILIES is None:
+        _FAMILIES = _families().FAMILIES
+    return _FAMILIES
+
+
+# gate-facing family order — ONE definition, owned by tools/families.py
+FAMILY_NAMES = _families().FAMILY_NAMES
+
+
+# -- snapshot / diff ---------------------------------------------------------
+def snapshot(audit) -> dict:
+    """JSON-safe golden record of one family's schedule model."""
+    s = audit.schedule
+    return {
+        "n_inputs": len(audit.lowered.inputs),
+        "critical_path_seconds": s.critical_path_seconds,
+        "dag_critical_seconds": s.dag_critical_seconds,
+        "compute_seconds": s.compute_seconds,
+        "comm_seconds": s.comm_seconds,
+        "exposed_comm_seconds": s.exposed_comm_seconds,
+        "hidden_comm_seconds": s.hidden_comm_seconds,
+        "overlap_fraction": round(s.overlap_fraction, 6),
+        "exposed_collectives": s.exposed_collectives(),
+        "exposed_by_axis_bytes": {
+            ax: d["exposed_bytes"] for ax, d in sorted(s.by_axis().items())},
+        "comm_by_axis_seconds": {
+            ax: d["seconds"] for ax, d in sorted(s.by_axis().items())},
+        "serialization_points": [[p.op, p.kind]
+                                 for p in s.serialization_points[:3]],
+        "mfu_bound": round(s.mfu_bound, 6),
+        "flops_total": s.flops_total,
+        "constants": dict(s.constants),
+        "carry_donation": audit.carry_donation(),
+    }
+
+
+def diff(name: str, golden: dict, cur: dict, tol: float):
+    """(failures, notes) of the current snapshot vs its golden."""
+    fails, notes = [], []
+    g, c = golden["critical_path_seconds"], cur["critical_path_seconds"]
+    if c > g * (1 + tol):
+        fails.append(f"{name}: critical-path latency regressed "
+                     f"{g:.3e}s -> {c:.3e}s (> {tol:.0%} tolerance) — "
+                     "rebless only if the growth is intentional")
+    elif c < g * (1 - tol):
+        notes.append(f"{name}: critical-path latency improved "
+                     f"{g:.3e}s -> {c:.3e}s; rebless with --update-golden "
+                     "to lock it in")
+    go, co = golden["overlap_fraction"], cur["overlap_fraction"]
+    if co < go - OVERLAP_DROP_TOL:
+        fails.append(f"{name}: overlap fraction dropped {go:.3f} -> "
+                     f"{co:.3f} — collective time fell off the "
+                     "compute-hiding path")
+    elif co > go + OVERLAP_DROP_TOL:
+        notes.append(f"{name}: overlap fraction improved {go:.3f} -> "
+                     f"{co:.3f}; rebless to lock it in")
+    gx, cx = golden["exposed_collectives"], cur["exposed_collectives"]
+    for kind in sorted(set(cx) | set(gx)):
+        gn, cn = gx.get(kind, 0), cx.get(kind, 0)
+        if cn > gn:
+            fails.append(f"{name}: newly exposed collective(s) — "
+                         f"{kind} x{cn} exposed vs {gn} in the golden "
+                         "(a collective stopped hiding behind compute)")
+        elif cn < gn:
+            notes.append(f"{name}: {kind} exposed count improved "
+                         f"{gn} -> {cn}; rebless to lock it in")
+    axes = set(golden["exposed_by_axis_bytes"]) \
+        | set(cur["exposed_by_axis_bytes"])
+    for ax in sorted(axes):
+        gb = golden["exposed_by_axis_bytes"].get(ax, 0)
+        cb = cur["exposed_by_axis_bytes"].get(ax, 0)
+        if cb > gb * (1 + tol) and cb - gb > 0:
+            fails.append(f"{name}: exposed comm bytes on axis {ax!r} "
+                         f"regressed {gb} -> {cb} (> {tol:.0%} tolerance)")
+        elif cb < gb * (1 - tol):
+            notes.append(f"{name}: exposed comm bytes on axis {ax!r} "
+                         f"improved {gb} -> {cb}")
+    gm, cm = golden["mfu_bound"], cur["mfu_bound"]
+    if cm < gm * (1 - tol):
+        fails.append(f"{name}: static MFU bound dropped {gm:.4f} -> "
+                     f"{cm:.4f} (> {tol:.0%}) — the schedule permits "
+                     "less utilization than it used to")
+    elif cm > gm * (1 + tol):
+        notes.append(f"{name}: static MFU bound improved {gm:.4f} -> "
+                     f"{cm:.4f}; rebless to lock it in")
+    if golden.get("constants") != cur.get("constants"):
+        notes.append(f"{name}: roofline constants differ from the "
+                     "golden's (env overrides?) — modeled seconds are "
+                     "not comparable; rebless under the default knobs")
+    return fails, notes
+
+
+def _golden_path(name: str) -> str:
+    return os.path.join(GOLDEN_DIR, f"sched_{name}.json")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--update-golden", action="store_true",
+                    help="rebless: write current snapshots as the goldens")
+    ap.add_argument("--family", action="append", choices=FAMILY_NAMES,
+                    help="restrict to named families (repeatable)")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="relative critical-path/exposed-byte drift "
+                         "allowed (default 5%%)")
+    ap.add_argument("--inject-exposed-collective", action="store_true",
+                    help="test hook: add a synthetic exposed all-gather "
+                         "to every current snapshot (the gate must fail)")
+    args = ap.parse_args(argv)
+    if args.inject_exposed_collective and args.update_golden:
+        ap.error("--inject-exposed-collective is a failure-path test hook "
+                 "and cannot be combined with --update-golden (it would "
+                 "bless the injected exposure into the goldens)")
+
+    names = args.family or list(FAMILY_NAMES)
+    fails, notes = [], []
+    row = {"gate": "schedcheck", "tolerance": args.tolerance, "families": {}}
+    fams = families()
+    for name in names:
+        cur = snapshot(fams[name]())
+        if args.inject_exposed_collective:
+            # a 1 MiB sync all-gather exposed on the critical path: the
+            # census gains an entry, the exposed time/bytes grow, and the
+            # overlap fraction drops accordingly
+            extra_s = float(1 << 20) / (cur["constants"]["ici_gbps"] * 1e9)
+            cur["exposed_collectives"]["all_gather"] = \
+                cur["exposed_collectives"].get("all_gather", 0) + 1
+            cur["exposed_by_axis_bytes"]["?"] = \
+                cur["exposed_by_axis_bytes"].get("?", 0) + (1 << 20)
+            cur["comm_seconds"] += extra_s
+            cur["exposed_comm_seconds"] += extra_s
+            cur["critical_path_seconds"] += extra_s
+            cur["overlap_fraction"] = round(
+                cur["hidden_comm_seconds"] / cur["comm_seconds"], 6)
+        row["families"][name] = cur
+        if args.update_golden:
+            os.makedirs(GOLDEN_DIR, exist_ok=True)
+            with open(_golden_path(name), "w") as f:
+                json.dump(cur, f, indent=1, sort_keys=True)
+                f.write("\n")
+            notes.append(f"{name}: golden written")
+            continue
+        try:
+            with open(_golden_path(name)) as f:
+                golden = json.load(f)
+        except (OSError, ValueError):
+            fails.append(f"{name}: no committed golden at "
+                         f"{os.path.relpath(_golden_path(name), REPO)} — "
+                         "run tools/schedcheck.py --update-golden and "
+                         "commit it")
+            continue
+        f2, n2 = diff(name, golden, cur, args.tolerance)
+        fails.extend(f2)
+        notes.extend(n2)
+
+    row["ok"] = not fails
+    if fails:
+        row["failures"] = fails
+    if notes:
+        row["notes"] = notes
+    print(json.dumps(row, indent=1, sort_keys=True))
+    for msg in notes:
+        print(f"NOTE: {msg}")
+    if fails:
+        for msg in fails:
+            print(f"FAIL: {msg}")
+        return 1
+    verb = "reblessed" if args.update_golden else "match goldens"
+    print(f"OK: {len(names)} program families {verb} (critical path "
+          f"within {args.tolerance:.0%}, overlap intact, no newly "
+          "exposed collectives)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

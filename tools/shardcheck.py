@@ -31,8 +31,8 @@ failure path itself stays tested (tests/test_shardcheck.py).
 from __future__ import annotations
 
 import argparse
-import functools
 import hashlib
+import importlib.util
 import json
 import os
 import sys
@@ -46,129 +46,22 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 GOLDEN_DIR = os.path.join(REPO, "mxnet_tpu", "analysis", "goldens")
 
 
-# -- program families --------------------------------------------------------
-# builders are memoized: the two fsdp families audit the SAME TrainStep
-# (step vs window program) and the two serving families the same engine
-# (decode vs prefill program) — one model build/compile per pair per run
-def _mlp_step(mesh, rules=None):
-    import mxnet_tpu as mx
-    from mxnet_tpu import nd, optimizer
-    from mxnet_tpu.gluon import nn
-    from mxnet_tpu.parallel import TrainStep
-
-    mx.random.seed(0)
-    net = nn.HybridSequential()
-    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
-    net.initialize()
-    x = nd.ones((8, 16))
-    _ = net(x)
-    ts = TrainStep(net, lambda out, *l: ((out - l[0]) ** 2).mean(),
-                   optimizer.Adam(learning_rate=1e-3), mesh=mesh,
-                   rules=rules)
-    return ts, (x, nd.zeros((8, 8)))
+def _families_mod():
+    """The shared golden-family builders (tools/families.py) — ONE
+    definition of the representative programs for every gate
+    (shardcheck / memcheck / schedcheck), loaded under a stable module
+    name so the memoized model builds are shared per process."""
+    spec = importlib.util.spec_from_file_location(
+        "shardcheck_families_loader", os.path.join(REPO, "tools",
+                                                   "families.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.load()
 
 
-def family_step_dp8():
-    """Pure data parallelism: the gradient all-reduce pattern."""
-    from mxnet_tpu.parallel import MeshConfig, make_mesh
-
-    ts, batch = _mlp_step(make_mesh(MeshConfig(dp=8)))
-    return ts.audit(*batch)
-
-
-@functools.lru_cache(maxsize=None)
-def _fsdp_step():
-    from mxnet_tpu.parallel import MeshConfig, ShardingRules, make_mesh
-
-    mesh = make_mesh(MeshConfig(dp=2, fsdp=4))
-    rules = ShardingRules(fsdp_axis="fsdp", min_fsdp_size=1)
-    return _mlp_step(mesh, rules)
-
-
-def family_step_fsdp():
-    """ZeRO dp=2 x fsdp=4: compute gathers + sharded-grad reductions."""
-    ts, batch = _fsdp_step()
-    return ts.audit(*batch)
-
-
-def family_window_fsdp():
-    """The fused 2-step scan window over the same ZeRO layout."""
-    ts, batch = _fsdp_step()
-    return ts.audit(*batch, window=2)
-
-
-@functools.lru_cache(maxsize=None)
-def _engine():
-    import numpy as np
-
-    import mxnet_tpu as mx
-    from mxnet_tpu import nd
-    from mxnet_tpu.inference import GenerationEngine
-    from mxnet_tpu.models import gpt2
-
-    mx.random.seed(0)
-    net = gpt2.get_gpt2("gpt2_tiny", dropout=0.0, num_layers=2, units=32,
-                        num_heads=2, max_length=64, vocab_size=64)
-    net.initialize()
-    _ = net(nd.array(np.zeros((1, 4), np.int32)))
-    return GenerationEngine(net, batch_size=2, max_length=64,
-                            prefill_buckets=(8, 16))
-
-
-def family_decode():
-    """The serving decode step: zero collectives is the contract."""
-    return _engine().audit()
-
-
-def family_prefill():
-    """The bucket-8 prefill program (same zero-collective contract)."""
-    return _engine().audit(bucket=8)
-
-
-@functools.lru_cache(maxsize=None)
-def _paged_engines():
-    """One paged + one speculative engine over the SAME net as _engine()
-    (separate build: engine caches are engine-local state)."""
-    import numpy as np
-
-    import mxnet_tpu as mx
-    from mxnet_tpu import nd
-    from mxnet_tpu.inference import GenerationEngine
-    from mxnet_tpu.models import gpt2
-
-    mx.random.seed(0)
-    net = gpt2.get_gpt2("gpt2_tiny", dropout=0.0, num_layers=2, units=32,
-                        num_heads=2, max_length=64, vocab_size=64)
-    net.initialize()
-    _ = net(nd.array(np.zeros((1, 4), np.int32)))
-    paged = GenerationEngine(net, batch_size=2, max_length=64,
-                             prefill_buckets=(8, 16), paged=True,
-                             page_size=16)
-    spec = GenerationEngine(net, batch_size=2, max_length=64,
-                            prefill_buckets=(8, 16), paged=True,
-                            page_size=16, draft_net=net, speculate_k=4)
-    return paged, spec
-
-
-def family_decode_paged():
-    """The paged decode step: page-table carry + pools, zero collectives."""
-    return _paged_engines()[0].audit()
-
-
-def family_verify_spec():
-    """The speculative verify pass (k+1 positions, one program)."""
-    return _paged_engines()[1].audit(program="verify")
-
-
-FAMILIES = {
-    "step_dp8": family_step_dp8,
-    "step_fsdp": family_step_fsdp,
-    "window_fsdp": family_window_fsdp,
-    "decode": family_decode,
-    "prefill": family_prefill,
-    "decode_paged": family_decode_paged,
-    "verify_spec": family_verify_spec,
-}
+#: name -> () -> ProgramAudit, from tools/families.py (kept as a module
+#: attribute: the tests read shardcheck.FAMILIES)
+FAMILIES = _families_mod().FAMILIES
 
 
 # -- snapshot / diff ---------------------------------------------------------
