@@ -24,6 +24,12 @@
 #             drops, newly exposed collectives and exposed-comm-byte
 #             regressions per mesh axis vs
 #             mxnet_tpu/analysis/goldens/sched_*.json
+#   profcheck - measured-profiling gate (tools/profcheck.py): traces two
+#             shared golden families for real, asserts non-empty device
+#             op timelines, a predicted/measured calibration table
+#             against the sched goldens, measured overlap next to the
+#             static overlap fraction, and step-time agreement with the
+#             metrics registry
 #   native  - build libmxtpu.so (C++ runtime: recordio/jpeg/runtime/c_api)
 #   fast    - pytest without @slow (target < 10 min on 8 virtual CPU devs)
 #   slow    - the @slow remainder (model compiles, 4-process launches)
@@ -40,9 +46,9 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity lint audit shardcheck memcheck schedcheck native fast slow test chaos chaos-elastic obs obsfleet perfwin genbench ampbench bench clean
+.PHONY: ci sanity lint audit shardcheck memcheck schedcheck profcheck native fast slow test chaos chaos-elastic obs obsfleet perfwin genbench ampbench bench clean
 
-ci: sanity lint native fast audit shardcheck memcheck schedcheck chaos-elastic obsfleet
+ci: sanity lint native fast audit shardcheck memcheck schedcheck profcheck chaos-elastic obsfleet
 
 sanity:
 	$(PY) -m compileall -q mxnet_tpu tools tests examples bench.py __graft_entry__.py
@@ -86,6 +92,16 @@ memcheck:
 # `python tools/schedcheck.py --update-golden`
 schedcheck:
 	$(PY) tools/schedcheck.py
+
+# measured-profiling gate (docs/OBSERVABILITY.md "Measured profiling"):
+# captures real traces of the fsdp step + decode golden families, parses
+# the XPlane timelines, and asserts non-empty op rows, the
+# predicted-vs-measured calibration table (anchored on the committed
+# sched goldens), measured overlap next to ScheduleReport's fraction,
+# and measured-vs-registry step-time agreement. The failure path stays
+# tested via `python tools/profcheck.py --inject-empty-trace`
+profcheck:
+	$(PY) tools/profcheck.py
 
 native:
 	$(MAKE) -C native

@@ -134,6 +134,13 @@ EXTRA_HOT_PATHS: Dict[str, Tuple[str, ...]] = {
         "FleetSnapshotter._write", "FleetSnapshotter._copy_events",
         "FleetSnapshotter._append_range",
     ),
+    # measured profiling's step-boundary probe: step_capture_begin /
+    # begin_if_due run once per training step while armed (the trace
+    # start/stop paths themselves are rare and excluded)
+    "observability/profiling.py": (
+        "step_capture_begin", "CaptureController.begin_if_due",
+        "CaptureController._consume_request",
+    ),
 }
 
 # function names that wrap a python callable into a compiled/traced one

@@ -158,6 +158,26 @@ class _CompSched:
     hbm_bytes: float = 0.0
     spans: List[CollectiveSpan] = dataclasses.field(default_factory=list)
     n_nodes: int = 0
+    # per-op-class roofline seconds (dot/conv/fusion/other + one class
+    # per collective kind) — the predicted side measured profiling's
+    # calibrate() compares against (docs/OBSERVABILITY.md)
+    classes: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    def add_class(self, cls: str, secs: float) -> None:
+        if secs > 0:
+            self.classes[cls] = self.classes.get(cls, 0.0) + secs
+
+    def merge_classes(self, other: Dict[str, float]) -> None:
+        for k, v in other.items():
+            self.classes[k] = self.classes.get(k, 0.0) + v
+
+
+def _op_class(name: str) -> str:
+    # one classifier for both sides of the predicted-vs-measured
+    # comparison (lazy import: observability pulls in the exporters)
+    from ..observability.profiling import op_class
+
+    return op_class(name)
 
 
 @dataclasses.dataclass
@@ -179,6 +199,10 @@ class ScheduleReport:
     mfu_bound: float               # static upper bound on achievable MFU
     constants: Dict[str, float]    # the roofline constants used
     n_nodes: int
+    # roofline seconds per op class — what measured profiling's
+    # calibrate() diffs against a trace's measured class seconds
+    op_class_seconds: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
 
     @property
     def overlap_fraction(self) -> float:
@@ -237,6 +261,8 @@ class ScheduleReport:
             "mfu_bound": round(self.mfu_bound, 6),
             "n_nodes": self.n_nodes,
             "constants": dict(self.constants),
+            "op_class_seconds": {k: v for k, v
+                                 in sorted(self.op_class_seconds.items())},
         }
 
 
@@ -389,6 +415,7 @@ class _Scheduler:
                 comp.hbm_bytes += best.hbm_bytes
                 comp.spans.extend(best.spans)
                 comp.n_nodes += best.n_nodes
+                comp.merge_classes(best.classes)
                 continue
             # roofline compute node: flops vs HBM bytes. A fusion's flops
             # are its body's dots; its HBM traffic its own operands +
@@ -410,6 +437,7 @@ class _Scheduler:
             comp.compute += secs
             comp.flops += flops
             comp.hbm_bytes += hbm_bytes
+            comp.add_class(_op_class(v.op), secs)
             if secs > 0:
                 compute_nodes.append(t)
 
@@ -451,6 +479,8 @@ class _Scheduler:
                 exposed_seconds=max(0.0, secs - hidden),
                 hidden_seconds=hidden, is_async=True, t_start=s, t_done=d))
         comp.spans.extend(spans)
+        for s2 in spans:  # locally created only — callee spans merged above
+            comp.add_class(s2.kind, s2.seconds)
 
         # pass 3: the dependency longest path (forward sweep in text
         # order — defs precede uses in both dialects)
@@ -543,4 +573,5 @@ def schedule_report(report: ProgramReport, mesh=None, *,
         constants={"peak_flops": peak, "hbm_gbps": hbm / 1e9,
                    "ici_gbps": ici / 1e9, "dcn_gbps": dcn / 1e9,
                    "dcn_axes": ",".join(dcn_ax)},
-        n_nodes=comp.n_nodes)
+        n_nodes=comp.n_nodes,
+        op_class_seconds=dict(comp.classes))

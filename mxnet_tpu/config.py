@@ -108,8 +108,20 @@ _KNOBS: Dict[str, tuple] = {
                       "run directory for events-h{host}.jsonl + metrics.json/"
                       ".prom exports"),
     "telemetry_rotate_mb": (int, 64, ("MXNET_TPU_TELEMETRY_ROTATE_MB",),
-                            "event-log rotation threshold per file (one .1 "
-                            "predecessor is kept)"),
+                            "event-log rotation threshold per file (rotated "
+                            "segments are gzip-compressed)"),
+    "events_keep_bytes": (int, 0, ("MXNET_TPU_EVENTS_KEEP_BYTES",),
+                          "cap on total bytes of retained rotated event-log "
+                          "segments (.jsonl.N.gz); 0 = keep exactly one "
+                          "rotated segment (the pre-cap behavior)"),
+    # -- measured profiling (docs/OBSERVABILITY.md "Measured profiling") -----
+    "prof_every_n_steps": (int, 0, ("MXNET_TPU_PROF_EVERY_N_STEPS",),
+                           "trace every N-th training step into a capture "
+                           "dir (periodic measured baseline); 0 = off"),
+    "prof_keep_bytes": (int, 512 * 1024 * 1024, ("MXNET_TPU_PROF_KEEP_BYTES",),
+                        "retention cap on total bytes of kept step-capture "
+                        "trace dirs (oldest swept first, newest always "
+                        "kept); 0 = unbounded"),
     # -- fleet observability (docs/OBSERVABILITY.md "Fleet view") ------------
     "fleet_dir": (str, "", ("MXNET_TPU_FLEET_DIR",),
                   "shared directory for cross-rank telemetry snapshots "

@@ -908,6 +908,41 @@ class GenerationEngine:
             carry_indices=tuple(range(n_pre, n_pre + n_carry)),
             comm=comm, memory=memory, schedule=schedule)
 
+    def profile(self, prompt=None, steps: int = 8, warmup: int = 2,
+                trace_dir: Optional[str] = None, calibrate: bool = True,
+                band: float = 3.0):
+        """Trace ``steps`` REAL decode steps (speculative rounds on a
+        speculative engine) and return the
+        :class:`~mxnet_tpu.observability.profiling.Capture` — the
+        measured per-op timeline of the serving hot loop, hot-op ranking
+        and measured step time (docs/OBSERVABILITY.md "Measured
+        profiling"). The dispatch goes through the engine's own
+        ``_decode_jit``/``_draft_jit`` caches, so the traced program IS
+        the program continuous batching dispatches. ``prompt`` (default
+        a short synthetic one) is prefilled into slot 0 first, outside
+        the traced window, so the decode has a live row to extend; the
+        slot is released afterwards.
+
+        With ``calibrate=True`` the capture carries per-op-class
+        predicted/measured ratios against :meth:`audit`'s schedule model
+        of the same decode program."""
+        from ..observability import profiling as _profiling
+
+        if prompt is None:
+            prompt = list(range(1, 1 + min(4, self.prefill_buckets[0])))
+        self.prefill(prompt, slot=0)
+        fn = self.spec_step if self.speculative else self.decode_step
+        try:
+            cap = _profiling.capture(fn, steps=steps, warmup=warmup,
+                                     trace_dir=trace_dir)
+        finally:
+            self.release_slot(0)
+        if calibrate:
+            cap.schedule = self.audit().schedule
+            cap.calibration = _profiling.calibrate(cap.schedule, cap.report,
+                                                   band=band)
+        return cap
+
     def release_slot(self, slot: int) -> None:
         """Mark a row free (emits pad, frontier frozen) — the next prefill
         into this slot overwrites it. In paged mode, the row's pages return

@@ -40,12 +40,13 @@ from . import goodput  # noqa: F401
 from . import metrics  # noqa: F401
 from .events import emit, read_events, set_step  # noqa: F401
 from .metrics import REGISTRY, counter, gauge, histogram  # noqa: F401
-from . import fleet  # noqa: F401  (imports events/metrics/goodput above)
+from . import profiling  # noqa: F401  (imports events/metrics above)
+from . import fleet  # noqa: F401  (imports events/metrics/goodput/profiling)
 
 __all__ = ["metrics", "events", "REGISTRY", "counter", "gauge", "histogram",
            "emit", "set_step", "read_events", "enabled", "enable", "disable",
            "shutdown", "span", "timed_region", "telemetry_dir",
-           "throughput_delta", "fleet", "goodput"]
+           "throughput_delta", "fleet", "goodput", "profiling"]
 
 
 def throughput_delta(prev):
@@ -103,7 +104,8 @@ def enable(directory: Optional[str] = None, run_id: Optional[str] = None) -> str
     host = events._host_index()
     events.LOG.configure(
         os.path.join(_dir, f"events-h{host}.jsonl"), run_id=run_id,
-        rotate_bytes=config.get("telemetry_rotate_mb") * 1024 * 1024)
+        rotate_bytes=config.get("telemetry_rotate_mb") * 1024 * 1024,
+        keep_bytes=config.get("events_keep_bytes"))
     _enabled = True
     if not _atexit_registered:
         atexit.register(shutdown)

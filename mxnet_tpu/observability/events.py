@@ -14,21 +14,77 @@ emitters (DataLoader workers, checkpoint IO) land on the training step they
 belong to and can be correlated with the XPlane trace rows annotated by
 ``obs.span``.
 
-Rotation: when the active file exceeds ``rotate_bytes`` the writer renames
-it to ``<path>.1`` (replacing any previous ``.1``) and reopens — bounded
-disk, two files max, and :func:`read_events` transparently reads both in
-order.
+Rotation: when the active file exceeds ``rotate_bytes`` the writer
+gzip-compresses it into ``<path>.<seq>.gz`` (monotonically increasing
+``seq`` — lowest is oldest) and reopens fresh. Total retained rotated
+bytes are capped by the ``events_keep_bytes`` knob
+(``MXNET_TPU_EVENTS_KEEP_BYTES``): the oldest segments are deleted until
+the cap fits, and with the default ``0`` exactly one rotated segment is
+kept — the pre-cap disk bound. :func:`read_events` reads rotated
+segments (gzipped or the legacy plain ``.1``) plus the live file in
+order, transparently.
 """
 from __future__ import annotations
 
+import gzip
 import json
 import os
+import re
 import threading
 import time
 from typing import Iterator, List, Optional
 
 __all__ = ["EventLog", "LOG", "emit", "set_step", "configure", "close",
-           "read_events", "current_step"]
+           "read_events", "current_step", "rotated_segments",
+           "latest_rotated"]
+
+
+def _segment_seq(base: str, path: str) -> Optional[int]:
+    m = re.fullmatch(re.escape(os.path.basename(base))
+                     + r"\.(\d+)(?:\.gz)?", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def rotated_segments(path: str) -> List[str]:
+    """Rotated predecessors of the live file at ``path``, oldest first
+    (``<path>.N[.gz]`` ordered by N; the legacy single ``.1`` sorts the
+    same way). When a segment briefly exists both plain and compressed
+    (the background compressor replaced the ``.gz`` but has not removed
+    the plain file yet) the ``.gz`` wins — it is complete by then."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    by_seq: dict = {}
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    for name in names:
+        seq = _segment_seq(base, name)
+        if seq is None:
+            continue
+        cur = by_seq.get(seq)
+        if cur is None or name.endswith(".gz"):
+            by_seq[seq] = name
+    return [os.path.join(d, name)
+            for _seq, name in sorted(by_seq.items())]
+
+
+def latest_rotated(path: str) -> Optional[str]:
+    segs = rotated_segments(path)
+    return segs[-1] if segs else None
+
+
+def segment_seq(path: str, segment: str) -> int:
+    """Rotation index of one of ``path``'s rotated segments (0 when
+    ``segment`` is not one)."""
+    return _segment_seq(path, segment) or 0
+
+
+def _open_text(path: str):
+    """Text handle over a (possibly gzipped) JSONL segment."""
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt", errors="replace")
+    return open(path, "r", errors="replace")
 
 
 _host_index_cache = None
@@ -56,13 +112,19 @@ class EventLog:
         self._path: Optional[str] = None
         self._run_id: Optional[str] = None
         self._rotate_bytes = 64 * 1024 * 1024
+        self._keep_bytes = 0  # 0 = keep exactly one rotated segment
         self._size = 0
+        self._seq = 1  # next rotation index (resumed from disk on configure)
         self._step = 0
         self._lock = threading.Lock()
+        # in-flight background compress/sweep workers (joined on close so
+        # a clean shutdown leaves only .gz segments behind)
+        self._rot_threads: List[threading.Thread] = []
 
     # -- lifecycle -----------------------------------------------------------
     def configure(self, path: str, run_id: Optional[str] = None,
-                  rotate_bytes: Optional[int] = None) -> "EventLog":
+                  rotate_bytes: Optional[int] = None,
+                  keep_bytes: Optional[int] = None) -> "EventLog":
         with self._lock:
             if self._fh is not None:
                 self._fh.close()
@@ -75,6 +137,13 @@ class EventLog:
             self._run_id = run_id or f"{int(time.time())}-{os.getpid()}"
             if rotate_bytes is not None:
                 self._rotate_bytes = int(rotate_bytes)
+            if keep_bytes is not None:
+                self._keep_bytes = int(keep_bytes)
+            # resume the rotation sequence past whatever a previous
+            # process (same path) already wrote
+            segs = rotated_segments(path)
+            last = _segment_seq(path, segs[-1]) if segs else 0
+            self._seq = (last or 0) + 1
         return self
 
     @property
@@ -94,6 +163,9 @@ class EventLog:
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
+            threads, self._rot_threads = self._rot_threads, []
+        for t in threads:  # outside the lock: workers never take it
+            t.join(timeout=30.0)
 
     # -- write path ----------------------------------------------------------
     def set_step(self, step: int) -> None:
@@ -143,12 +215,73 @@ class EventLog:
             return
         try:
             self._fh.close()
-            os.replace(self._path, self._path + ".1")
+            rot = f"{self._path}.{self._seq}"
+            os.replace(self._path, rot)  # O(1) — this is all emit() pays
+            self._seq += 1
+            # gzip + retention sweep run OFF the emit lock on a daemon
+            # thread: compressing a 64 MB segment inline would stall the
+            # training step that happened to cross the threshold (and
+            # every other emitting thread behind the lock). The plain
+            # numbered segment stays readable until the .gz replaces it.
+            t = threading.Thread(target=self._compress_and_sweep,
+                                 args=(rot,), daemon=True,
+                                 name="events-rotate")
+            self._rot_threads.append(t)
+            t.start()
         finally:
-            # reopen even if the rename failed (truncation beats a closed
-            # handle); a reopen failure propagates to emit()'s guard above
+            # reopen even if the rotation failed (truncation beats a
+            # closed handle); a reopen failure propagates to emit()'s
+            # guard above
             self._fh = open(self._path, "a", buffering=1)
             self._size = self._fh.tell()
+
+    def _compress_and_sweep(self, rot: str) -> None:
+        try:
+            with open(rot, "rb") as src, \
+                    gzip.open(rot + ".gz.tmp", "wb") as dst:
+                while True:
+                    chunk = src.read(1 << 20)
+                    if not chunk:
+                        break
+                    dst.write(chunk)
+            os.replace(rot + ".gz.tmp", rot + ".gz")
+            os.remove(rot)
+        except OSError:
+            pass  # the plain segment stays readable; retry never needed
+        try:
+            self._sweep_retention()
+        except OSError:
+            pass
+
+    def _sweep_retention(self) -> None:
+        """Delete oldest rotated segments until the retained total fits
+        ``keep_bytes`` (0 = keep exactly one segment, the historical
+        bound). The newest segment always survives — the fleet
+        snapshotter recovers post-rotation bytes from it."""
+        segs = rotated_segments(self._path)
+        if not segs:
+            return
+        if self._keep_bytes <= 0:
+            doomed = segs[:-1]
+        else:
+            sizes = {}
+            for p in segs:
+                try:
+                    sizes[p] = os.path.getsize(p)
+                except OSError:
+                    sizes[p] = 0
+            total = sum(sizes.values())
+            doomed = []
+            for p in segs[:-1]:
+                if total <= self._keep_bytes:
+                    break
+                doomed.append(p)
+                total -= sizes[p]
+        for p in doomed:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
 
 
 def _json_fallback(o):
@@ -159,24 +292,36 @@ def _json_fallback(o):
 
 
 def read_events(path: str) -> List[dict]:
-    """Read every record from ``path`` (including its ``.1`` rotation
-    predecessor, oldest first). ``path`` may also be a directory, in which
-    case every ``events*.jsonl`` file under it is read (multi-host runs
-    write one file per host)."""
+    """Read every record from ``path`` (its rotated predecessors first,
+    oldest to newest — gzipped ``.N.gz`` segments and the legacy plain
+    ``.1`` both read transparently). ``path`` may also be a directory, in
+    which case every ``events*.jsonl[.gz]`` file under it is read
+    (multi-host runs write one file per host), or a single ``.gz``
+    segment."""
     if os.path.isdir(path):
         files: List[str] = []
-        for name in sorted(os.listdir(path)):
-            if name.startswith("events") and name.endswith(".jsonl.1"):
+        names = sorted(os.listdir(path))
+        # rotated segments first (oldest records), ordered per base file
+        # by NUMERIC seq — lexically, .10.gz would sort before .2.gz
+        rotated = []
+        for name in names:
+            seq = _segment_seq(name.split(".jsonl")[0] + ".jsonl", name)
+            if name.startswith("events") and seq is not None:
+                rotated.append((name.split(".jsonl")[0], seq, name))
+        files.extend(os.path.join(path, name)
+                     for _base, _seq, name in sorted(rotated))
+        for name in names:
+            if name.startswith("events") and (name.endswith(".jsonl")
+                                              or name.endswith(".jsonl.gz")):
                 files.append(os.path.join(path, name))
-        for name in sorted(os.listdir(path)):
-            if name.startswith("events") and name.endswith(".jsonl"):
-                files.append(os.path.join(path, name))
+    elif path.endswith(".gz"):
+        files = [path]
     else:
-        files = ([path + ".1"] if os.path.exists(path + ".1") else []) + [path]
+        files = rotated_segments(path) + [path]
     out: List[dict] = []
     for p in files:
         try:
-            with open(p) as f:
+            with _open_text(p) as f:
                 for line in f:
                     line = line.strip()
                     if not line:
@@ -185,8 +330,8 @@ def read_events(path: str) -> List[dict]:
                         out.append(json.loads(line))
                     except ValueError:
                         continue  # torn final line after a crash
-        except OSError:
-            continue
+        except (OSError, EOFError):
+            continue  # vanished file / torn gzip trailer after a crash
     return out
 
 

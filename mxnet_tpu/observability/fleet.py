@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import dataclasses
 import glob
+import gzip
 import json
 import logging
 import math
@@ -58,7 +59,7 @@ __all__ = ["FleetSnapshotter", "FleetAggregator", "FleetReport",
 logger = logging.getLogger("mxnet_tpu.observability.fleet")
 
 _RANK_DIR = re.compile(r"telemetry-h(\d+)$")
-_GEN_FILE = re.compile(r"-g(\d+)\.(json|jsonl)$")
+_GEN_FILE = re.compile(r"-g(\d+)\.(json|jsonl)(\.gz)?$")
 
 
 def _atomic_write(path: str, data: str) -> None:
@@ -114,6 +115,11 @@ class FleetSnapshotter:
         # tick would move O(run length) bytes across the shared FS)
         self._copied = 0
         self._seeded_rotation = False
+        # highest rotation index already drained — rotation is detected
+        # by the sequence advancing, never by the live file's size (a
+        # fresh live file can outgrow the old offset between two ticks,
+        # which a shrink check would read as "no rotation")
+        self._last_seq = 0
 
     def snapshot(self) -> bool:
         """Write one snapshot now (atomic); True when it landed."""
@@ -148,11 +154,15 @@ class FleetSnapshotter:
         rank dying mid-append can tear at most the final line, which the
         JSONL reader already skips. Rotation of the source is detected by
         the live file shrinking: the remainder of the old live file is
-        recovered from its ``.1`` successor before restarting at 0."""
+        recovered from its newest rotated segment (gzip-compressed since
+        the ``events_keep_bytes`` rework — decompressed transparently)
+        before restarting at 0."""
         src = _events.LOG.path
         if not src:
             return
         dst = os.path.join(self.directory, f"events-g{g}.jsonl")
+        segs = _events.rotated_segments(src)
+        max_seq = _events.segment_seq(src, segs[-1]) if segs else 0
         if not self._seeded_rotation:
             self._seeded_rotation = True
             # this instance owns the (rank, generation) file: truncate any
@@ -163,30 +173,50 @@ class FleetSnapshotter:
                 open(dst, "wb").close()
             except OSError:
                 return
-            self._append_range(src + ".1", 0, dst)
+            for seg in segs:
+                self._append_range(seg, 0, dst)
+            self._last_seq = max_seq
+        elif max_seq > self._last_seq:
+            # the live file rotated under us (possibly more than once):
+            # the remainder of what we were copying sits at offset
+            # ``_copied`` of the segment that WAS the live file (seq ==
+            # last_seq + 1); every later new segment copies whole. A
+            # swept segment (events_keep_bytes retention outran the
+            # snapshot cadence) is gone — the survivors copy from 0
+            for seg in segs:
+                seq = _events.segment_seq(src, seg)
+                if seq <= self._last_seq:
+                    continue
+                self._append_range(
+                    seg, self._copied if seq == self._last_seq + 1 else 0,
+                    dst)
+            self._copied = 0
+            self._last_seq = max_seq
         try:
             size = os.path.getsize(src)
         except OSError:
             return
-        if size < self._copied:  # live file rotated under us
-            self._append_range(src + ".1", self._copied, dst)
-            self._copied = 0
         if size > self._copied:
             self._copied += self._append_range(src, self._copied, dst)
 
     @staticmethod
-    def _append_range(src: str, offset: int, dst: str) -> int:
-        """Append ``src[offset:]`` to ``dst``; bytes copied (0 on any
-        miss — a vanished source is a skipped copy, never an error)."""
+    def _append_range(src: Optional[str], offset: int, dst: str) -> int:
+        """Append ``src[offset:]`` to ``dst`` (offsets are uncompressed
+        positions; a ``.gz`` source is decompressed on the way through);
+        bytes copied (0 on any miss — a vanished source is a skipped
+        copy, never an error)."""
+        if not src:  # lint: disable=JH002 -- host path string, never traced
+            return 0
         try:
-            with open(src, "rb") as f:
+            opener = gzip.open if src.endswith(".gz") else open
+            with opener(src, "rb") as f:
                 f.seek(offset)
                 chunk = f.read()
             if chunk:
                 with open(dst, "ab") as out:
                     out.write(chunk)
             return len(chunk)
-        except OSError:
+        except (OSError, EOFError):
             return 0
 
     def maybe_snapshot(self) -> bool:
@@ -359,6 +389,10 @@ class FleetReport:
     goodput: Optional[GoodputReport]
     serving: dict
     torn_snapshots: int
+    # newest measured-profile snapshot per rank (profile.json written by
+    # a periodic or straggler-triggered step capture — docs/
+    # OBSERVABILITY.md "Measured profiling")
+    profiles: Dict[int, dict] = dataclasses.field(default_factory=dict)
 
     def summary(self) -> dict:
         return {
@@ -372,6 +406,8 @@ class FleetReport:
             "goodput": self.goodput.summary() if self.goodput else None,
             "serving": dict(self.serving),
             "torn_snapshots": self.torn_snapshots,
+            "profiles": {str(r): p for r, p
+                         in sorted(self.profiles.items())},
         }
 
 
@@ -545,13 +581,15 @@ class FleetAggregator:
                 stats.generations.append(g)
                 self._fold_metrics(stats, metrics, meta)
                 serving.fold(metrics)
-            for path in _gen_sorted(glob.glob(
-                    os.path.join(d, "events-g*.jsonl"))):
+            for path in _gen_sorted(
+                    glob.glob(os.path.join(d, "events-g*.jsonl"))
+                    + glob.glob(os.path.join(d, "events-g*.jsonl.gz"))):
                 g = _file_gen(path)
                 for rec in _events.read_events(path):
                     rec["_rank"], rec["_gen"] = rank, g
                     events.append(rec)
                 gens.add(g)
+        profiles = self._collect_profiles(rank_dirs)
         self._last_torn = list(torn)
         if not events and not torn \
                 and not any(s.generations for s in ranks.values()):
@@ -564,7 +602,22 @@ class FleetAggregator:
             directory=self.directory, ranks=ranks,
             generations=sorted(gens), events=events, stragglers=stragglers,
             skew_timeline=timeline, goodput=ledger,
-            serving=serving.summary(), torn_snapshots=len(torn))
+            serving=serving.summary(), torn_snapshots=len(torn),
+            profiles=profiles)
+
+    @staticmethod
+    def _collect_profiles(rank_dirs) -> Dict[int, dict]:
+        """Newest ``prof-*/profile.json`` per rank — the measured hot-op
+        snapshot a periodic or straggler-triggered capture wrote into the
+        shared dir (torn files skipped, like every other snapshot)."""
+        from .profiling import latest_profile
+
+        out: Dict[int, dict] = {}
+        for rank, d in rank_dirs:
+            p = latest_profile(d)
+            if p is not None:
+                out[rank] = p
+        return out
 
     def _fold_metrics(self, stats: RankStats, metrics: dict,
                       meta: dict) -> None:
@@ -601,8 +654,12 @@ class FleetAggregator:
         """collect() + emit only findings not seen by a previous poll:
         new ``straggler`` events, their ``fleet_step_skew_seconds``
         observations, the ``straggler_rank`` gauge, and the
-        ``fleet_torn_snapshots_total`` counter. Returns
-        ``(report, new_stragglers)``."""
+        ``fleet_torn_snapshots_total`` counter. Each NEW straggler also
+        gets a capture request dropped into the shared dir
+        (``prof-request-h{rank}.json``) so the flagged rank traces its
+        next step and snapshots the measured timeline back into
+        ``telemetry-h{rank}/`` — docs/OBSERVABILITY.md "Measured
+        profiling". Returns ``(report, new_stragglers)``."""
         report = self.collect()
         for p in getattr(self, "_last_torn", []):
             if p not in self._torn_seen:
@@ -624,6 +681,7 @@ class FleetAggregator:
                 "straggler_rank",
                 "most recently flagged straggler rank").set(s["rank"])
             _events.LOG.emit("straggler", **s)
+            self._request_capture(s)
         for t in report.skew_timeline:
             key = ("skew", t["generation"], t["step"])
             if key in self._seen:
@@ -634,3 +692,24 @@ class FleetAggregator:
                 "per-step cross-rank skew (slowest - median)",
                 unit="s").observe(t["skew_seconds"])
         return report, new
+
+    def _request_capture(self, finding: dict) -> None:
+        """Drop the trigger file the flagged rank's step-capture
+        controller consumes (best-effort, one pending request per rank —
+        the request, the capture and the snapshot are all advisory
+        telemetry and must never fail the poll)."""
+        from .profiling import request_path
+
+        path = request_path(self.directory, finding["rank"])
+        if os.path.exists(path):
+            return  # a request is already pending for this rank
+        try:
+            _atomic_write(path, json.dumps({
+                "reason": "straggler", "kind": finding["kind"],
+                "generation": finding.get("generation"),
+                "step": finding.get("step"),
+                "ratio": finding.get("ratio"),
+                "ts": round(time.time(), 6)}))  # lint: disable=JH003
+        except OSError as e:
+            logger.warning("capture request for rank %s not written: %s",
+                           finding["rank"], e)

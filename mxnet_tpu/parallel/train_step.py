@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import observability as _obs
 from .. import random as _rng
+from ..observability import profiling as _profiling
 from ..gluon.block import _HybridTrace
 from ..ndarray import NDArray
 from .sharding import ShardingRules
@@ -553,27 +554,47 @@ class TrainStep:
         lr = jnp.float32(self.optimizer.learning_rate)
         wd = jnp.float32(self.optimizer.wd)
         gnorm = None
-        if self.amp_state is not None:
-            if obs_on:
-                (self.params, self.opt_state, self.step_count, self.amp_state,
-                 loss, gnorm) = step(self.params, self.opt_state,
-                                     self.step_count, self.amp_state, raws,
-                                     key, lr, wd)
+        # measured profiling (docs/OBSERVABILITY.md): a periodic or
+        # straggler-triggered capture traces THIS dispatch; one global
+        # read + call per step while disarmed. Immediately before the
+        # guarded region — everything fallible after begin must reach
+        # the abort handler, or a raise would leak the trace session
+        ptok = _profiling.step_capture_begin(
+            int(self.optimizer.num_update) + 1)
+        try:
+            if self.amp_state is not None:
+                if obs_on:
+                    (self.params, self.opt_state, self.step_count,
+                     self.amp_state, loss, gnorm) = step(
+                        self.params, self.opt_state, self.step_count,
+                        self.amp_state, raws, key, lr, wd)
+                else:
+                    (self.params, self.opt_state, self.step_count,
+                     self.amp_state, loss) = step(
+                        self.params, self.opt_state, self.step_count,
+                        self.amp_state, raws, key, lr, wd)
+            elif obs_on:
+                (self.params, self.opt_state, self.step_count, loss,
+                 gnorm) = step(self.params, self.opt_state, self.step_count,
+                               raws, key, lr, wd)
             else:
-                (self.params, self.opt_state, self.step_count, self.amp_state,
-                 loss) = step(self.params, self.opt_state, self.step_count,
-                              self.amp_state, raws, key, lr, wd)
-        elif obs_on:
-            (self.params, self.opt_state, self.step_count, loss,
-             gnorm) = step(self.params, self.opt_state, self.step_count,
-                           raws, key, lr, wd)
-        else:
-            self.params, self.opt_state, self.step_count, loss = step(
-                self.params, self.opt_state, self.step_count, raws, key, lr, wd)
-        # host-side mirror (no device sync — loss is returned as a future)
-        self.optimizer.num_update += 1
-        if obs_on:
-            self._record_step(t0, raws, loss, gnorm, cache_key)
+                self.params, self.opt_state, self.step_count, loss = step(
+                    self.params, self.opt_state, self.step_count, raws, key,
+                    lr, wd)
+            # host-side mirror (no device sync — loss is a future)
+            self.optimizer.num_update += 1
+            if obs_on:
+                self._record_step(t0, raws, loss, gnorm, cache_key)
+        except BaseException:
+            # a failed traced step must not leak the live trace session
+            # (it would disable every later capture in the process)
+            _profiling.step_capture_abort(ptok)
+            raise
+        if ptok is not None:
+            # close the traced window AFTER the step was recorded: the
+            # parse/persist/retention overhead never inflates the
+            # train_step_seconds observation of the step it measured
+            _profiling.step_capture_end(ptok, loss)
         self._run_monitors()
         self._check_preemption()
         return loss
@@ -694,29 +715,41 @@ class TrainStep:
             lrs = jnp.full((window,), opt.learning_rate, jnp.float32)
         wd = jnp.float32(opt.wd)
         gnorms = None
-        if self.amp_state is not None:
-            if obs_on:
-                (self.params, self.opt_state, self.step_count, self.amp_state,
-                 losses, gnorms) = fn(self.params, self.opt_state,
-                                      self.step_count, self.amp_state,
-                                      batches, keys, lrs, wd)
+        # measured profiling: one capture covers the whole fused window;
+        # placed immediately before the guarded region so any raise after
+        # begin reaches the abort handler (no leaked trace session)
+        ptok = _profiling.step_capture_begin(
+            int(self.optimizer.num_update) + window)
+        try:
+            if self.amp_state is not None:
+                if obs_on:
+                    (self.params, self.opt_state, self.step_count,
+                     self.amp_state, losses, gnorms) = fn(
+                        self.params, self.opt_state, self.step_count,
+                        self.amp_state, batches, keys, lrs, wd)
+                else:
+                    (self.params, self.opt_state, self.step_count,
+                     self.amp_state, losses) = fn(
+                        self.params, self.opt_state, self.step_count,
+                        self.amp_state, batches, keys, lrs, wd)
+            elif obs_on:
+                (self.params, self.opt_state, self.step_count, losses,
+                 gnorms) = fn(self.params, self.opt_state, self.step_count,
+                              batches, keys, lrs, wd)
             else:
-                (self.params, self.opt_state, self.step_count, self.amp_state,
-                 losses) = fn(self.params, self.opt_state, self.step_count,
-                              self.amp_state, batches, keys, lrs, wd)
-        elif obs_on:
-            (self.params, self.opt_state, self.step_count, losses,
-             gnorms) = fn(self.params, self.opt_state, self.step_count,
-                          batches, keys, lrs, wd)
-        else:
-            self.params, self.opt_state, self.step_count, losses = fn(
-                self.params, self.opt_state, self.step_count, batches, keys,
-                lrs, wd)
-        self._window_dispatches += 1
-        self.optimizer.num_update += window
-        if obs_on:
-            self._record_window(t0, batches, losses, gnorms, window, accum,
-                                cache_key)
+                self.params, self.opt_state, self.step_count, losses = fn(
+                    self.params, self.opt_state, self.step_count, batches,
+                    keys, lrs, wd)
+            self._window_dispatches += 1
+            self.optimizer.num_update += window
+            if obs_on:
+                self._record_window(t0, batches, losses, gnorms, window,
+                                    accum, cache_key)
+        except BaseException:
+            _profiling.step_capture_abort(ptok)
+            raise
+        if ptok is not None:  # after recording — overhead stays out of it
+            _profiling.step_capture_end(ptok, losses)
         self._run_monitors()
         self._check_preemption()
         return losses
@@ -1184,6 +1217,48 @@ class TrainStep:
             carry_indices=tuple(range(n_carry)),
             contract=contract, comm=comm, memory=memory,
             schedule=schedule)
+
+    def profile(self, *batch, steps: int = 2, warmup: int = 1,
+                window: Optional[int] = None, accum: int = 1,
+                trace_dir: Optional[str] = None, calibrate: bool = True,
+                band: float = 3.0):
+        """Trace ``steps`` REAL training steps of this batch signature
+        (after ``warmup`` untraced ones) and return the
+        :class:`~mxnet_tpu.observability.profiling.Capture` — measured
+        per-device op timeline, hot-op ranking, measured step time and
+        compute/collective overlap (docs/OBSERVABILITY.md "Measured
+        profiling"). The dispatch goes through ``__call__``/``run``'s own
+        jit cache, so the traced program IS the production program — and
+        the profiled steps advance the training state exactly like any
+        other steps.
+
+        With ``calibrate=True`` (default) the capture also carries a
+        :class:`~mxnet_tpu.observability.profiling.CalibrationReport`:
+        per-op-class predicted/measured ratios against this program's
+        :meth:`audit` schedule model, flagging roofline-constant drift
+        (``MXNET_TPU_SCHED_*``). ``window=`` profiles the fused k-step
+        scan program instead of the single step (one traced dispatch per
+        window)."""
+        if window:
+            raws = tuple(b._data if isinstance(b, NDArray)
+                         else jnp.asarray(b) for b in batch)
+            lead = (window,) if accum == 1 else (window, accum)
+            stacked = tuple(jnp.broadcast_to(r, lead + r.shape)
+                            for r in raws)
+            if self.batch_sharding is not None:
+                ws = self.window_batch_sharding(accum)
+                stacked = tuple(jax.device_put(s, ws) for s in stacked)
+            fn = lambda: self._run_window(stacked, window, accum)  # noqa: E731
+        else:
+            fn = lambda: self(*batch)  # noqa: E731
+        cap = _profiling.capture(fn, steps=steps, warmup=warmup,
+                                 trace_dir=trace_dir)
+        if calibrate:
+            cap.schedule = self.audit(*batch, window=window,
+                                      accum=accum).schedule
+            cap.calibration = _profiling.calibrate(cap.schedule, cap.report,
+                                                   band=band)
+        return cap
 
     def _record_schedule_bound(self, schedule) -> None:
         """Export the schedule auditor's static bound next to the live

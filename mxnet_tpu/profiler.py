@@ -90,56 +90,49 @@ def dump(finished=True, profile_process="worker"):
 
 
 def _aggregate_xplane(dump_dir):
-    """Parse the dumped XSpace protos into per-op stats.
+    """Parse the dumped XSpace protos into per-(plane, op) stats.
 
     Reference UX: ``src/profiler/aggregate_stats.cc`` ``dumps(reset)`` — a
-    table of (op name, count, total/avg/min/max ms). Here the events come
-    from jaxlib's native XPlane parser over the trace jax.profiler wrote; on
-    TPU the device plane rows are per-fused-computation (XLA's unit of
-    execution), which IS this framework's "op".
+    table of (op name, count, total/avg/min/max ms). The events come from
+    ``observability.profiling``'s XPlane parser over the trace
+    jax.profiler wrote (native ``ProfileData`` when jaxlib ships it, the
+    pure-stdlib wire reader otherwise); on TPU the device plane rows are
+    per-fused-computation (XLA's unit of execution), which IS this
+    framework's "op". Aggregates are keyed by ``(plane, op)`` — one row
+    per device per op, so a multi-device run's per-device timings never
+    merge into one misleading average.
     """
-    try:
-        from jax.profiler import ProfileData
-    except ImportError:  # pragma: no cover - very old jaxlib
-        return {}
-    import glob
+    from .observability import profiling
 
-    stats = {}  # name -> [count, total_ns, min_ns, max_ns]
-    # only the LATEST run directory: the dump dir accumulates one
-    # timestamped subdir per profiling session, and aggregating across all
-    # of them would double-count earlier runs (and other processes sharing
-    # the default dir)
-    run_dirs = sorted(glob.glob(os.path.join(dump_dir, "plugins", "profile", "*")))
-    if not run_dirs:
-        return stats
-    paths = sorted(glob.glob(os.path.join(run_dirs[-1], "*.xplane.pb")))
-    for path in paths:
-        try:
-            data = ProfileData.from_file(path)
-        except Exception:
+    stats = {}  # (plane, name) -> [count, total_ns, min_ns, max_ns]
+    # only the LATEST run directory (parse_trace picks it): the dump dir
+    # accumulates one timestamped subdir per profiling session, and
+    # aggregating across all of them would double-count earlier runs (and
+    # other processes sharing the default dir)
+    timeline = profiling.parse_trace(dump_dir)
+    for plane in timeline.planes:
+        pname = plane.name or ""
+        # keep device planes + the python/TraceMe host plane; skip
+        # bookkeeping planes (task environment, derived lines)
+        if not ("TPU" in pname or "GPU" in pname or "CPU" in pname
+                or "Host" in pname or "python" in pname.lower()):
             continue
-        for plane in data.planes:
-            pname = plane.name or ""
-            # keep device planes + the python/TraceMe host plane; skip
-            # bookkeeping planes (task environment, derived lines)
-            if not ("TPU" in pname or "GPU" in pname or "CPU" in pname
-                    or "Host" in pname or "python" in pname.lower()):
-                continue
-            for line in plane.lines:
-                for ev in line.events:
-                    name = ev.name
-                    dur = getattr(ev, "duration_ns", 0) or 0
-                    if not name or dur <= 0:
-                        continue
-                    # drop python-tracer stack frames ($file.py:42 fn) —
-                    # the reference table is per-op, not per-frame
-                    if name.startswith(("$", "<frozen")) or ".py:" in name:
-                        continue
-                    rec = stats.setdefault(name, [0, 0, float("inf"), 0])
-                    rec[0] += 1
-                    rec[1] += dur
-                    rec[2] = min(rec[2], dur)
-                    rec[3] = max(rec[3], dur)
+        for line in plane.lines:
+            for ev in line.events:
+                name = ev.name
+                dur = ev.dur_ns
+                if not name or dur <= 0:
+                    continue
+                # drop python-tracer stack frames ($file.py:42 fn) —
+                # the reference table is per-op, not per-frame
+                if name.startswith(("$", "<frozen")) or ".py:" in name:
+                    continue
+                rec = stats.setdefault((pname, name),
+                                       [0, 0, float("inf"), 0])
+                rec[0] += 1
+                rec[1] += dur
+                rec[2] = min(rec[2], dur)
+                rec[3] = max(rec[3], dur)
     return stats
 
 
@@ -154,10 +147,18 @@ def dumps(reset=False):
 
     header = f"{'Name':<48} {'Count':>8} {'Total(ms)':>12} {'Avg(ms)':>10} {'Min(ms)':>10} {'Max(ms)':>10}"
     lines = ["Profile Statistics", header, "-" * len(header)]
+    xstats = _aggregate_xplane(_state["dir"])
+    planes = sorted({p for p, _n in xstats})
+    plane_totals = {}
     rows = []
-    for name, (count, total_ns, mn, mx) in _aggregate_xplane(_state["dir"]).items():
-        rows.append((name, count, total_ns / 1e6, total_ns / 1e6 / count,
+    for (plane, name), (count, total_ns, mn, mx) in xstats.items():
+        # one row per (plane, op): the plane tag keeps per-device timings
+        # apart on multi-device runs (single-plane dumps stay unadorned)
+        shown = name if len(planes) <= 1 \
+            else f"{name} [{plane.split('/')[-1].replace('device:', '')}]"
+        rows.append((shown, count, total_ns / 1e6, total_ns / 1e6 / count,
                      mn / 1e6, mx / 1e6))
+        plane_totals[plane] = plane_totals.get(plane, 0.0) + total_ns / 1e6
     hist = REGISTRY.get(_SCOPE_METRIC)
     if hist is not None:
         for labels, s in hist.series():
@@ -170,6 +171,10 @@ def dumps(reset=False):
     for name, count, tot, avg, mn, mx in rows:
         lines.append(f"{name[:48]:<48} {count:>8} {tot:>12.3f} {avg:>10.3f} "
                      f"{mn:>10.3f} {mx:>10.3f}")
+    if len(plane_totals) > 1:
+        lines.append("Per-device totals")
+        for plane, tot in sorted(plane_totals.items()):
+            lines.append(f"{plane[:48]:<48} {'':>8} {tot:>12.3f}")
     if reset:
         REGISTRY.reset(_SCOPE_METRIC)
     return "\n".join(lines)

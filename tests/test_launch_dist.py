@@ -427,6 +427,20 @@ _STRAGGLER_CHILD = textwrap.dedent("""
             with open(os.path.join(os.environ["MXNET_TPU_FLEET_DIR"],
                                    "pid-r2"), "w") as f:
                 f.write(str(os.getpid()))
+
+    # straggler-triggered capture (ISSUE 14): the aggregator flags rank 2
+    # and drops a prof-request into the fleet dir; the flagged rank's
+    # step-capture probe consumes it and traces its next step. Rank 2
+    # keeps stepping (bounded) until its snapshot lands so the
+    # supervisor's 3s poll cadence can't race the loop's natural end.
+    if rank == 2:
+        import glob, time
+        fdir = os.environ["MXNET_TPU_FLEET_DIR"]
+        deadline = time.time() + 90
+        while time.time() < deadline and not glob.glob(os.path.join(
+                fdir, "telemetry-h2", "prof-*", "profile.json")):
+            ts(x, y)
+            time.sleep(0.02)
     obs.shutdown()
     print(f"STRAG-RANK{rank}-DONE", flush=True)
 """)
@@ -509,6 +523,22 @@ def test_fleet_straggler_sigstop(tmp_path):
     assert s["skew_timeline"], "skew timeline empty"
     # supervisor-side surfacing: the elastic log names the slow rank
     assert "[fleet] straggler: rank=2" in err, tail
+
+    # straggler-triggered capture (ISSUE 14 acceptance): the aggregator's
+    # prof-request made the flagged rank trace one step and snapshot the
+    # measured timeline into the fleet dir — with real device op rows
+    import glob as _glob
+    import json
+
+    snaps = _glob.glob(str(fleet / "telemetry-h2" / "prof-*"
+                           / "profile.json"))
+    assert snaps, "no straggler-triggered trace snapshot in the fleet dir"
+    prof = json.loads(open(snaps[0]).read())
+    assert prof["meta"]["trigger"] == "straggler"
+    assert prof["meta"]["rank"] == 2
+    assert prof["report"]["n_op_rows"] > 0
+    # and the merged fleet report carries the measured hot-op snapshot
+    assert "2" in s.get("profiles", {}), list(s.get("profiles", {}))
 
 
 @pytest.mark.timeout(600)

@@ -123,18 +123,21 @@ def test_event_log_rotation(tmp_path):
     for i in range(40):
         log.emit("tick", i=i, pad="x" * 64)
     log.close()
-    assert os.path.exists(path + ".1"), "rotation never happened"
+    # rotated segments are gzip-compressed, numbered oldest-first
+    assert os.path.exists(path + ".1.gz"), "rotation never happened"
     recs = ev_mod.read_events(path)
     # nothing lost across a single rotation boundary, order preserved
     assert [r["i"] for r in recs] == list(range(40))
-    # directory-mode read finds the same records
+    # directory-mode read finds the same records (gz read transparently)
     assert len(ev_mod.read_events(str(tmp_path))) == 40
-    # many rotations: disk stays bounded at two files holding the tail
+    # many rotations at the default keep_bytes=0: disk stays bounded at
+    # the live file + exactly ONE rotated segment holding the tail
     log2 = ev_mod.EventLog()
     log2.configure(str(tmp_path / "e2.jsonl"), rotate_bytes=512)
     for i in range(64):
         log2.emit("tick", i=i, pad="x" * 64)
     log2.close()
+    assert len(ev_mod.rotated_segments(str(tmp_path / "e2.jsonl"))) == 1
     tail = [r["i"] for r in ev_mod.read_events(str(tmp_path / "e2.jsonl"))]
     assert tail == list(range(tail[0], 64)) and len(tail) >= 2
 

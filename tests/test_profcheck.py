@@ -1,0 +1,79 @@
+"""Measured-profiling gate (ISSUE 14, docs/OBSERVABILITY.md "Measured
+profiling"): `make profcheck` as a test — real traces of the shared
+golden families produce non-empty op timelines, the calibration table is
+emitted against the committed sched goldens, measured overlap sits next
+to the predicted fraction, and the --inject-empty-trace failure hook
+fails the build.
+
+Runs tools/profcheck.py in-process (importlib) so the memoized family
+builders (tools/families.py) are shared with the other gate tests in
+this process.
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_mod", os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def profcheck():
+    return _load("profcheck")
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off_after():
+    # the gate enables telemetry process-wide; later tests in this
+    # session must not inherit it
+    from mxnet_tpu import observability as obs
+
+    yield
+    obs.disable()
+
+
+def _verdict(capsys):
+    out = capsys.readouterr().out
+    row, _ = json.JSONDecoder().raw_decode(out, out.index("{"))
+    return row, out
+
+
+def test_gate_passes_and_reports_measured_next_to_predicted(profcheck,
+                                                            capsys):
+    """ISSUE 14 acceptance: non-empty measured op timeline for >= 2
+    shared golden families, a calibration table with both sides
+    populated, and measured overlap reported 1:1 next to
+    ScheduleReport.overlap_fraction (zero allowed on CPU)."""
+    rc = profcheck.main([])
+    row, _ = _verdict(capsys)
+    assert rc == 0 and row["ok"], row.get("failures")
+    assert set(row["families"]) == {"step_fsdp", "decode"}
+    for name, fam in row["families"].items():
+        assert fam["n_op_rows"] > 0, name
+        assert fam["measured_step_seconds"] > 0, name
+        assert 0.0 <= fam["overlap_measured"] <= 1.0
+        assert fam["overlap_predicted"] is not None
+        cal = fam["calibration"]
+        assert any(r["predicted_seconds"] > 0 and r["measured_seconds"] > 0
+                   for r in cal["rows"]), name
+    # the predicted side is anchored on the committed sched goldens
+    assert row["families"]["step_fsdp"]["golden_critical_path_seconds"] > 0
+    assert row["captures_total"] >= 2
+
+
+def test_injected_empty_trace_fails_gate(profcheck, capsys):
+    """The failure path stays tested: an empty trace (capture or parser
+    broken) must fail the build with the op-timeline check."""
+    rc = profcheck.main(["--inject-empty-trace"])
+    row, out = _verdict(capsys)
+    assert rc == 1 and not row["ok"]
+    assert any("EMPTY" in f for f in row["failures"]), row["failures"]

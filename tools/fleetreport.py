@@ -124,6 +124,27 @@ def render(s: dict) -> str:
             w(f"   exposed-comm share of critical path: "
               f"{max(exposed):.3f}")
 
+    profiles = s.get("profiles", {})
+    if profiles:
+        # newest capture across ranks: the measured hot-op list sits
+        # right under the static bound it must be read against
+        # (docs/OBSERVABILITY.md "Measured profiling")
+        rank, prof = max(profiles.items(),
+                         key=lambda kv: kv[1].get("meta", {}).get("ts", 0))
+        meta = prof.get("meta", {})
+        r = prof.get("report", {})
+        w(f"-- hot ops (measured profile: rank {rank}, "
+          f"step={meta.get('step')}, trigger={meta.get('trigger')})")
+        st = r.get("step_seconds") or {}
+        w(f"   steps={r.get('steps')} step mean={_fmt_s(st.get('mean'))} "
+          f"op_rows={r.get('n_op_rows')} "
+          f"measured overlap={r.get('overlap_fraction')}")
+        for h in r.get("hot_ops", [])[:10]:
+            w(f"   {h['name'][:40]:<40} {h['op_class']:<12} "
+              f"n={h['count']:<5} self={h['self_ns'] / 1e6:.3f} ms"
+              + (f" bytes={h['bytes']}" if h.get("bytes") is not None
+                 else ""))
+
     sv = s["serving"]
     if sv:
         w("-- serving")

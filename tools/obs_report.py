@@ -193,7 +193,37 @@ def summarize(run_dir):
             d = per_site.setdefault(site, {"ok": 0, "failed": 0})
             d["ok" if ok else "failed"] += int(s["value"])
         summary["retries"] = per_site
+
+    # -- measured profile (docs/OBSERVABILITY.md "Measured profiling") -------
+    # the newest capture snapshot under the run dir (periodic captures
+    # land in {run_dir}/prof/ when telemetry is on), rendered next to the
+    # achieved-MFU gauges and the schedule auditor's static bound so the
+    # measured hot list and the static ceiling sit in one report
+    def _gauge(name):
+        m = metrics.get(name)
+        if not m or not m.get("series"):
+            return None
+        return m["series"][-1]["value"]
+
+    prof = _latest_profile(run_dir)
+    if prof is not None:
+        r = prof.get("report", {})
+        summary["profile"] = {
+            "meta": prof.get("meta", {}),
+            "steps": r.get("steps"),
+            "step_seconds": r.get("step_seconds"),
+            "hot_ops": r.get("hot_ops", [])[:10],
+            "overlap_fraction": r.get("overlap_fraction"),
+            "mfu": _gauge("train_mfu"),
+            "mfu_bound": _gauge("train_mfu_bound"),
+        }
     return summary
+
+
+def _latest_profile(run_dir):
+    from mxnet_tpu.observability.profiling import latest_profile
+
+    return latest_profile(run_dir)
 
 
 def render(s):
@@ -244,6 +274,21 @@ def render(s):
         w("-- retries")
         for site, r in sorted(s["retries"].items()):
             w(f"   {site}: ok={r['ok']} failed={r['failed']}")
+    p = s.get("profile")
+    if p:
+        meta = p.get("meta", {})
+        ctx = " ".join(f"{k}={meta[k]}" for k in ("step", "trigger")
+                       if k in meta)
+        w(f"-- hot ops (measured profile{', ' + ctx if ctx else ''})")
+        if p.get("mfu") is not None or p.get("mfu_bound") is not None:
+            w(f"   achieved mfu={p['mfu'] if p['mfu'] is not None else '-'}"
+              f"  static bound={p['mfu_bound'] if p['mfu_bound'] is not None else '-'}"
+              f"  measured overlap={p.get('overlap_fraction')}")
+        for h in p.get("hot_ops", []):
+            w(f"   {h['name'][:40]:<40} {h['op_class']:<12} "
+              f"n={h['count']:<5} self={h['self_ns'] / 1e6:.3f} ms"
+              + (f" bytes={h['bytes']}" if h.get("bytes") is not None
+                 else ""))
     return "\n".join(out)
 
 
