@@ -34,8 +34,11 @@
 #   fast    - pytest without @slow (target < 10 min on 8 virtual CPU devs)
 #   slow    - the @slow remainder (model compiles, 4-process launches)
 #   ci      - sanity + lint + native + fast + audit + shardcheck +
-#             memcheck + schedcheck + chaos-elastic (the pre-merge gate;
-#             chaos-elastic is the slow 4-process kill-a-worker drill)
+#             memcheck + schedcheck + chaos-elastic + chaos-serve (the
+#             pre-merge gate; chaos-elastic is the slow 4-process
+#             kill-a-worker drill, chaos-serve the serving-resilience
+#             drill: injected gen.* faults + deadlines + accept-rate
+#             collapse, tools/servedrill.py)
 #   test    - full suite (ci + slow), what the driver effectively runs
 
 PY ?= python
@@ -46,9 +49,9 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity lint audit shardcheck memcheck schedcheck profcheck native fast slow test chaos chaos-elastic obs obsfleet perfwin genbench ampbench bench clean
+.PHONY: ci sanity lint audit shardcheck memcheck schedcheck profcheck native fast slow test chaos chaos-elastic chaos-serve obs obsfleet perfwin genbench ampbench bench clean
 
-ci: sanity lint native fast audit shardcheck memcheck schedcheck profcheck chaos-elastic obsfleet
+ci: sanity lint native fast audit shardcheck memcheck schedcheck profcheck chaos-elastic chaos-serve obsfleet
 
 sanity:
 	$(PY) -m compileall -q mxnet_tpu tools tests examples bench.py __graft_entry__.py
@@ -131,6 +134,17 @@ chaos: native
 # cause + old/new world size
 chaos-elastic: native
 	$(PY) -m pytest tests/test_launch_dist.py -q -k "elastic"
+
+# serving chaos drill (docs/RESILIENCE.md "Serving resilience"): batcher
+# traffic on a speculative engine under injected gen.* faults, deadline
+# pressure, cancellations, a shed-inducing submit burst, and a forced
+# accept-rate collapse — asserts no hang, explicit finish reasons on every
+# request, bit-identical surviving rows vs an undisturbed baseline,
+# speculative fallback + re-arm observed via telemetry, and a clean
+# drained state. The failure path stays tested via
+# `python tools/servedrill.py --inject-leak`
+chaos-serve: native
+	$(PY) tools/servedrill.py
 
 # observability gate (docs/OBSERVABILITY.md): a 2-step LeNet train with
 # telemetry on must yield a non-empty obs_report summary covering step/

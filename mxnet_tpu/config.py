@@ -92,6 +92,45 @@ _KNOBS: Dict[str, tuple] = {
                            "heartbeat staleness after which a peer counts "
                            "as lost and the worker requests a mesh "
                            "re-formation"),
+    # -- serving resilience (docs/RESILIENCE.md "Serving resilience") --------
+    "serve_default_deadline": (float, 0.0, ("MXNET_TPU_SERVE_DEADLINE",),
+                               "default per-request deadline in seconds "
+                               "applied at submit when the caller passes "
+                               "none (0 = no deadline)"),
+    "serve_max_queue": (int, 0, ("MXNET_TPU_SERVE_MAX_QUEUE",),
+                        "bounded admission queue: submits past this depth "
+                        "are shed per serve_queue_policy (0 = unbounded)"),
+    "serve_queue_policy": (str, "reject", ("MXNET_TPU_SERVE_QUEUE_POLICY",),
+                           "full-queue policy: 'reject' sheds the NEW "
+                           "request; 'shed' evicts the oldest queued "
+                           "request already past its deadline (falls back "
+                           "to reject when none is)"),
+    "serve_shed_page_floor": (int, 0, ("MXNET_TPU_SERVE_SHED_PAGE_FLOOR",),
+                              "load-shed watermark: with a backlog queued, "
+                              "shed new submits while free KV pages are "
+                              "below this floor (0 = off)"),
+    "serve_head_aging_steps": (int, 8, ("MXNET_TPU_SERVE_HEAD_AGING_STEPS",),
+                               "admission aging guard: after this many "
+                               "step-boundary deferrals of the queue head "
+                               "on free pages, freed pages are reserved "
+                               "for the head and bypass admission stops "
+                               "(prevents head starvation behind a stream "
+                               "of small requests)"),
+    "serve_spec_window": (int, 8, ("MXNET_TPU_SERVE_SPEC_WINDOW",),
+                          "speculative accept-rate window (rounds) the "
+                          "degradation governor decides on"),
+    "serve_spec_floor": (float, 0.125, ("MXNET_TPU_SERVE_SPEC_FLOOR",),
+                         "windowed accept rate below which speculation "
+                         "falls back to plain paged decode (break-even "
+                         "is ~1/speculate_k)"),
+    "serve_spec_cooldown": (int, 16, ("MXNET_TPU_SERVE_SPEC_COOLDOWN",),
+                            "plain decode steps before a fallen-back "
+                            "engine re-arms speculation"),
+    "serve_watchdog_s": (float, 0.0, ("MXNET_TPU_SERVE_WATCHDOG_S",),
+                         "soft per-dispatch timeout for the serving loop: "
+                         "a dispatch exceeding it emits gen_stuck_dispatch "
+                         "(event + counter) instead of hanging silently "
+                         "(0 = off)"),
     # -- compilation (docs/PERFORMANCE.md) -----------------------------------
     "compile_cache": (str, "", ("MXNET_TPU_COMPILE_CACHE",),
                       "persistent XLA compilation-cache directory "

@@ -14,7 +14,12 @@ Two pieces:
   - :class:`ContinuousBatcher` — slot-based continuous batching: queued
     requests are admitted into free rows of the static decode batch at step
     boundaries (page-bounded on a paged engine), so serving never changes
-    a shape and never recompiles.
+    a shape and never recompiles. Also the serving-resilience layer
+    (docs/RESILIENCE.md "Serving resilience"): per-request deadlines and
+    cancellation with immediate page reclaim, overload shedding, an
+    admission aging guard against head starvation, accept-rate-governed
+    fallback from speculative to plain decode, a dispatch watchdog, and
+    retried ``gen.*`` fault sites — chaos-gated by ``make chaos-serve``.
 """
 from .engine import GenerationEngine, SamplingConfig  # noqa: F401
 from .batcher import ContinuousBatcher, GenRequest  # noqa: F401

@@ -4,21 +4,51 @@ The decode batch is a fixed (B, …) shape; a *slot* is one row of it.
 Queued requests are admitted into free slots only at step boundaries —
 admission is a batch-1 prefill program writing one cache row, so joining
 traffic never changes a shape and never recompiles anything. Finished rows
-(EOS, token budget, cache end, or page exhaustion) free their slot — and,
-on a paged engine, their pages — for the next request.
+(EOS, token budget, cache end, page exhaustion, deadline, cancellation)
+free their slot — and, on a paged engine, their pages — for the next
+request.
 
 On a **paged** engine (docs/INFERENCE.md "Paged cache") admission is
 bounded by free *pages*, not just free slots: a request is admitted only
 when the pool can cover its prompt; otherwise it stays queued and the
 deferral is counted (``gen_admission_rejects_total{reason="free_pages"}``).
-Prompts that could never fit (no bucket, or more pages than the whole
-pool) are rejected at ``submit`` with the matching reason, instead of
-overflowing mid-decode.
+While the head is parked on pages, *smaller* later requests may bypass it
+into free slots (the head keeps its queue position) — bounded by an
+**aging guard**: after ``serve_head_aging_steps`` deferred boundaries the
+bypass stops and freed pages are *reserved* for the head
+(``engine.reserve_pages``), so a large request can never starve forever
+behind a stream of small ones. Prompts that could never fit (no bucket,
+or more pages than the whole pool) are rejected at ``submit`` with the
+matching reason, instead of overflowing mid-decode.
 
-On a **speculative** engine each step is one draft+verify round emitting
-up to ``speculate_k + 1`` tokens per row; outputs are truncated at each
-request's token budget, so results are identical to non-speculative
-serving.
+Serving resilience (docs/RESILIENCE.md "Serving resilience"):
+
+  - **deadlines** — requests carry ``deadline_s``; at every step boundary
+    expired queued requests are dropped before admission and expired
+    active rows are cancelled (finish reason ``"deadline"``), freeing
+    their pages immediately through the same trash-page-safe reclaim as
+    EOS;
+  - **cancellation** — ``cancel(request_id)`` (or ``req.cancel()``) marks
+    a request; the next step boundary applies it (``"cancelled"``) with
+    the identical slot/page reclaim — surviving rows are never perturbed;
+  - **overload control** — a bounded admission queue
+    (``serve_max_queue``) with policy ``"reject"`` (shed the new request)
+    or ``"shed"`` (evict the oldest queued request already past its
+    deadline), plus a free-page load-shed watermark
+    (``serve_shed_page_floor``). Shed requests finish with reason
+    ``"shed"`` and are counted (``gen_shed_total{cause=}``,
+    ``gen_queue_age_seconds{outcome=}``);
+  - **degrade-to-safe speculation** — on a speculative engine a
+    :class:`~mxnet_tpu.resilience.serving.SpeculationGovernor` watches the
+    windowed accept rate and falls back to the plain paged decode step
+    (token-identical) when it collapses, re-arming after a cooldown;
+  - **dispatch watchdog** — every compiled dispatch runs under a soft
+    ``serve_watchdog_s`` timeout that emits ``gen_stuck_dispatch``
+    (program family + step id) instead of hanging the server silently;
+  - **fault sites** — engine dispatches fire ``gen.prefill`` /
+    ``gen.decode`` / ``gen.verify`` and run under
+    :func:`~mxnet_tpu.resilience.retry.retry_call`, so ``make
+    chaos-serve`` can prove transient serving faults are absorbed.
 
 Serving telemetry (docs/OBSERVABILITY.md):
 
@@ -28,6 +58,9 @@ Serving telemetry (docs/OBSERVABILITY.md):
                                 per request;
   - ``gen_queue_depth``       — requests waiting for a slot (gauge);
   - ``gen_active_slots``      — rows currently decoding (gauge);
+  - ``gen_queue_age_seconds{outcome=}`` — time spent queued, by how the
+                                wait ended (admitted/shed/deadline/
+                                cancelled);
   - ``gen_requests_total{reason=...}`` — completions by finish reason;
   - ``gen_admission_rejects_total{reason=...}`` — submit-time rejects and
                                 page-bounded admission deferrals.
@@ -40,28 +73,51 @@ from collections import deque
 from typing import List, Optional, Sequence
 
 from .. import observability as _obs
+from ..resilience import retry as _retry
+from ..resilience import serving as _serving
 
 __all__ = ["ContinuousBatcher", "GenRequest"]
+
+#: every way a request can terminate — the chaos-serve gate asserts each
+#: submitted request lands on exactly one of these
+FINISH_REASONS = ("eos", "length", "cache_full", "page_exhausted",
+                  "deadline", "cancelled", "shed")
 
 
 class GenRequest:
     """Handle for one submitted generation request."""
 
-    def __init__(self, req_id: int, prompt, max_new_tokens: int):
+    def __init__(self, req_id: int, prompt, max_new_tokens: int,
+                 deadline_s: Optional[float] = None,
+                 clock=time.perf_counter):
         self.id = req_id
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
         self.output: List[int] = []
         self.slot: Optional[int] = None
-        # eos | length | cache_full | page_exhausted
+        # one of FINISH_REASONS once done
         self.finish_reason: Optional[str] = None
-        self.submit_t = time.perf_counter()
+        self.submit_t = clock()
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        #: absolute expiry point on the batcher's clock (None = no deadline)
+        self.deadline_t = None if self.deadline_s is None \
+            else self.submit_t + self.deadline_s
         self.first_token_t: Optional[float] = None
         self.finish_t: Optional[float] = None
+        self.cancel_requested = False
 
     @property
     def done(self) -> bool:
         return self.finish_reason is not None
+
+    def cancel(self) -> None:
+        """Request cancellation; applied at the next step boundary (the
+        slot and its pages are reclaimed there, finish reason
+        ``"cancelled"``). Idempotent; a no-op once the request is done."""
+        self.cancel_requested = True
+
+    def expired(self, now: float) -> bool:
+        return self.deadline_t is not None and now >= self.deadline_t
 
     def result(self) -> List[int]:
         if not self.done:
@@ -76,16 +132,71 @@ class GenRequest:
 
 
 class ContinuousBatcher:
-    """FIFO admission of queued requests into free decode slots."""
+    """FIFO admission of queued requests into free decode slots, with
+    deadlines, cancellation, overload shedding, and degrade-to-safe
+    speculative decoding (see module docstring). Constructor knobs default
+    to the ``serve_*`` config entries (``MXNET_TPU_SERVE_*``); pass
+    ``clock=`` to drive deadline arithmetic from a fake clock in tests."""
 
-    def __init__(self, engine):
+    def __init__(self, engine, max_queue: Optional[int] = None,
+                 queue_policy: Optional[str] = None,
+                 shed_page_floor: Optional[int] = None,
+                 head_aging_steps: Optional[int] = None,
+                 default_deadline_s: Optional[float] = None,
+                 spec_window: Optional[int] = None,
+                 spec_floor: Optional[float] = None,
+                 spec_cooldown: Optional[int] = None,
+                 watchdog_s: Optional[float] = None,
+                 retry_policy=None, clock=None):
+        from .. import config
+
         self.engine = engine
         self._queue: deque = deque()
         self._slots: List[Optional[GenRequest]] = [None] * engine.batch_size
         self._ids = itertools.count()
+        self._clock = clock or time.perf_counter
+        self.max_queue = int(max_queue if max_queue is not None
+                             else config.get("serve_max_queue"))
+        self.queue_policy = str(queue_policy if queue_policy is not None
+                                else config.get("serve_queue_policy"))
+        if self.queue_policy not in ("reject", "shed"):
+            raise ValueError(f"unknown queue policy {self.queue_policy!r}")
+        self.shed_page_floor = int(
+            shed_page_floor if shed_page_floor is not None
+            else config.get("serve_shed_page_floor"))
+        self.head_aging_steps = int(
+            head_aging_steps if head_aging_steps is not None
+            else config.get("serve_head_aging_steps"))
+        self.default_deadline_s = float(
+            default_deadline_s if default_deadline_s is not None
+            else config.get("serve_default_deadline"))
+        self._retry_policy = retry_policy or _retry.RetryPolicy()
+        # one policy governs every serving retry, including the engine's
+        # in-round gen.verify retry
+        engine.retry_policy = self._retry_policy
+        self._watchdog = _serving.DispatchWatchdog(
+            float(watchdog_s if watchdog_s is not None
+                  else config.get("serve_watchdog_s")))
+        self.governor = None
+        if getattr(engine, "speculative", False):
+            self.governor = _serving.SpeculationGovernor(
+                window=int(spec_window if spec_window is not None
+                           else config.get("serve_spec_window")),
+                floor=float(spec_floor if spec_floor is not None
+                            else config.get("serve_spec_floor")),
+                cooldown=int(spec_cooldown if spec_cooldown is not None
+                             else config.get("serve_spec_cooldown")))
+        self._step_id = 0
+        self._head_id: Optional[int] = None
+        self._head_deferrals = 0
 
     # -- client side ---------------------------------------------------------
-    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32) -> GenRequest:
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
+               deadline_s: Optional[float] = None) -> GenRequest:
+        """Queue a request. Raises ``ValueError`` for prompts that could
+        never be served (no bucket / more pages than the pool); returns an
+        already-finished handle (``finish_reason == "shed"``) when overload
+        control sheds it — callers must check ``req.done``."""
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if len(prompt) < 1:
@@ -105,10 +216,43 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt needs {self.engine.pages_for(len(prompt))} pages; "
                 f"the whole pool holds {self.engine.num_pages}")
-        req = GenRequest(next(self._ids), prompt, max_new_tokens)
+        if deadline_s is None and self.default_deadline_s > 0:
+            deadline_s = self.default_deadline_s
+        req = GenRequest(next(self._ids), prompt, max_new_tokens,
+                         deadline_s=deadline_s, clock=self._clock)
+        now = req.submit_t
+        # -- overload control (docs/RESILIENCE.md "Serving resilience") ------
+        if (self.engine.paged and self.shed_page_floor > 0
+                and self.engine.free_pages < self.shed_page_floor
+                and (self._queue or self.active == self.engine.batch_size)):
+            return self._shed(req, now, cause="page_floor")
+        if self.max_queue > 0 and len(self._queue) >= self.max_queue:
+            victim = None
+            if self.queue_policy == "shed":
+                victim = next((r for r in self._queue if r.expired(now)),
+                              None)
+            if victim is None:
+                return self._shed(req, now, cause="queue_full")
+            self._queue.remove(victim)
+            self._shed(victim, now, cause="queue_full")
         self._queue.append(req)
         self._gauges()
         return req
+
+    def cancel(self, req_or_id) -> bool:
+        """Mark a request for cancellation by handle or id. The next step
+        boundary reclaims its slot and pages (finish reason
+        ``"cancelled"``). Returns False for unknown/finished requests."""
+        if isinstance(req_or_id, GenRequest):
+            req = req_or_id if not req_or_id.done else None
+        else:
+            req = next((r for r in list(self._queue) + self._slots
+                        if r is not None and r.id == req_or_id
+                        and not r.done), None)
+        if req is None:
+            return False
+        req.cancel()
+        return True
 
     @property
     def pending(self) -> int:
@@ -118,20 +262,57 @@ class ContinuousBatcher:
     def active(self) -> int:
         return sum(r is not None for r in self._slots)
 
+    @property
+    def watchdog(self) -> _serving.DispatchWatchdog:
+        return self._watchdog
+
     # -- serving loop --------------------------------------------------------
     def _gauges(self):
         _obs.gauge("gen_queue_depth",
                    "requests waiting for a decode slot").set(len(self._queue))
         _obs.gauge("gen_active_slots", "decode rows in flight").set(self.active)
 
+    def _queue_age(self, req: GenRequest, now: float, outcome: str):
+        _obs.histogram("gen_queue_age_seconds",
+                       "time spent in the admission queue, by outcome",
+                       unit="s").observe(max(0.0, now - req.submit_t),
+                                         outcome=outcome)
+
+    def _shed(self, req: GenRequest, now: float, cause: str) -> GenRequest:
+        req.finish_reason = "shed"
+        req.finish_t = now
+        _obs.counter("gen_requests_total",
+                     "completed generation requests").inc(reason="shed")
+        _obs.counter("gen_shed_total",
+                     "requests shed by overload control").inc(cause=cause)
+        self._queue_age(req, now, "shed")
+        return req
+
+    def _finish_queued(self, req: GenRequest, now: float, reason: str):
+        """Terminate a request that never reached a slot (deadline expiry
+        or cancellation while queued)."""
+        req.finish_reason = reason
+        req.finish_t = now
+        _obs.counter("gen_requests_total",
+                     "completed generation requests").inc(reason=reason)
+        if reason == "deadline":
+            _obs.counter("gen_deadline_expired_total",
+                         "requests expired by their deadline").inc(
+                             where="queue")
+        self._queue_age(req, now, reason)
+
     def _finish(self, slot: int, reason: str):
         req = self._slots[slot]
         self._slots[slot] = None
         self.engine.release_slot(slot)
         req.finish_reason = reason
-        req.finish_t = time.perf_counter()
+        req.finish_t = self._clock()
         _obs.counter("gen_requests_total", "completed generation requests").inc(
             reason=reason)
+        if reason == "deadline":
+            _obs.counter("gen_deadline_expired_total",
+                         "requests expired by their deadline").inc(
+                             where="slot")
         gen = len(req.output) - 1  # tokens after the TTFT token
         span = req.finish_t - (req.first_token_t or req.submit_t)
         if gen > 0 and span > 0:
@@ -139,36 +320,115 @@ class ContinuousBatcher:
                            "per-request generation rate after first token",
                            unit="tokens/s").observe(gen / span)
 
-    def _admit(self):
-        """Step-boundary admission: fill free slots FIFO. Each admission is
-        one bucketed prefill (no shape change for the running rows). On a
-        paged engine a request is only admitted when the pool can cover its
-        prompt — FIFO order is preserved (no later request jumps a parked
-        head-of-queue), the deferral is counted."""
-        for slot in range(self.engine.batch_size):
+    def _sweep(self, now: float):
+        """Step-boundary housekeeping: apply cancellations and deadline
+        expiry to queued requests and active slots. Slot reclaim goes
+        through ``release_slot`` — pages free immediately and the device
+        page-table row is cleared before the next dispatch writes
+        anything, so surviving rows can never be corrupted."""
+        if self._queue:
+            keep: deque = deque()
+            for req in self._queue:
+                if req.cancel_requested:
+                    self._finish_queued(req, now, "cancelled")
+                elif req.expired(now):
+                    self._finish_queued(req, now, "deadline")
+                else:
+                    keep.append(req)
+            self._queue = keep
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            if req.cancel_requested:
+                self._finish(slot, "cancelled")
+            elif req.expired(now):
+                self._finish(slot, "deadline")
+
+    def _admit_into(self, slot: int, req: GenRequest, now: float):
+        """One bucketed batch-1 prefill under the retry policy + watchdog
+        (fault site ``gen.prefill`` fires inside the engine, before any
+        allocator mutation)."""
+        req.slot = slot
+        self._slots[slot] = req
+        self._queue_age(req, now, "admitted")
+
+        def _dispatch():
+            # the watchdog arms per ATTEMPT (inside the retried closure):
+            # retry backoff sleeps must never read as a stuck dispatch
+            with self._watchdog.guard("prefill", self._step_id):
+                return self.engine.prefill(req.prompt, slot)
+
+        tok = _retry.retry_call(_dispatch, site="gen.prefill",
+                                policy=self._retry_policy)
+        req.first_token_t = self._clock()
+        _obs.histogram("ttft_seconds", "submit -> first sampled token",
+                       unit="s").observe(req.first_token_t - req.submit_t)
+        req.output.append(tok)
+        if self.engine.done[slot]:  # first token was EOS
+            self._finish(slot, "eos")
+        elif req.max_new_tokens == 1:
+            self._finish(slot, "length")
+
+    def _admit(self, now: float):
+        """Step-boundary admission: fill free slots FIFO. On a paged
+        engine the head is only admitted when the pool covers its prompt;
+        while it is parked, smaller later requests may bypass it — until
+        the aging guard reserves freed pages for the head (see module
+        docstring)."""
+        eng = self.engine
+        deferral_counted = False
+        for slot in range(eng.batch_size):
             if not self._queue:
                 break
             if self._slots[slot] is not None:
                 continue
-            if (self.engine.paged
-                    and self.engine.free_pages
-                    < self.engine.pages_for(len(self._queue[0].prompt))):
+            head = self._queue[0]
+            if not eng.paged:
+                self._admit_into(slot, self._queue.popleft(), now)
+                continue
+            need = eng.pages_for(len(head.prompt))
+            if eng.free_pages >= need:
+                eng.reserve_pages(0)
+                self._head_id = None
+                self._head_deferrals = 0
+                self._admit_into(slot, self._queue.popleft(), now)
+                continue
+            # head parked on pages: ONE deferral per boundary, however
+            # many free slots re-evaluate it
+            if not deferral_counted:
+                deferral_counted = True
                 _obs.counter("gen_admission_rejects_total",
                              "requests rejected or deferred at admission").inc(
                                  reason="free_pages")
+                if head.id != self._head_id:
+                    self._head_id = head.id
+                    self._head_deferrals = 0
+                self._head_deferrals += 1
+            if (self.head_aging_steps > 0
+                    and self._head_deferrals > self.head_aging_steps):
+                # aging guard: stop bypass and hold freed pages for the
+                # head — decode-time growth can no longer consume them
+                eng.reserve_pages(need)
                 break
-            req = self._queue.popleft()
-            req.slot = slot
-            self._slots[slot] = req
-            tok = self.engine.prefill(req.prompt, slot)
-            req.first_token_t = time.perf_counter()
-            _obs.histogram("ttft_seconds", "submit -> first sampled token",
-                           unit="s").observe(req.first_token_t - req.submit_t)
-            req.output.append(tok)
-            if self.engine.done[slot]:  # first token was EOS
-                self._finish(slot, "eos")
-            elif req.max_new_tokens == 1:
-                self._finish(slot, "length")
+            # bypass: the first later request the unreserved pool covers
+            # (the head keeps its queue position)
+            avail = eng.free_pages - eng.reserved_pages
+            cand = next((i for i in range(1, len(self._queue))
+                         if eng.pages_for(len(self._queue[i].prompt))
+                         <= avail), None)
+            if cand is None:
+                break
+            req = self._queue[cand]
+            del self._queue[cand]
+            _obs.counter("gen_admission_bypass_total",
+                         "small requests admitted past a page-parked "
+                         "queue head").inc()
+            self._admit_into(slot, req, now)
+        if not self._queue:
+            self._head_id = None
+            self._head_deferrals = 0
+            if eng.paged and eng.reserved_pages:
+                eng.reserve_pages(0)
 
     def _done_reason(self, slot: int, last_token) -> str:
         """Why the engine marked this row done: a sampled EOS, a forced
@@ -184,16 +444,32 @@ class ContinuousBatcher:
         return "eos"
 
     def step(self) -> bool:
-        """Admit, then run one compiled decode step (or one speculative
-        draft+verify round). Returns True while any work (active rows or
-        queued requests) remains."""
-        self._admit()
+        """Sweep deadlines/cancellations, admit, then run one compiled
+        decode step (or one speculative draft+verify round, or — in
+        governor fallback — one plain step on the speculative engine).
+        Returns True while any work (active rows or queued requests)
+        remains."""
+        now = self._clock()
+        self._step_id += 1
+        self._sweep(now)
+        self._admit(now)
         self._gauges()
         if self.active == 0:
             return bool(self._queue)
         was_active = [s for s, r in enumerate(self._slots) if r is not None]
-        if getattr(self.engine, "speculative", False):
-            toks, counts, done = self.engine.spec_step()
+        speculative = getattr(self.engine, "speculative", False)
+        use_spec = speculative and (self.governor is None
+                                    or self.governor.speculating)
+        if use_spec:
+            def _round():
+                with self._watchdog.guard("spec_round", self._step_id):
+                    return self.engine.spec_step()
+
+            toks, counts, done = _retry.retry_call(
+                _round, site="gen.decode", policy=self._retry_policy)
+            if self.governor is not None and self.engine.last_round_drafted:
+                self.governor.observe_round(self.engine.last_round_accepted,
+                                            self.engine.last_round_drafted)
             for slot in was_active:
                 req = self._slots[slot]
                 n = int(counts[slot])
@@ -211,7 +487,17 @@ class ContinuousBatcher:
                 elif len(req.output) >= req.max_new_tokens:
                     self._finish(slot, "length")
         else:
-            tok, done, _ = self.engine.decode_step()
+            step_fn = self.engine.plain_step if speculative \
+                else self.engine.decode_step
+
+            def _step():
+                with self._watchdog.guard("decode", self._step_id):
+                    return step_fn()
+
+            tok, done, _ = _retry.retry_call(
+                _step, site="gen.decode", policy=self._retry_policy)
+            if self.governor is not None:
+                self.governor.observe_plain_step()
             for slot in was_active:
                 req = self._slots[slot]
                 if (self.engine.paged and done[slot]

@@ -55,6 +55,8 @@ from .. import observability as _obs
 from ..gluon.block import _HybridTrace
 from ..ndarray import NDArray
 from ..ops import random_ops as _rops
+from ..resilience import faults as _faults
+from ..resilience import retry as _retry
 
 __all__ = ["GenerationEngine", "SamplingConfig"]
 
@@ -187,6 +189,9 @@ class GenerationEngine:
             self._row_pages: List[List[int]] = \
                 [[] for _ in range(self.batch_size)]
             self._pending_clear: set = set()
+            #: pages the batcher's aging guard holds back from decode-time
+            #: growth for a parked queue head (docs/RESILIENCE.md)
+            self._reserved_pages = 0
             #: rows force-finished because the pool ran dry (the batcher
             #: reports these as finish_reason="page_exhausted")
             self.page_exhausted = np.zeros(self.batch_size, bool)
@@ -212,6 +217,16 @@ class GenerationEngine:
                                  f"max_length {self.max_length}")
             self.draft_pools = draft_net.init_paged_cache(
                 self.num_pages, self.page_size, dtype=cache_dtype)
+
+        #: accept stats of the most recent speculative round (read by the
+        #: batcher's degradation governor)
+        self.last_round_drafted = 0
+        self.last_round_accepted = 0
+        self._plain_decode_jit = None  # lazy spec-engine fallback program
+        #: RetryPolicy for the in-round gen.verify retry (None = config
+        #: defaults); ContinuousBatcher installs its own policy here so
+        #: one knob governs every serving retry
+        self.retry_policy = None
 
         # host state (tiny (B,) vectors shipped to the device each step —
         # keeping them host-side makes slot admission trivial)
@@ -286,6 +301,28 @@ class GenerationEngine:
         """Pages a ``length``-token sequence occupies."""
         return -(-int(length) // self.page_size)
 
+    @property
+    def reserved_pages(self) -> int:
+        """Free pages currently held back for a parked queue head."""
+        return self._reserved_pages if self.paged else 0
+
+    def reserve_pages(self, n: int) -> None:
+        """Hold ``n`` free pages back from decode-time growth (the
+        batcher's aging guard: a queue head deferred too long on
+        ``free_pages`` gets freed pages *reserved* instead of watching
+        running rows' ``_grow_pages`` consume them forever). Reserved
+        pages are still visible to :meth:`prefill` — the head's admission
+        is exactly what they are being saved for. ``n=0`` releases the
+        reservation. Rows that cannot cover their next write because of a
+        reservation are evicted through the ordinary page-exhaustion path
+        (explicit ``page_exhausted`` finish, never a hang)."""
+        if not self.paged:
+            return
+        self._reserved_pages = max(0, int(n))
+        _obs.gauge("gen_pages_reserved",
+                   "free pages held back for a parked queue head").set(
+                       self._reserved_pages)
+
     def _page_gauges(self):
         free = len(self._free_pages)
         _obs.gauge("gen_pages_free",
@@ -315,6 +352,9 @@ class GenerationEngine:
         upd_slots = np.zeros((self.batch_size, self._upd_width), np.int32)
         upd_pages = np.zeros((self.batch_size, self._upd_width), np.int32)
         allocated = 0
+        # pages past the reservation are off-limits to growth: they are
+        # being accumulated for a parked queue head (reserve_pages)
+        avail = len(self._free_pages) - self._reserved_pages
         for row in range(self.batch_size):
             if self.done[row]:
                 continue
@@ -322,7 +362,7 @@ class GenerationEngine:
             need = min(p + window, self.max_length - 1) // ps + 1
             u = 0
             while len(self._row_pages[row]) < need:
-                if not self._free_pages:
+                if avail <= 0:
                     if len(self._row_pages[row]) * ps <= p:
                         # cannot write the next token: evict the row
                         self.done[row] = True
@@ -332,6 +372,7 @@ class GenerationEngine:
                             "rows force-finished on page exhaustion").inc(
                                 reason="exhausted")
                     break
+                avail -= 1
                 pid = self._free_pages.popleft()
                 upd_slots[row, u] = len(self._row_pages[row])
                 upd_pages[row, u] = pid
@@ -590,6 +631,10 @@ class GenerationEngine:
             raise ValueError("empty prompt")
         if not 0 <= slot < self.batch_size:
             raise ValueError(f"slot {slot} out of range")
+        # fault site BEFORE any allocator mutation: a retried admission
+        # (ContinuousBatcher wraps prefill in retry_call) must replay
+        # against untouched page/clear state
+        _faults.fire("gen.prefill")
         bucket = self.bucket_for(length)
         padded = np.full((1, bucket), self.pad_id, np.int32)
         padded[0, :length] = prompt
@@ -657,14 +702,40 @@ class GenerationEngine:
         device array)``. Rows that were already done emit ``pad_id``."""
         if self.speculative:
             raise RuntimeError("speculative engine decodes in rounds; "
-                               "use spec_step()")
+                               "use spec_step() (or plain_step() for the "
+                               "degrade-to-plain fallback)")
+        return self._plain_decode_step()
+
+    def plain_step(self):
+        """One plain (non-speculative) decode step on ANY engine — the
+        degrade-to-safe path of a speculative engine when the accept rate
+        collapses (docs/RESILIENCE.md "Serving resilience"): one dispatch
+        per token through the same paged pools, greedy-token-identical to
+        the speculative rounds. The draft model's cache is NOT written
+        during fallback, so rows decoded here have draft-cache holes after
+        a re-arm — an accept-rate cost only, never a correctness one."""
+        return self._plain_decode_step()
+
+    def _plain_decode_step(self):
+        _faults.fire("gen.decode")
         t0 = time.perf_counter()
         if self.paged:
             upd_slots, upd_pages = self._grow_pages(0)
             clear = self._take_clear_mask()
             active_in = ~self.done  # exhaustion may have finished rows
+            if self.speculative:
+                # the spec engine compiled draft+verify, not a single-token
+                # decode: lower the fallback program lazily on first use
+                # (counted like every other program lowering)
+                if getattr(self, "_plain_decode_jit", None) is None:
+                    self._plain_decode_jit = jax.jit(
+                        self._paged_decode_fn, donate_argnums=(1,),
+                        keep_unused=True)
+                decode_jit = self._plain_decode_jit
+            else:
+                decode_jit = self._decode_jit
             self._note_program(("decode", self.batch_size, "paged"), "decode")
-            carry, tok, done, logits = self._decode_jit(
+            carry, tok, done, logits = decode_jit(
                 self._params(), (self.page_table, self.pools),
                 jnp.asarray(self.last_tokens), jnp.asarray(self.positions),
                 jnp.asarray(self.done), jnp.asarray(upd_slots),
@@ -714,6 +785,8 @@ class GenerationEngine:
         driven to the same length."""
         if not self.speculative:
             raise RuntimeError("spec_step() needs draft_net=/speculate_k=")
+        _faults.fire("gen.decode")  # before any allocator mutation: the
+        # batcher's retry_call replays the whole round cleanly
         k = self.speculate_k
         t0 = time.perf_counter()
         upd_slots, upd_pages = self._grow_pages(k)
@@ -733,13 +806,24 @@ class GenerationEngine:
             jnp.asarray(self.last_tokens), jnp.asarray(self.positions),
             jnp.asarray(self.done), jnp.asarray(upd_slots),
             jnp.asarray(upd_pages), jnp.asarray(clear), key)
-        self.draft_pools = dpools
+        # commit the draft half's carry BEFORE the verify dispatch: the
+        # old page_table buffer was donated to the draft program, and the
+        # gen.verify fault site below must leave the engine re-entrant (a
+        # retried spec_step re-runs the draft from the same positions —
+        # deterministic overwrites of the same cache entries)
+        self.page_table, self.draft_pools = table, dpools
         self._note_program(("verify", self.batch_size, k), "verify")
-        (table, pools), out, m, done, acc = self._verify_jit(
-            self._params(), (table, self.pools),
-            jnp.asarray(self.last_tokens), drafted,
-            jnp.asarray(self.positions), jnp.asarray(self.done),
-            jnp.asarray(room), key)
+
+        def _dispatch_verify():
+            _faults.fire("gen.verify")
+            return self._verify_jit(
+                self._params(), (self.page_table, self.pools),
+                jnp.asarray(self.last_tokens), drafted,
+                jnp.asarray(self.positions), jnp.asarray(self.done),
+                jnp.asarray(room), key)
+
+        (table, pools), out, m, done, acc = _retry.retry_call(
+            _dispatch_verify, site="gen.verify", policy=self.retry_policy)
         self.page_table, self.pools = table, pools
         out = np.array(out)
         m = np.array(m)
@@ -760,6 +844,12 @@ class GenerationEngine:
         n_active = int(active_in.sum())
         _obs.counter("gen_spec_rounds_total",
                      "speculative draft+verify rounds").inc()
+        # per-round accept stats for the degradation governor
+        # (resilience.serving.SpeculationGovernor reads them after each
+        # round the batcher dispatches)
+        self.last_round_drafted = k * n_active
+        self.last_round_accepted = int(acc[active_in].sum()) if n_active \
+            else 0
         if n_active:
             accepted = int(acc[active_in].sum())
             _obs.counter("gen_spec_drafted_tokens_total",

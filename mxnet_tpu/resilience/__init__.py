@@ -13,6 +13,9 @@ testable on CPU via deterministic fault injection:
   - ``preemption``  SIGTERM/SIGINT -> checkpoint at step boundary -> exit 0
   - ``elastic``     worker-loss detection + mesh re-formation + elastic
                     world size (with ``tools/launch.py --elastic``)
+  - ``serving``     serving-side degradation governor (speculative-decode
+                    accept-rate fallback) + dispatch watchdog, consumed by
+                    ``inference.ContinuousBatcher`` (``make chaos-serve``)
 
 See docs/RESILIENCE.md for the operator-facing contract.
 """
@@ -23,15 +26,20 @@ from . import faults  # noqa: F401
 from . import integrity  # noqa: F401
 from . import preemption  # noqa: F401
 from . import retry  # noqa: F401
+from . import serving  # noqa: F401
 from .elastic import (ELASTIC_RESTART_EXIT, ElasticContext,  # noqa: F401
                       HeartbeatMonitor, PeerLost, ReformExit)
 from .faults import InjectedCrash, InjectedFault  # noqa: F401
 from .integrity import CheckpointCorruptError, sweep_retention  # noqa: F401
 from .preemption import Preempted, PreemptionGuard  # noqa: F401
 from .retry import RetryError, RetryPolicy, retry_call  # noqa: F401
+from .serving import (AcceptRateTracker, DispatchWatchdog,  # noqa: F401
+                      SpeculationGovernor)
 
 __all__ = ["faults", "retry", "integrity", "preemption", "elastic",
-           "InjectedFault", "InjectedCrash", "CheckpointCorruptError",
-           "Preempted", "PreemptionGuard", "RetryError", "RetryPolicy",
-           "retry_call", "sweep_retention", "ELASTIC_RESTART_EXIT",
-           "ElasticContext", "HeartbeatMonitor", "PeerLost", "ReformExit"]
+           "serving", "InjectedFault", "InjectedCrash",
+           "CheckpointCorruptError", "Preempted", "PreemptionGuard",
+           "RetryError", "RetryPolicy", "retry_call", "sweep_retention",
+           "ELASTIC_RESTART_EXIT", "ElasticContext", "HeartbeatMonitor",
+           "PeerLost", "ReformExit", "AcceptRateTracker",
+           "SpeculationGovernor", "DispatchWatchdog"]

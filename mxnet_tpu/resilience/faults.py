@@ -36,6 +36,17 @@ Known sites (see docs/RESILIENCE.md):
                           liveness probe; surfaces as ``PeerLost`` and
                           drives a mesh re-formation with no real dead
                           process
+  ``gen.prefill``         ``GenerationEngine.prefill`` — before any page
+                          allocation or dispatch, so a retried admission
+                          replays cleanly (``ContinuousBatcher`` wraps it
+                          in ``retry_call``)
+  ``gen.decode``          one serving decode dispatch — fired at the top of
+                          ``decode_step``/``plain_step`` and of each
+                          speculative round, before any allocator mutation
+  ``gen.verify``          the speculative verify dispatch — fired after the
+                          draft half committed its carry, retried inside
+                          ``spec_step`` (the round's host state is
+                          re-entrant at that point)
   ======================  ====================================================
 
 Env grammar (entries separated by ``;``, options by ``:``)::

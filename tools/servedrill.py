@@ -1,0 +1,374 @@
+#!/usr/bin/env python
+"""Chaos drill for the serving path (`make chaos-serve`,
+docs/RESILIENCE.md "Serving resilience").
+
+Drives :class:`ContinuousBatcher` traffic on a tiny GPT-2 speculative
+engine under everything the serving-resilience layer is supposed to
+absorb, simultaneously:
+
+  - injected transient faults at every serving fault site
+    (``gen.prefill`` / ``gen.decode`` / ``gen.verify``, deterministic
+    ``every=N`` triggers the 3-attempt retry policy must absorb);
+  - deadline pressure (requests expiring both in the queue and mid-slot)
+    and explicit client cancellations, on a scripted fake clock so the
+    schedule is deterministic;
+  - overload (a bounded admission queue + a submit burst that must shed);
+  - a forced speculative accept-rate collapse (an adversarial draft model
+    that is always wrong), so the governor's fallback → cooldown → re-arm
+    ladder is exercised for real;
+  - the dispatch watchdog armed (and expected silent).
+
+Gate (exit 1 on any violation):
+
+  - the drill terminates within its step budget — no hang;
+  - every submitted request ends with an explicit finish reason from the
+    documented set;
+  - rows that ran to completion are BIT-IDENTICAL to an undisturbed
+    non-speculative baseline, and every interrupted row (deadline /
+    cancelled / page_exhausted) emitted a strict prefix of it — injected
+    faults, cancellations and page churn never corrupt a surviving row;
+  - deadline / cancelled / shed counters are all nonzero, and both
+    deadline flavours (``where=queue`` / ``where=slot``) fired;
+  - speculative fallback AND re-arm were observed (metrics + events);
+  - the retry bridge counted failed attempts for every ``gen.*`` site;
+  - the drained end state is clean: no active slots, empty queue, every
+    page back in the free pool, no reservation, zero watchdog stalls.
+
+``--inject-leak`` is the tested failure path (like profcheck's
+``--inject-empty-trace``): it corrupts the drained-state evidence and the
+gate must go red.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+VOCAB, PAD = 61, 0
+ALLOWED_REASONS = ("eos", "length", "cache_full", "page_exhausted",
+                   "deadline", "cancelled", "shed")
+
+
+class FakeClock:
+    """Deterministic clock the batcher's deadline arithmetic runs on."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt=1.0):
+        self.t += dt
+
+
+class AdversarialDraft:
+    """Duck-typed draft model that always proposes the same (wrong) token:
+    the accept rate collapses to ~0, every round pays 2 dispatches for 1
+    token, and the governor must fall back."""
+
+    def __init__(self, vocab, max_length, token=7):
+        self._vocab = vocab
+        self._max_length = max_length
+        self._token = token
+
+    def collect_params(self):
+        return {}
+
+    def init_paged_cache(self, num_pages, page_size, dtype="float32"):
+        import jax.numpy as jnp
+
+        return [(jnp.zeros((num_pages + 1, 1, page_size, 1), jnp.float32),
+                 jnp.zeros((num_pages + 1, 1, page_size, 1), jnp.float32))]
+
+    def __call__(self, tokens, cache=None, start_pos=None, page_table=None):
+        import jax
+
+        from mxnet_tpu.ndarray import NDArray
+
+        t = tokens._data.shape[1]
+        logits = jax.nn.one_hot(
+            jax.numpy.full((tokens._data.shape[0], t), self._token),
+            self._vocab, dtype="float32") * 10.0
+        return NDArray(logits), cache
+
+
+def build_net(max_length=64, seed=0):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.models import gpt2
+
+    mx.random.seed(seed)
+    net = gpt2.GPT2Model(num_layers=2, units=64, num_heads=4,
+                         max_length=max_length, vocab_size=VOCAB,
+                         dropout=0.0)
+    net.initialize()
+    _ = net(nd.array(np.zeros((1, 4)), dtype="int32"))
+    return net
+
+
+def _prompt(n, seed):
+    import numpy as np
+
+    return list(np.random.RandomState(seed).randint(1, VOCAB, n))
+
+
+def _counter(name, **labels):
+    from mxnet_tpu.observability import REGISTRY
+
+    c = REGISTRY.get(name)
+    if c is None:
+        return 0.0
+    return c.value(**labels) if labels else c.total()
+
+
+#: (key, prompt seed, prompt len, max_new) — survivors run to their budget
+SURVIVORS = [("surv0", 10, 5, 18), ("surv1", 11, 9, 18), ("surv2", 12, 6, 6)]
+#: rows interrupted mid-flight must emit a strict prefix of the baseline
+PREFIXED = [("slotdl", 20, 5, 18),   # admitted, deadline fires in the slot
+            ("cancel", 21, 7, 18)]   # admitted, cancelled mid-decode
+
+
+def baseline_outputs():
+    """Undisturbed plain (non-speculative) paged run of every prompt the
+    drill will interrupt or complete — the bit-identity reference."""
+    from mxnet_tpu.inference import ContinuousBatcher, GenerationEngine
+
+    eng = GenerationEngine(build_net(), batch_size=3, prefill_buckets=(8, 16),
+                           eos_id=None, pad_id=PAD, paged=True, page_size=8,
+                           num_pages=18)
+    bat = ContinuousBatcher(eng)
+    reqs = {}
+    for key, seed, n, budget in SURVIVORS + PREFIXED:
+        reqs[key] = bat.submit(_prompt(n, seed), max_new_tokens=budget)
+    bat.run_until_idle(max_steps=500)
+    return {k: r.result() for k, r in reqs.items()}
+
+
+def run_drill(max_steps=250, telemetry_dir=None):
+    """Run the drill; returns the evidence dict ``validate`` judges."""
+    import mxnet_tpu  # noqa: F401  (package init)
+    from mxnet_tpu import observability as obs
+    from mxnet_tpu.inference import ContinuousBatcher, GenerationEngine
+    from mxnet_tpu.resilience import RetryPolicy, faults
+    from mxnet_tpu.resilience import retry as retry_mod
+
+    t_wall = time.perf_counter()
+    base = baseline_outputs()
+
+    before = {
+        "deadline_q": _counter("gen_deadline_expired_total", where="queue"),
+        "deadline_s": _counter("gen_deadline_expired_total", where="slot"),
+        "cancelled": _counter("gen_requests_total", reason="cancelled"),
+        "shed": _counter("gen_shed_total"),
+        "fallbacks": _counter("gen_spec_fallbacks_total"),
+        "rearms": _counter("gen_spec_rearms_total"),
+        "stuck": _counter("gen_stuck_dispatch_total"),
+        "retry_fail": {s: _counter("retry_attempts_total", site=s, ok="false")
+                       for s in ("gen.prefill", "gen.decode", "gen.verify")},
+    }
+
+    run_dir = telemetry_dir or os.path.join(
+        "/tmp", f"servedrill-{os.getpid()}")
+    obs.enable(run_dir, run_id="servedrill")
+    # deterministic transient noise on every serving site; every>=2 so the
+    # default 3-attempt policy can never see a fault twice in a row
+    faults.arm("gen.prefill", every=3)
+    faults.arm("gen.decode", every=5)
+    faults.arm("gen.verify", every=4)
+
+    clock = FakeClock()
+    net = build_net()
+    eng = GenerationEngine(net, batch_size=3, prefill_buckets=(8, 16),
+                           eos_id=None, pad_id=PAD, paged=True, page_size=8,
+                           num_pages=18,
+                           draft_net=AdversarialDraft(VOCAB, 64),
+                           speculate_k=3)
+    bat = ContinuousBatcher(
+        eng, max_queue=4, queue_policy="shed", head_aging_steps=4,
+        spec_window=4, spec_floor=0.3, spec_cooldown=5, watchdog_s=30.0,
+        retry_policy=RetryPolicy(base_delay=0.002, jitter=0.0, seed=0),
+        clock=clock)
+
+    reqs = {}
+    try:
+        for key, seed, n, budget in SURVIVORS:
+            reqs[key] = bat.submit(_prompt(n, seed), max_new_tokens=budget)
+        k, s, n, budget = PREFIXED[0]  # expires mid-slot (admitted at t=0)
+        reqs[k] = bat.submit(_prompt(n, s), max_new_tokens=budget,
+                             deadline_s=7.0)
+        steps = 0
+        while True:
+            if steps == 2:
+                # all 3 slots busy + slotdl queued -> this one expires in
+                # the QUEUE (deadline shorter than any plausible wait)
+                reqs["queuedl"] = bat.submit(_prompt(6, 22),
+                                             max_new_tokens=8, deadline_s=2.0)
+            if steps == 3:
+                k, s, n, budget = PREFIXED[1]
+                reqs[k] = bat.submit(_prompt(n, s), max_new_tokens=budget)
+            if steps == 6:
+                # submit burst against max_queue=4: the overflow sheds
+                for j in range(5):
+                    reqs[f"burst{j}"] = bat.submit(
+                        _prompt(4, 30 + j), max_new_tokens=4,
+                        deadline_s=60.0)
+            if (steps >= 8 and not reqs["cancel"].done
+                    and reqs["cancel"].slot is not None
+                    and not reqs["cancel"].cancel_requested):
+                # cancel once the request is decoding in a slot: the next
+                # boundary must reclaim it (reason "cancelled")
+                assert bat.cancel(reqs["cancel"].id)
+            clock.advance(1.0)
+            alive = bat.step()
+            steps += 1
+            if not alive or steps >= max_steps:
+                break
+        bat.run_until_idle(max_steps=max(0, max_steps - steps))
+    finally:
+        for site in ("gen.prefill", "gen.decode", "gen.verify"):
+            faults.disarm(site)
+        obs.disable()
+
+    result = {
+        "steps": steps,
+        "max_steps": max_steps,
+        "wall_s": time.perf_counter() - t_wall,
+        "baseline": base,
+        "requests": {k: {"reason": r.finish_reason, "output": list(r.output)}
+                     for k, r in reqs.items()},
+        "counters": {
+            "deadline_q": _counter("gen_deadline_expired_total",
+                                   where="queue") - before["deadline_q"],
+            "deadline_s": _counter("gen_deadline_expired_total",
+                                   where="slot") - before["deadline_s"],
+            "cancelled": _counter("gen_requests_total", reason="cancelled")
+            - before["cancelled"],
+            "shed": _counter("gen_shed_total") - before["shed"],
+            "fallbacks": _counter("gen_spec_fallbacks_total")
+            - before["fallbacks"],
+            "rearms": _counter("gen_spec_rearms_total") - before["rearms"],
+            "stuck": _counter("gen_stuck_dispatch_total") - before["stuck"],
+            "retry_fail": {
+                s: _counter("retry_attempts_total", site=s, ok="false")
+                - before["retry_fail"][s]
+                for s in ("gen.prefill", "gen.decode", "gen.verify")},
+        },
+        "attempt_log_sites": sorted(
+            s for s in ("gen.prefill", "gen.decode", "gen.verify")
+            if any(not a["ok"] for a in retry_mod.attempt_log(s))),
+        "events": [e["event"] for e in obs.read_events(run_dir)
+                   if e.get("event", "").startswith("gen_spec")],
+        "drained": {
+            "active": bat.active,
+            "pending": bat.pending,
+            "free_pages": eng.free_pages,
+            "num_pages": eng.num_pages,
+            "reserved": eng.reserved_pages,
+        },
+    }
+    return result
+
+
+def validate(result):
+    """Judge a drill result; returns the list of violations (empty = OK)."""
+    problems = []
+    if result["steps"] >= result["max_steps"]:
+        problems.append(f"drill did not drain within {result['max_steps']} "
+                        "steps (possible hang)")
+    base = result["baseline"]
+    for key, rec in result["requests"].items():
+        reason, out = rec["reason"], rec["output"]
+        if reason not in ALLOWED_REASONS:
+            problems.append(f"request {key}: finish reason {reason!r} not in "
+                            f"{ALLOWED_REASONS}")
+            continue
+        want = base.get(key)
+        if want is None:
+            continue
+        if reason in ("eos", "length") and out != want:
+            problems.append(f"request {key}: completed tokens diverge from "
+                            "the undisturbed baseline (corruption)")
+        elif reason not in ("eos", "length") and \
+                out != want[:len(out)]:
+            problems.append(f"request {key}: interrupted tokens are not a "
+                            "prefix of the baseline (corruption)")
+    for k, v in result["requests"].items():
+        if v["reason"] is None:
+            problems.append(f"request {k} never terminated")
+    c = result["counters"]
+    for name in ("deadline_q", "deadline_s", "cancelled", "shed",
+                 "fallbacks", "rearms"):
+        if c[name] < 1:
+            problems.append(f"expected counter {name} >= 1, got {c[name]}")
+    if c["stuck"] != 0:
+        problems.append(f"watchdog flagged {c['stuck']} stuck dispatches")
+    for site, n in c["retry_fail"].items():
+        if n < 1:
+            problems.append(f"no failed attempts recorded for fault site "
+                            f"{site} (injection or retry bridge broken)")
+    if sorted(result["attempt_log_sites"]) != \
+            ["gen.decode", "gen.prefill", "gen.verify"]:
+        problems.append("attempt_log missing records for some gen.* site: "
+                        f"{result['attempt_log_sites']}")
+    ev = set(result["events"])
+    if "gen_spec_fallback" not in ev or "gen_spec_rearm" not in ev:
+        problems.append(f"fallback/re-arm events missing from telemetry: "
+                        f"{sorted(ev)}")
+    d = result["drained"]
+    if d["active"] or d["pending"]:
+        problems.append(f"not drained: active={d['active']} "
+                        f"pending={d['pending']}")
+    if d["free_pages"] != d["num_pages"]:
+        problems.append(f"page leak: {d['free_pages']}/{d['num_pages']} "
+                        "free after drain")
+    if d["reserved"]:
+        problems.append(f"reservation leaked: {d['reserved']} pages")
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--max-steps", type=int, default=250)
+    ap.add_argument("--inject-leak", action="store_true",
+                    help="failure-path test hook: corrupt the drained-state "
+                    "evidence; the gate must fail")
+    args = ap.parse_args(argv)
+
+    result = run_drill(max_steps=args.max_steps)
+    if args.inject_leak:
+        result["drained"]["free_pages"] -= 1
+    problems = validate(result)
+
+    c = result["counters"]
+    print(f"servedrill: {len(result['requests'])} requests, "
+          f"{result['steps']} steps, {result['wall_s']:.1f}s wall")
+    print(f"  reasons: "
+          + ", ".join(sorted({v['reason'] or 'NONE'
+                              for v in result['requests'].values()})))
+    print(f"  deadline(queue/slot)={c['deadline_q']:.0f}/"
+          f"{c['deadline_s']:.0f} cancelled={c['cancelled']:.0f} "
+          f"shed={c['shed']:.0f}")
+    print(f"  spec fallbacks={c['fallbacks']:.0f} rearms={c['rearms']:.0f} "
+          f"stuck={c['stuck']:.0f}")
+    print(f"  retry failures absorbed: "
+          + ", ".join(f"{s}={n:.0f}"
+                      for s, n in sorted(c["retry_fail"].items())))
+    print(f"  drained: {result['drained']}")
+    if problems:
+        for p in problems:
+            print(f"servedrill: FAIL: {p}")
+        return 1
+    print("servedrill: OK — explicit finish reasons, bit-identical "
+          "survivors, fallback+re-arm observed, clean drain")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
