@@ -34,11 +34,13 @@
 #   fast    - pytest without @slow (target < 10 min on 8 virtual CPU devs)
 #   slow    - the @slow remainder (model compiles, 4-process launches)
 #   ci      - sanity + lint + native + fast + audit + shardcheck +
-#             memcheck + schedcheck + chaos-elastic + chaos-serve (the
-#             pre-merge gate; chaos-elastic is the slow 4-process
-#             kill-a-worker drill, chaos-serve the serving-resilience
-#             drill: injected gen.* faults + deadlines + accept-rate
-#             collapse, tools/servedrill.py)
+#             memcheck + schedcheck + chaos-elastic + chaos-serve +
+#             chaos-fleet (the pre-merge gate; chaos-elastic is the slow
+#             4-process kill-a-worker drill, chaos-serve the
+#             serving-resilience drill: injected gen.* faults + deadlines
+#             + accept-rate collapse, chaos-fleet the multi-replica
+#             router drill: kill + wedge with zero in-deadline drops,
+#             tools/servedrill.py)
 #   test    - full suite (ci + slow), what the driver effectively runs
 
 PY ?= python
@@ -49,9 +51,9 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity lint audit shardcheck memcheck schedcheck profcheck native fast slow test chaos chaos-elastic chaos-serve obs obsfleet perfwin genbench ampbench bench clean
+.PHONY: ci sanity lint audit shardcheck memcheck schedcheck profcheck native fast slow test chaos chaos-elastic chaos-serve chaos-fleet obs obsfleet perfwin genbench ampbench bench clean
 
-ci: sanity lint native fast audit shardcheck memcheck schedcheck profcheck chaos-elastic chaos-serve obsfleet
+ci: sanity lint native fast audit shardcheck memcheck schedcheck profcheck chaos-elastic chaos-serve chaos-fleet obsfleet
 
 sanity:
 	$(PY) -m compileall -q mxnet_tpu tools tests examples bench.py __graft_entry__.py
@@ -145,6 +147,17 @@ chaos-elastic: native
 # `python tools/servedrill.py --inject-leak`
 chaos-serve: native
 	$(PY) tools/servedrill.py
+
+# fleet serving chaos drill (docs/INFERENCE.md "Fleet serving"): three
+# router-fed replicas on the CPU backend with a deterministic clock; one
+# replica is killed and one wedged mid-burst. Asserts zero dropped
+# in-deadline requests (redistributed re-runs stay bit-identical to the
+# baseline), the wedged replica walks DEGRADED->DRAINING->DEAD with its
+# work redistributed, a replacement joins, and the survivors drain to a
+# clean empty end state. Failure path stays tested via
+# `python tools/servedrill.py --fleet --inject-drop`
+chaos-fleet: native
+	$(PY) tools/servedrill.py --fleet
 
 # observability gate (docs/OBSERVABILITY.md): a 2-step LeNet train with
 # telemetry on must yield a non-empty obs_report summary covering step/

@@ -131,6 +131,33 @@ _KNOBS: Dict[str, tuple] = {
                          "a dispatch exceeding it emits gen_stuck_dispatch "
                          "(event + counter) instead of hanging silently "
                          "(0 = off)"),
+    # -- fleet serving tier (docs/INFERENCE.md "Fleet serving") --------------
+    "router_hb_timeout": (float, 5.0, ("MXNET_TPU_ROUTER_HB_TIMEOUT",),
+                          "replica heartbeat staleness (seconds since the "
+                          "last published snapshot) after which fleet "
+                          "health marks it DEGRADED"),
+    "router_drain_after": (float, 5.0, ("MXNET_TPU_ROUTER_DRAIN_AFTER",),
+                           "seconds a replica may stay DEGRADED before the "
+                           "router drains it (no new admissions, queued "
+                           "work redistributed)"),
+    "router_dead_grace": (float, 30.0, ("MXNET_TPU_ROUTER_DEAD_GRACE",),
+                          "seconds a DRAINING replica gets for in-flight "
+                          "rows to finish or expire before it is declared "
+                          "DEAD and its remaining work redistributed"),
+    "router_queue_bound": (int, 4, ("MXNET_TPU_ROUTER_QUEUE_BOUND",),
+                           "max published admission-queue depth the router "
+                           "will dispatch onto; deeper replicas keep the "
+                           "request in the router backlog"),
+    "router_classes": (str, "interactive,normal,batch",
+                       ("MXNET_TPU_ROUTER_CLASSES",),
+                       "priority classes in admission order (first = "
+                       "dispatched first under contention)"),
+    "router_affinity": (bool, True, ("MXNET_TPU_ROUTER_AFFINITY",),
+                        "pin a session's requests to the replica holding "
+                        "its prefix pages while that replica is LIVE"),
+    "router_seed": (int, 0, ("MXNET_TPU_ROUTER_SEED",),
+                    "seed for the power-of-two-choices candidate sampling "
+                    "(deterministic routing in drills and tests)"),
     # -- compilation (docs/PERFORMANCE.md) -----------------------------------
     "compile_cache": (str, "", ("MXNET_TPU_COMPILE_CACHE",),
                       "persistent XLA compilation-cache directory "

@@ -79,9 +79,12 @@ from ..resilience import serving as _serving
 __all__ = ["ContinuousBatcher", "GenRequest"]
 
 #: every way a request can terminate — the chaos-serve gate asserts each
-#: submitted request lands on exactly one of these
+#: submitted request lands on exactly one of these. ``"redistributed"``
+#: is the fleet tier's pull-back: the request was not abandoned, it is
+#: being re-run on another replica (distinct from ``"cancelled"``, which
+#: is a client decision and terminal for the work itself)
 FINISH_REASONS = ("eos", "length", "cache_full", "page_exhausted",
-                  "deadline", "cancelled", "shed")
+                  "deadline", "cancelled", "shed", "redistributed")
 
 
 class GenRequest:
@@ -189,6 +192,9 @@ class ContinuousBatcher:
         self._step_id = 0
         self._head_id: Optional[int] = None
         self._head_deferrals = 0
+        #: drain mode (fleet tier): no new admissions — queued work is
+        #: pulled back by the router, in-flight rows finish or expire
+        self.draining = False
 
     # -- client side ---------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
@@ -221,6 +227,10 @@ class ContinuousBatcher:
         req = GenRequest(next(self._ids), prompt, max_new_tokens,
                          deadline_s=deadline_s, clock=self._clock)
         now = req.submit_t
+        if self.draining:
+            # a draining replica takes nothing new — the router routes
+            # around it; a direct client gets an explicit shed
+            return self._shed(req, now, cause="draining")
         # -- overload control (docs/RESILIENCE.md "Serving resilience") ------
         if (self.engine.paged and self.shed_page_floor > 0
                 and self.engine.free_pages < self.shed_page_floor
@@ -253,6 +263,85 @@ class ContinuousBatcher:
             return False
         req.cancel()
         return True
+
+    # -- fleet-tier drain hooks (mxnet_tpu.serving) --------------------------
+    def begin_drain(self) -> None:
+        """Enter drain mode: every later ``submit`` is shed
+        (``cause="draining"``) and admission stops — queued work is meant
+        to be pulled back with :meth:`withdraw_queued`, in-flight rows
+        finish or expire normally. Idempotent; there is no un-drain (a
+        drained replica gets replaced, not resurrected)."""
+        self.draining = True
+
+    def withdraw(self, req_or_id) -> bool:
+        """Pull one *queued* request back for re-routing — it finishes
+        immediately with reason ``"redistributed"`` (not ``"cancelled"``:
+        the work is not abandoned, it re-runs elsewhere). Immediate, not
+        boundary-deferred: a wedged replica never reaches another step
+        boundary, and a queued request holds no slot or pages, so there
+        is nothing to reclaim. Active rows cannot be withdrawn (their
+        cache row lives here); returns False for those and for
+        unknown/finished requests."""
+        now = self._clock()
+        if isinstance(req_or_id, GenRequest):
+            req = req_or_id
+        else:
+            req = next((r for r in self._queue if r.id == req_or_id), None)
+        if req is None or req.done or req not in self._queue:
+            return False
+        self._queue.remove(req)
+        self._finish_queued(req, now, "redistributed")
+        self._gauges()
+        return True
+
+    def withdraw_queued(self) -> List[GenRequest]:
+        """Pull back EVERY queued request (drain entry): each finishes
+        with reason ``"redistributed"``; the handles are returned so the
+        router can re-enqueue the work."""
+        out = list(self._queue)
+        self._queue.clear()
+        now = self._clock()
+        for req in out:
+            self._finish_queued(req, now, "redistributed")
+        self._gauges()
+        return out
+
+    def abandon(self) -> List[GenRequest]:
+        """Declare this batcher lost (replica DEAD): every live request —
+        queued and in-flight — finishes with reason ``"redistributed"``.
+        Bookkeeping only: no engine dispatch and no allocator mutation
+        happens (the replica may be wedged inside one); the engine and
+        its page pool are discarded with the replica."""
+        now = self._clock()
+        out = self.withdraw_queued()
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            self._slots[slot] = None
+            req.finish_reason = "redistributed"
+            req.finish_t = now
+            _obs.counter("gen_requests_total",
+                         "completed generation requests").inc(
+                             reason="redistributed")
+            out.append(req)
+        self._gauges()
+        return out
+
+    # -- queue telemetry the replica publishes (docs/INFERENCE.md) -----------
+    def queue_ages(self, now: Optional[float] = None) -> List[float]:
+        if now is None:
+            now = self._clock()
+        return [max(0.0, now - r.submit_t) for r in self._queue]
+
+    def queue_age_p95(self, now: Optional[float] = None) -> float:
+        """p95 age of the *currently queued* requests (0.0 when empty) —
+        the live backlog-pressure signal the fleet router balances on,
+        distinct from the ``gen_queue_age_seconds`` histogram which only
+        records ages at queue *exit*."""
+        ages = sorted(self.queue_ages(now))
+        if not ages:
+            return 0.0
+        return ages[max(0, -(-len(ages) * 95 // 100) - 1)]
 
     @property
     def pending(self) -> int:
@@ -375,6 +464,8 @@ class ContinuousBatcher:
         while it is parked, smaller later requests may bypass it — until
         the aging guard reserves freed pages for the head (see module
         docstring)."""
+        if self.draining:
+            return  # drain mode: in-flight only, nothing new starts
         eng = self.engine
         deferral_counted = False
         for slot in range(eng.batch_size):

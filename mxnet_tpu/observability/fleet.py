@@ -361,6 +361,10 @@ class RankStats:
     mfu_bound: Optional[float] = None
     comm_exposed_share: Optional[float] = None
     last_ts: Optional[float] = None
+    # serving-replica self-report (mxnet_tpu.serving.replica publishes
+    # replica_* series through the same rank-dir transport; None for a
+    # training rank)
+    replica: Optional[dict] = None
 
     def summary(self) -> dict:
         return {"rank": self.rank, "generations": sorted(self.generations),
@@ -373,6 +377,7 @@ class RankStats:
                 "flops_per_step": self.flops_per_step, "mfu": self.mfu,
                 "mfu_bound": self.mfu_bound,
                 "comm_exposed_share": self.comm_exposed_share,
+                "replica": self.replica,
                 "last_ts": self.last_ts}
 
 
@@ -393,6 +398,10 @@ class FleetReport:
     # a periodic or straggler-triggered step capture — docs/
     # OBSERVABILITY.md "Measured profiling")
     profiles: Dict[int, dict] = dataclasses.field(default_factory=dict)
+    # router-tier rollup ({fleet_dir}/router/ snapshots written by
+    # mxnet_tpu.serving.FleetRouter.publish): per-replica state /
+    # admissions / redistributions, request and completion counts
+    router: dict = dataclasses.field(default_factory=dict)
 
     def summary(self) -> dict:
         return {
@@ -408,6 +417,7 @@ class FleetReport:
             "torn_snapshots": self.torn_snapshots,
             "profiles": {str(r): p for r, p
                          in sorted(self.profiles.items())},
+            "router": dict(self.router),
         }
 
 
@@ -520,6 +530,74 @@ class _ServingAcc:
         return out
 
 
+#: router_replica_state gauge codes (mxnet_tpu.serving.health
+#: STATE_CODES, duplicated here so observability never imports the
+#: serving tier)
+_REPLICA_STATES = {0: "live", 1: "degraded", 2: "draining", 3: "dead"}
+
+#: replica self-report series -> RankStats.replica keys
+_REPLICA_SERIES = (("replica_free_pages", "free_pages"),
+                   ("replica_queue_depth", "queue_depth"),
+                   ("replica_active_slots", "active_slots"),
+                   ("replica_queue_age_p95", "queue_age_p95"),
+                   ("replica_admissions_total", "admissions"),
+                   ("replica_redistributions_total", "redistributions"),
+                   ("replica_stuck_dispatches_total", "stuck_dispatches"))
+
+
+class _RouterAcc:
+    """Router-tier rollup from ``{fleet_dir}/router/`` snapshots: the
+    fleet-health state, admission and redistribution counts per replica
+    plus the router's request/completion tallies. Counter series are
+    cumulative within the router process, so "latest generation wins"
+    per exact label set is the correct fold (summing snapshot files
+    would double count)."""
+
+    def __init__(self):
+        self.replicas: Dict[str, dict] = {}
+        self.requests: Dict[str, int] = {}
+        self.completions: Dict[str, int] = {}
+        self.redistributions: Dict[str, Dict[str, int]] = {}
+
+    def _rep(self, labels) -> dict:
+        return self.replicas.setdefault(labels.get("replica", "?"), {})
+
+    def fold(self, metrics: dict) -> None:
+        def series(name):
+            m = metrics.get(name)
+            return m.get("series", []) if isinstance(m, dict) else []
+
+        for s in series("router_replica_state"):
+            code = int(s["value"])
+            self._rep(s["labels"])["state"] = _REPLICA_STATES.get(
+                code, str(code))
+        for s in series("router_admissions_total"):
+            self._rep(s["labels"])["admissions"] = int(s["value"])
+        for s in series("router_redistributions_total"):
+            rid = s["labels"].get("replica", "?")
+            cause = s["labels"].get("cause", "?")
+            self.redistributions.setdefault(rid, {})[cause] = int(s["value"])
+        for s in series("router_requests_total"):
+            self.requests[s["labels"].get("priority", "?")] = int(s["value"])
+        for s in series("router_completions_total"):
+            self.completions[s["labels"].get("reason", "?")] = int(s["value"])
+
+    def summary(self) -> dict:
+        if not (self.replicas or self.requests or self.completions):
+            return {}
+        reps = {}
+        for rid, rec in self.replicas.items():
+            by_cause = self.redistributions.get(rid, {})
+            reps[rid] = dict(rec, redistributions=sum(by_cause.values()),
+                             redistributions_by_cause=dict(by_cause))
+        for rid, by_cause in self.redistributions.items():
+            if rid not in reps:  # redistributions off an already-gone id
+                reps[rid] = {"redistributions": sum(by_cause.values()),
+                             "redistributions_by_cause": dict(by_cause)}
+        return {"replicas": reps, "requests": dict(self.requests),
+                "completions": dict(self.completions)}
+
+
 class FleetAggregator:
     """Merge every rank's fleet-dir snapshots into a :class:`FleetReport`.
 
@@ -589,9 +667,22 @@ class FleetAggregator:
                     rec["_rank"], rec["_gen"] = rank, g
                     events.append(rec)
                 gens.add(g)
+        router = _RouterAcc()
+        for path in _gen_sorted(glob.glob(
+                os.path.join(self.directory, "router", "metrics-g*.json"))):
+            try:
+                with open(path) as f:
+                    snap = json.load(f)
+                metrics = snap["metrics"]
+                if not isinstance(metrics, dict):
+                    raise TypeError(type(metrics).__name__)
+            except (OSError, ValueError, KeyError, TypeError):
+                torn.append(path)  # same skip-count-go-on contract
+                continue
+            router.fold(metrics)
         profiles = self._collect_profiles(rank_dirs)
         self._last_torn = list(torn)
-        if not events and not torn \
+        if not events and not torn and not router.summary() \
                 and not any(s.generations for s in ranks.values()):
             return None
         events.sort(key=lambda e: e.get("ts") or 0.0)
@@ -603,7 +694,7 @@ class FleetAggregator:
             generations=sorted(gens), events=events, stragglers=stragglers,
             skew_timeline=timeline, goodput=ledger,
             serving=serving.summary(), torn_snapshots=len(torn),
-            profiles=profiles)
+            profiles=profiles, router=router.summary())
 
     @staticmethod
     def _collect_profiles(rank_dirs) -> Dict[int, dict]:
@@ -645,6 +736,11 @@ class FleetAggregator:
                             "comm_exposed_share")):
             for s in series(name):
                 setattr(stats, attr, float(s["value"]))
+        for name, key in _REPLICA_SERIES:
+            for s in series(name):
+                if stats.replica is None:
+                    stats.replica = {}
+                stats.replica[key] = float(s["value"])
         ts = meta.get("ts")
         if isinstance(ts, (int, float)):
             stats.last_ts = max(stats.last_ts or ts, ts)

@@ -18,8 +18,9 @@ recovery); this module is the serving side's equivalent, consumed by
     dispatch of the serving loop. Threading-based (``threading.Timer``, no
     signal dependency, safe off the main thread): if a dispatch does not
     return within the budget it emits a ``gen_stuck_dispatch`` event
-    carrying the compiled-program family and the last step id — the server
-    pages an operator instead of hanging silently. The dispatch itself is
+    carrying the compiled-program family, the last step id and the
+    replica/rank identity — the server pages an operator (and the fleet
+    health tier degrades the replica) instead of hanging silently. The dispatch itself is
     never killed (XLA owns it); the watchdog is observability, not
     preemption.
 
@@ -35,6 +36,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import threading
 from collections import deque
 from typing import Optional
@@ -190,10 +192,19 @@ class DispatchWatchdog:
     Timer-based, not signal-based, so it works from any thread (the
     serving loop often is not the main thread) and never interrupts the
     dispatch; ``timeout_s <= 0`` disables the guard to a bare yield.
+
+    The event payload carries the replica/rank identity so fleet health
+    (``mxnet_tpu.serving.health``) can attribute a stall to exactly one
+    replica: set ``replica`` (the serving tier does this when it wraps a
+    batcher) or it falls back to ``MXNET_TPU_PROCID``.
     """
 
-    def __init__(self, timeout_s: float = 0.0):
+    def __init__(self, timeout_s: float = 0.0,
+                 replica: Optional[int] = None):
         self.timeout_s = float(timeout_s)
+        #: replica/rank this watchdog guards; None falls back to the
+        #: process rank env at alarm time
+        self.replica = replica
         self.stalls = 0
         self.last_stall: Optional[dict] = None
         self._lock = threading.Lock()
@@ -203,17 +214,25 @@ class DispatchWatchdog:
         return self.timeout_s > 0
 
     def _alarm(self, family: str, step_id: int) -> None:
+        replica = self.replica
+        if replica is None:
+            try:
+                replica = int(os.environ.get("MXNET_TPU_PROCID", "0"))
+            except ValueError:
+                replica = 0
         with self._lock:
             self.stalls += 1
             self.last_stall = {"family": family, "step_id": step_id,
+                               "replica": replica,
                                "timeout_s": self.timeout_s}
         _obs.counter("gen_stuck_dispatch_total",
                      "serving dispatches that exceeded the watchdog "
                      "budget").inc(family=family)
         _obs.emit("gen_stuck_dispatch", family=family, step_id=step_id,
-                  timeout_s=self.timeout_s)
-        logger.error("stuck dispatch: family=%s step_id=%d still running "
-                     "after %.3fs", family, step_id, self.timeout_s)
+                  replica=replica, timeout_s=self.timeout_s)
+        logger.error("stuck dispatch: replica=%s family=%s step_id=%d still "
+                     "running after %.3fs", replica, family, step_id,
+                     self.timeout_s)
 
     @contextlib.contextmanager
     def guard(self, family: str, step_id: int = 0):

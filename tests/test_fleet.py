@@ -387,6 +387,42 @@ def test_aggregator_empty_dir(tmp_path):
     assert FleetAggregator(str(tmp_path)).collect() is None
 
 
+def test_aggregator_torn_snapshots_under_writer_churn(tmp_path):
+    """A non-atomic writer killed mid-write, over and over: each torn
+    generation is counted (once), never fatal, and a torn file claiming
+    a newer heartbeat must not advance the rank's last_ts — a crashed
+    replica's half-written snapshot cannot resurrect it (ISSUE 16)."""
+    _write_snapshot(tmp_path, 0, 0, metrics=_step_hist([0.1]),
+                    events=[_step_event(1, 0.1, 100.1)], ts=100.0)
+    d = os.path.join(str(tmp_path), "telemetry-h0")
+    agg = FleetAggregator(str(tmp_path))
+    before = obs.REGISTRY.counter("fleet_torn_snapshots_total").total()
+    torn_written = 0
+    # churn: generations 1..4 each appear torn first (writer died
+    # mid-write, bogus fresh ts visible in the fragment), get polled,
+    # then the writer's replacement completes them
+    for gen in range(1, 5):
+        path = os.path.join(d, f"metrics-g{gen}.json")
+        with open(path, "w") as f:
+            f.write('{"meta": {"rank": 0, "generation": %d, '
+                    '"ts": 9999.0}, "metr' % gen)
+        torn_written += 1
+        report, _ = agg.poll()
+        assert report is not None  # counted, never fatal
+        assert report.torn_snapshots == 1  # only the current fragment
+        # the bogus 9999.0 heartbeat in the torn fragment must not leak
+        assert report.ranks[0].last_ts == 100.0 + (gen - 1)
+        agg.poll()  # re-polling the same torn file never double counts
+        _write_snapshot(tmp_path, 0, gen, metrics=_step_hist([0.1]),
+                        ts=100.0 + gen)
+        report, _ = agg.poll()
+        # completed: the generation now folds in and advances the clock
+        assert sorted(report.ranks[0].generations) == list(range(gen + 1))
+        assert report.ranks[0].last_ts == 100.0 + gen
+    after = obs.REGISTRY.counter("fleet_torn_snapshots_total").total()
+    assert after - before == torn_written
+
+
 def test_aggregator_poll_emits_straggler_telemetry(tmp_path):
     events = []
     for step in (1, 2):

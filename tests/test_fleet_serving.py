@@ -1,0 +1,637 @@
+"""Fleet serving tier (ISSUE 16, docs/INFERENCE.md "Fleet serving"):
+
+  - watchdog stall attribution: ``gen_stuck_dispatch`` carries the
+    replica identity (explicit or from MXNET_TPU_PROCID);
+  - the batcher's ``"redistributed"`` terminal reason: withdraw /
+    withdraw_queued / abandon semantics and counter coverage, drain-mode
+    admission stop;
+  - ServingReplica publish + read_fleet_views round-trip through the
+    shared fleet dir, torn-newest fallback (staleness, never
+    resurrection), FleetAggregator folding of the replica_* series;
+  - FleetHealth state machine LIVE -> DEGRADED -> DRAINING -> DEAD on a
+    fake clock: heartbeat vs stuck causes, recovery only for heartbeat,
+    DEAD terminal;
+  - FleetRouter: priority-ordered dispatch, power-of-two-choices on
+    published scores, session affinity (and its drop on degrade),
+    redistribution from a dead replica without extending deadlines;
+  - the `make chaos-fleet` gate (tools/servedrill.py --fleet) goes green
+    on a real drill and red on tampered evidence.
+"""
+import copy
+import importlib.util
+import itertools
+import json
+import os
+import time
+import types
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.inference import ContinuousBatcher, GenerationEngine
+from mxnet_tpu.inference.batcher import FINISH_REASONS
+from mxnet_tpu.models import gpt2
+from mxnet_tpu.observability import REGISTRY
+from mxnet_tpu.observability.fleet import FleetAggregator
+from mxnet_tpu.resilience import DispatchWatchdog
+from mxnet_tpu.serving import (DEAD, DEGRADED, DRAINING, LIVE, FleetHealth,
+                               FleetRouter, ServingReplica, read_fleet_views)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+VOCAB, PAD = 97, 0
+
+
+def _gpt2(max_length=64, seed=0):
+    mx.random.seed(seed)
+    net = gpt2.GPT2Model(num_layers=2, units=64, num_heads=4,
+                         max_length=max_length, vocab_size=VOCAB, dropout=0.0)
+    net.initialize()
+    _ = net(nd.array(np.zeros((1, 4)), dtype="int32"))
+    return net
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _gpt2()
+
+
+def _engine(net, **kw):
+    kw.setdefault("batch_size", 2)
+    kw.setdefault("prefill_buckets", (8,))
+    kw.setdefault("eos_id", None)
+    kw.setdefault("pad_id", PAD)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("num_pages", 12)
+    return GenerationEngine(net, paged=True, **kw)
+
+
+def _prompt(n, seed):
+    return list(np.random.RandomState(seed).randint(1, VOCAB, n))
+
+
+def _counter(name, **labels):
+    c = REGISTRY.get(name)
+    if c is None:
+        return 0
+    return c.value(**labels) if labels else c.total()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# a duck-typed batcher: enough surface for ServingReplica/FleetRouter unit
+# tests without paying a jit compile per replica (the real-batcher paths
+# are covered by TestRedistributed below and the chaos-fleet drill)
+# ---------------------------------------------------------------------------
+class _FakeReq:
+    def __init__(self, req_id, prompt, max_new_tokens):
+        self.id = req_id
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.slot = None
+        self.finish_reason = None
+        self.output = []
+
+    @property
+    def done(self):
+        return self.finish_reason is not None
+
+
+class FakeBatcher:
+    def __init__(self, capacity=2, free_pages=12):
+        self.engine = types.SimpleNamespace(free_pages=free_pages,
+                                            num_pages=free_pages)
+        self.watchdog = types.SimpleNamespace(replica=None, stalls=0)
+        self.capacity = capacity
+        self.draining = False
+        self._queue = []
+        self._slots = []
+        self._ids = itertools.count()
+
+    def submit(self, prompt, max_new_tokens=32, deadline_s=None):
+        r = _FakeReq(next(self._ids), prompt, max_new_tokens)
+        if self.draining:
+            r.finish_reason = "shed"
+            return r
+        self._queue.append(r)
+        return r
+
+    def step(self):
+        if not self.draining:
+            while self._queue and len(self._slots) < self.capacity:
+                r = self._queue.pop(0)
+                r.slot = len(self._slots)
+                self._slots.append(r)
+        for r in list(self._slots):
+            r.output.append(7)
+            if len(r.output) >= r.max_new_tokens:
+                r.finish_reason = "length"
+                self._slots.remove(r)
+        return bool(self._slots or self._queue)
+
+    def begin_drain(self):
+        self.draining = True
+
+    def withdraw_queued(self):
+        out, self._queue = self._queue, []
+        for r in out:
+            r.finish_reason = "redistributed"
+        return out
+
+    def abandon(self):
+        out = self.withdraw_queued()
+        for r in self._slots:
+            r.finish_reason = "redistributed"
+            out.append(r)
+        self._slots = []
+        return out
+
+    @property
+    def active(self):
+        return len(self._slots)
+
+    @property
+    def pending(self):
+        return len(self._queue)
+
+    def queue_age_p95(self, now=None):
+        return 0.0
+
+
+def _fake_replica(rid, fleet_dir, clock, capacity=2):
+    return ServingReplica(rid, FakeBatcher(capacity=capacity),
+                          str(fleet_dir), clock=clock)
+
+
+# ---------------------------------------------------------------------------
+# watchdog replica attribution
+# ---------------------------------------------------------------------------
+class TestWatchdogReplicaIdentity:
+    def test_explicit_replica_in_stall_record(self):
+        wd = DispatchWatchdog(timeout_s=0.05, replica=7)
+        with wd.guard("decode", step_id=3):
+            time.sleep(0.15)
+        assert wd.stalls == 1
+        assert wd.last_stall["replica"] == 7
+        assert wd.last_stall["family"] == "decode"
+
+    def test_env_fallback_identity(self, monkeypatch):
+        monkeypatch.setenv("MXNET_TPU_PROCID", "5")
+        wd = DispatchWatchdog(timeout_s=0.05)
+        with wd.guard("prefill", step_id=0):
+            time.sleep(0.15)
+        assert wd.last_stall["replica"] == 5
+
+    def test_serving_replica_claims_the_watchdog(self, tmp_path):
+        rep = _fake_replica(9, tmp_path, FakeClock())
+        assert rep.batcher.watchdog.replica == 9
+
+
+# ---------------------------------------------------------------------------
+# batcher "redistributed" terminal reason (real batcher)
+# ---------------------------------------------------------------------------
+class TestRedistributed:
+    def test_reason_is_registered(self):
+        assert "redistributed" in FINISH_REASONS
+
+    def test_withdraw_queued_request(self, net):
+        clock = FakeClock()
+        bat = ContinuousBatcher(_engine(net, batch_size=1), clock=clock)
+        r1 = bat.submit(_prompt(5, 1), max_new_tokens=8)
+        bat.step()  # r1 takes the only slot
+        assert r1.slot == 0
+        r2 = bat.submit(_prompt(5, 2), max_new_tokens=8)
+        c0 = _counter("gen_requests_total", reason="redistributed")
+        assert bat.withdraw(r2) is True
+        assert r2.finish_reason == "redistributed" and r2.output == []
+        assert bat.pending == 0
+        assert _counter("gen_requests_total",
+                        reason="redistributed") == c0 + 1
+        # idempotent: a finished request cannot be withdrawn again
+        assert bat.withdraw(r2) is False
+        # active rows hold cache state here — never withdrawable
+        assert bat.withdraw(r1) is False
+        assert r1.finish_reason is None
+
+    def test_abandon_marks_queue_and_slots(self, net):
+        bat = ContinuousBatcher(_engine(net, batch_size=1),
+                                clock=FakeClock())
+        r1 = bat.submit(_prompt(5, 3), max_new_tokens=8)
+        bat.step()
+        r2 = bat.submit(_prompt(5, 4), max_new_tokens=8)
+        c0 = _counter("gen_requests_total", reason="redistributed")
+        lost = bat.abandon()
+        assert {r.id for r in lost} == {r1.id, r2.id}
+        assert r1.finish_reason == "redistributed"
+        assert r2.finish_reason == "redistributed"
+        assert bat.active == 0 and bat.pending == 0
+        assert _counter("gen_requests_total",
+                        reason="redistributed") == c0 + 2
+
+    def test_drain_stops_admission_and_sheds_submits(self, net):
+        clock = FakeClock()
+        bat = ContinuousBatcher(_engine(net, batch_size=1), clock=clock)
+        r1 = bat.submit(_prompt(5, 5), max_new_tokens=3)
+        bat.step()
+        r2 = bat.submit(_prompt(5, 6), max_new_tokens=3)
+        bat.begin_drain()
+        s0 = _counter("gen_shed_total", cause="draining")
+        r3 = bat.submit(_prompt(5, 7), max_new_tokens=3)
+        assert r3.done and r3.finish_reason == "shed"
+        assert _counter("gen_shed_total", cause="draining") == s0 + 1
+        withdrawn = bat.withdraw_queued()
+        assert withdrawn == [r2]
+        # in-flight work still finishes normally under drain
+        bat.run_until_idle(max_steps=10)
+        assert r1.finish_reason == "length"
+        assert bat.active == 0 and bat.pending == 0
+
+    def test_queue_age_p95_tracks_live_queue(self, net):
+        clock = FakeClock()
+        bat = ContinuousBatcher(_engine(net, batch_size=1), clock=clock)
+        assert bat.queue_age_p95() == 0.0
+        bat.submit(_prompt(5, 8), max_new_tokens=4)
+        bat.step()  # admitted; queue empty again
+        bat.submit(_prompt(5, 9), max_new_tokens=4)
+        clock.advance(2.0)
+        bat.submit(_prompt(5, 10), max_new_tokens=4)
+        clock.advance(1.0)
+        ages = bat.queue_ages()
+        assert sorted(ages) == [1.0, 3.0]
+        assert bat.queue_age_p95() == 3.0
+
+
+# ---------------------------------------------------------------------------
+# replica publish + fleet views
+# ---------------------------------------------------------------------------
+class TestReplicaPublish:
+    def test_publish_and_read_round_trip(self, tmp_path):
+        clock = FakeClock()
+        clock.advance(100.0)
+        rep = _fake_replica(2, tmp_path, clock)
+        rep.submit(_prompt(4, 1), max_new_tokens=4)
+        rep.submit(_prompt(4, 2), max_new_tokens=4)
+        rep.submit(_prompt(4, 3), max_new_tokens=4)
+        rep.step()  # 2 admitted, 1 queued; publishes
+        views = read_fleet_views(str(tmp_path))
+        assert set(views) == {2}
+        v = views[2]
+        assert v["ts"] == 100.0
+        assert v["active_slots"] == 2.0
+        assert v["queue_depth"] == 1.0
+        assert v["free_pages"] == 12.0
+        assert v["admissions"] == 2.0
+
+    def test_torn_newest_falls_back_to_stale_not_resurrect(self, tmp_path):
+        clock = FakeClock()
+        clock.advance(50.0)
+        rep = _fake_replica(0, tmp_path, clock)
+        rep.publish()
+        # a non-atomic writer killed mid-write leaves a torn newer
+        # generation claiming a fresh heartbeat — the reader must fall
+        # back to the older VALID snapshot (reads as stale), never parse
+        # the garbage
+        with open(os.path.join(rep.directory, "metrics-g1.json"), "w") as f:
+            f.write('{"meta": {"rank": 0, "ts": 9999.0}, "metr')
+        views = read_fleet_views(str(tmp_path))
+        assert views[0]["ts"] == 50.0
+        assert views[0]["generation"] == 0
+
+    def test_aggregator_folds_replica_series(self, tmp_path):
+        clock = FakeClock()
+        clock.advance(10.0)
+        rep = _fake_replica(1, tmp_path, clock)
+        rep.submit(_prompt(4, 4), max_new_tokens=2)
+        rep.step()
+        report = FleetAggregator(str(tmp_path)).collect()
+        assert report is not None
+        rs = report.ranks[1]
+        assert rs.replica is not None
+        assert rs.replica["active_slots"] == 1.0
+        assert rs.replica["free_pages"] == 12.0
+        assert rs.replica["admissions"] == 1.0
+        assert "replica" in report.summary()["ranks"]["1"]
+
+
+# ---------------------------------------------------------------------------
+# health state machine
+# ---------------------------------------------------------------------------
+class TestFleetHealth:
+    def _health(self):
+        return FleetHealth(hb_timeout=2.0, drain_after=3.0, dead_grace=10.0)
+
+    def _view(self, ts, stuck=0.0, active=1.0, queue=0.0):
+        return {"ts": ts, "stuck_dispatches": stuck,
+                "active_slots": active, "queue_depth": queue}
+
+    def test_heartbeat_degrade_and_recover(self):
+        clock = FakeClock()
+        h = self._health()
+        h.register(0, clock())
+        clock.advance(1.0)
+        assert h.evaluate(clock(), {0: self._view(ts=1.0)}) == []
+        assert h.state(0) == LIVE
+        clock.advance(2.5)  # hb age 2.5 > 2.0
+        trs = h.evaluate(clock(), {})
+        assert [t["to"] for t in trs] == [DEGRADED]
+        assert trs[0]["cause"] == "heartbeat"
+        clock.advance(0.5)  # fresh publish before drain_after: recovers
+        trs = h.evaluate(clock(), {0: self._view(ts=clock())})
+        assert [t["to"] for t in trs] == [LIVE]
+        assert h.state(0) == LIVE
+
+    def test_stuck_degrade_never_recovers_then_drains(self):
+        clock = FakeClock()
+        h = self._health()
+        h.register(0, clock())
+        h.evaluate(clock(), {0: self._view(ts=0.0)})
+        clock.advance(1.0)
+        trs = h.evaluate(clock(), {0: self._view(ts=1.0, stuck=1.0)})
+        assert [t["to"] for t in trs] == [DEGRADED]
+        assert trs[0]["cause"] == "stuck_dispatch"
+        # heartbeats keep coming but the wedged program still owns the
+        # device: no recovery, only the drain timer
+        clock.advance(1.0)
+        assert h.evaluate(clock(), {0: self._view(ts=2.0, stuck=1.0)}) == []
+        assert h.state(0) == DEGRADED
+        clock.advance(3.0)  # degraded for 4.0 > drain_after 3.0
+        trs = h.evaluate(clock(), {0: self._view(ts=5.0, stuck=1.0)})
+        assert [t["to"] for t in trs] == [DRAINING]
+        # drained-empty view -> DEAD
+        clock.advance(1.0)
+        trs = h.evaluate(clock(), {0: self._view(ts=6.0, stuck=1.0,
+                                                 active=0.0, queue=0.0)})
+        assert [t["to"] for t in trs] == [DEAD]
+        assert trs[0]["cause"] == "drained"
+
+    def test_dead_grace_expiry_and_terminal_state(self):
+        clock = FakeClock()
+        h = self._health()
+        h.register(0, clock())
+        clock.advance(3.0)  # never published: stale from first_seen
+        assert [t["to"] for t in h.evaluate(clock(), {})] == [DEGRADED]
+        clock.advance(4.0)
+        assert [t["to"] for t in h.evaluate(clock(), {})] == [DRAINING]
+        clock.advance(11.0)  # no drained view ever arrives
+        trs = h.evaluate(clock(), {})
+        assert [t["to"] for t in trs] == [DEAD]
+        assert trs[0]["cause"] == "drain_grace_expired"
+        # terminal: a late fresh snapshot never resurrects the id
+        clock.advance(1.0)
+        assert h.evaluate(clock(), {0: self._view(ts=clock())}) == []
+        assert h.state(0) == DEAD
+
+    def test_transition_counter_and_gauge(self):
+        clock = FakeClock()
+        h = self._health()
+        h.register(4, clock())
+        c0 = _counter("router_replica_transitions_total", to=DEGRADED)
+        clock.advance(2.5)
+        h.evaluate(clock(), {})
+        assert _counter("router_replica_transitions_total",
+                        to=DEGRADED) == c0 + 1
+        g = REGISTRY.get("router_replica_state")
+        assert g.value(replica="4") == 1.0  # degraded=1
+
+
+# ---------------------------------------------------------------------------
+# router
+# ---------------------------------------------------------------------------
+class TestRouter:
+    def _fleet(self, tmp_path, n=2, capacity=2, **kw):
+        clock = FakeClock()
+        clock.advance(1.0)
+        health = FleetHealth(hb_timeout=2.0, drain_after=1.0, dead_grace=3.0)
+        kw.setdefault("queue_bound", 4)
+        kw.setdefault("seed", 0)
+        router = FleetRouter(str(tmp_path), health=health, clock=clock, **kw)
+        reps = {}
+        for rid in range(n):
+            rep = _fake_replica(rid, tmp_path, clock, capacity=capacity)
+            rep.publish()
+            router.attach(rep)
+            reps[rid] = rep
+        return router, reps, clock, health
+
+    def _tick(self, router, reps, clock, dt=1.0):
+        clock.advance(dt)
+        router.step()
+        for rep in reps.values():
+            rep.step()
+
+    def test_dispatch_and_completion(self, tmp_path):
+        router, reps, clock, _ = self._fleet(tmp_path)
+        rqs = [router.submit(_prompt(4, s), max_new_tokens=3)
+               for s in range(3)]
+        c0 = _counter("router_completions_total", reason="length")
+        for _ in range(8):
+            self._tick(router, reps, clock)
+            if all(r.done for r in rqs):
+                break
+        assert all(r.finish_reason == "length" for r in rqs)
+        assert all(len(r.result()) == 3 for r in rqs)
+        assert router.idle
+        assert _counter("router_completions_total",
+                        reason="length") == c0 + 3
+        # p2c spread the work: every attempt landed on an attached rid
+        assert all(set(r.replicas_tried) <= set(reps) for r in rqs)
+
+    def test_priority_classes_dispatch_in_order(self, tmp_path):
+        router, reps, clock, _ = self._fleet(
+            tmp_path, n=1, classes=["interactive", "batch"])
+        lo = router.submit(_prompt(4, 1), max_new_tokens=2,
+                           priority="batch")
+        hi = router.submit(_prompt(4, 2), max_new_tokens=2,
+                           priority="interactive")
+        clock.advance(1.0)
+        router.step()
+        # both dispatched to the lone replica, interactive first
+        assert [r.id for r in reps[0].batcher._queue] == [0, 1]
+        assert reps[0].requests[0].prompt == hi.prompt
+        assert reps[0].requests[1].prompt == lo.prompt
+        with pytest.raises(ValueError):
+            router.submit(_prompt(4, 3), priority="nope")
+
+    def test_queue_bound_holds_work_in_router(self, tmp_path):
+        router, reps, clock, _ = self._fleet(tmp_path, n=1, queue_bound=2)
+        for s in range(5):
+            router.submit(_prompt(4, s), max_new_tokens=2)
+        clock.advance(1.0)
+        router.step()
+        # published depth 0 + added: dispatches stop once depth exceeds
+        # the bound; the rest waits in the router backlog
+        assert reps[0].batcher.pending <= 3
+        assert router.backlog == 5 - reps[0].batcher.pending
+
+    def test_session_affinity_and_drop_on_degrade(self, tmp_path):
+        router, reps, clock, health = self._fleet(tmp_path, n=2)
+        r1 = router.submit(_prompt(4, 1), max_new_tokens=2, session="s")
+        for _ in range(5):
+            self._tick(router, reps, clock)
+            if r1.done:
+                break
+        home = r1.replicas_tried[0]
+        assert router._sessions["s"] == home
+        r2 = router.submit(_prompt(4, 2), max_new_tokens=2, session="s")
+        self._tick(router, reps, clock)
+        assert r2.replicas_tried[0] == home  # prefix pages live there
+        # stop all publishing: heartbeats go stale, the fleet degrades,
+        # and the session pin must drop with its home replica
+        clock.advance(3.0)
+        router.step()
+        assert health.state(home) == DEGRADED
+        assert "s" not in router._sessions
+
+    def test_dead_replica_redistributes_in_deadline_work(self, tmp_path):
+        router, reps, clock, health = self._fleet(tmp_path, n=2,
+                                                  capacity=1)
+        # pin every request onto replica 0 via affinity, then kill it
+        rqs = [router.submit(_prompt(4, s), max_new_tokens=3, session="s",
+                             deadline_s=60.0) for s in range(3)]
+        clock.advance(1.0)
+        router.step()
+        victim = rqs[0].replicas_tried[0]
+        survivor = next(r for r in reps if r != victim)
+        assert router.assignments().get(victim, 0) >= 1
+        c0 = _counter("router_redistributions_total")
+        # the victim stops publishing and never steps again
+        for _ in range(20):
+            clock.advance(1.0)
+            router.step()
+            reps[survivor].step()
+            if all(r.done for r in rqs):
+                break
+        assert health.state(victim) == DEAD
+        assert victim not in router.replicas
+        assert all(r.finish_reason == "length" for r in rqs)
+        moved = [r for r in rqs if victim in r.replicas_tried]
+        assert moved and all(r.replicas_tried[-1] == survivor
+                             for r in moved)
+        assert all(r.redistributions >= 1 for r in moved)
+        assert _counter("router_redistributions_total") > c0
+
+    def test_redistribution_never_extends_deadline(self, tmp_path):
+        router, reps, clock, health = self._fleet(tmp_path, n=1,
+                                                  capacity=1)
+        r1 = router.submit(_prompt(4, 1), max_new_tokens=50,
+                           deadline_s=2.0)
+        clock.advance(1.0)
+        router.step()
+        assert r1.replicas_tried == [0]
+        # replica 0 dies holding the request; by the time health buries
+        # it the deadline has passed — the request finishes "deadline",
+        # it is NOT granted a fresh budget elsewhere
+        for _ in range(10):
+            clock.advance(1.0)
+            router.step()
+            if r1.done:
+                break
+        assert r1.finish_reason == "deadline"
+        assert r1.redistributions == 0
+
+    def test_backlog_expires_without_replicas(self, tmp_path):
+        clock = FakeClock()
+        router = FleetRouter(str(tmp_path), health=FleetHealth(
+            hb_timeout=2.0, drain_after=1.0, dead_grace=3.0), clock=clock)
+        r = router.submit(_prompt(4, 1), max_new_tokens=2, deadline_s=1.5)
+        clock.advance(2.0)
+        router.step()
+        assert r.finish_reason == "deadline"
+        assert router.idle
+
+    def test_dead_id_never_reattaches(self, tmp_path):
+        router, reps, clock, health = self._fleet(tmp_path, n=1)
+        clock.advance(3.0)  # silence -> degraded
+        router.step()
+        clock.advance(2.0)
+        router.step()  # draining
+        clock.advance(4.0)
+        router.step()  # dead (grace expired)
+        assert health.state(0) == DEAD
+        with pytest.raises(ValueError):
+            router.attach(_fake_replica(0, tmp_path, clock))
+        # a replacement under a fresh id joins fine
+        router.attach(_fake_replica(5, tmp_path, clock))
+        assert 5 in router.replicas
+
+    def test_router_publish_lands_in_router_dir(self, tmp_path):
+        router, reps, clock, _ = self._fleet(tmp_path, n=1)
+        router.submit(_prompt(4, 1), max_new_tokens=2)
+        clock.advance(1.0)
+        router.step()
+        assert router.publish(0) is True
+        path = os.path.join(str(tmp_path), "router", "metrics-g0.json")
+        with open(path) as f:
+            snap = json.load(f)
+        assert all(k.startswith("router_") for k in snap["metrics"])
+        assert "router_requests_total" in snap["metrics"]
+
+
+# ---------------------------------------------------------------------------
+# the chaos-fleet gate (tools/servedrill.py --fleet)
+# ---------------------------------------------------------------------------
+class TestChaosFleetGate:
+    @pytest.fixture(scope="class")
+    def servedrill(self):
+        spec = importlib.util.spec_from_file_location(
+            "servedrill_fleet_mod",
+            os.path.join(REPO, "tools", "servedrill.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    @pytest.fixture(scope="class")
+    def drill(self, servedrill, tmp_path_factory):
+        try:
+            return servedrill.run_fleet_drill(
+                telemetry_dir=str(tmp_path_factory.mktemp("fleetdrill")))
+        finally:
+            from mxnet_tpu import observability as obs
+
+            obs.disable()
+
+    def test_gate_green(self, servedrill, drill):
+        assert servedrill.validate_fleet(drill) == []
+
+    def test_dropped_request_fails_gate(self, servedrill, drill):
+        bad = copy.deepcopy(drill)
+        key = next(k for k, v in bad["requests"].items()
+                   if v["reason"] == "length")
+        bad["requests"][key]["reason"] = None
+        assert any("never terminated" in p
+                   for p in servedrill.validate_fleet(bad))
+
+    def test_corrupted_redistributed_tokens_fail_gate(self, servedrill,
+                                                      drill):
+        bad = copy.deepcopy(drill)
+        key = next(k for k, v in bad["requests"].items()
+                   if v["reason"] == "length" and v["redistributions"] > 0)
+        bad["requests"][key]["output"][0] ^= 1
+        assert any("diverge" in p or "baseline" in p
+                   for p in servedrill.validate_fleet(bad))
+
+    def test_wrong_transition_walk_fails_gate(self, servedrill, drill):
+        bad = copy.deepcopy(drill)
+        bad["transitions"][bad["wedge_rid"]] = [
+            {"to": "degraded", "cause": "stuck_dispatch"},
+            {"to": "dead", "cause": "drained"}]
+        assert any("degraded" in p.lower() or "walk" in p.lower()
+                   for p in servedrill.validate_fleet(bad))
+
+    def test_undrained_survivor_fails_gate(self, servedrill, drill):
+        bad = copy.deepcopy(drill)
+        rid = next(iter(bad["drained"]))
+        bad["drained"][rid]["active"] = 1
+        assert any("drain" in p.lower()
+                   for p in servedrill.validate_fleet(bad))

@@ -8,7 +8,10 @@ and prints one operator-facing summary: per-rank step-time /
 collective-wait distributions, the straggler/skew timeline, the goodput
 ledger (productive train vs checkpoint / restore / re-formation downtime /
 data stalls / idle), MFU, and serving rollups (TTFT + decode-rate
-percentiles, slot utilization).
+percentiles, slot utilization) — plus, when a fleet router published
+into ``{fleet_dir}/router/``, the router-tier columns: per-replica
+health state, admissions and redistributions joined with each replica's
+own published load signals (docs/INFERENCE.md "Fleet serving").
 
 Usage::
 
@@ -160,6 +163,32 @@ def render(s: dict) -> str:
         if "requests" in sv:
             w("   requests: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(sv["requests"].items())))
+
+    rt = s.get("router") or {}
+    if rt:
+        # router-tier columns (mxnet_tpu.serving): health state +
+        # admission/redistribution counts per replica, joined with each
+        # replica's own published load signals from its rank dir
+        w("-- router")
+        w(f"   {'replica':>7} {'state':>9} {'admits':>7} {'redist':>7} "
+          f"{'free pg':>8} {'queue':>6} {'age p95':>10}")
+        def _n(v):
+            return "-" if v is None else int(v)
+
+        for rid, rec in sorted(rt.get("replicas", {}).items(),
+                               key=lambda kv: kv[0]):
+            self_rep = (s["ranks"].get(str(rid)) or {}).get("replica") or {}
+            age = self_rep.get("queue_age_p95")
+            w(f"   {rid:>7} {rec.get('state', '?'):>9} "
+              f"{rec.get('admissions', 0):>7} "
+              f"{rec.get('redistributions', 0):>7} "
+              f"{_n(self_rep.get('free_pages')):>8} "
+              f"{_n(self_rep.get('queue_depth')):>6} "
+              f"{_fmt_s(age) if age is not None else '-':>10}")
+        for name in ("requests", "completions"):
+            if rt.get(name):
+                w(f"   {name}: " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(rt[name].items())))
     return "\n".join(out)
 
 

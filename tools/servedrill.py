@@ -37,6 +37,18 @@ Gate (exit 1 on any violation):
 ``--inject-leak`` is the tested failure path (like profcheck's
 ``--inject-empty-trace``): it corrupts the drained-state evidence and the
 gate must go red.
+
+``--fleet`` (`make chaos-fleet`) is the tier-level analogue over
+``mxnet_tpu.serving``: three replicas behind a telemetry-driven router,
+one replica KILLED mid-burst (stops stepping and publishing — a dead
+process) and one WEDGED (keeps heartbeating but every dispatch trips the
+watchdog — a stuck compiled program). The gate asserts zero dropped
+in-deadline requests (every one re-runs somewhere and finishes
+bit-identical to an undisturbed single-engine baseline), the wedged
+replica walks DEGRADED→DRAINING→DEAD with its work redistributed, a
+replacement replica joins under a fresh id, session affinity holds while
+the pinned replica stays LIVE, and the surviving replicas drain to a
+clean empty end state. ``--inject-drop`` is its tested failure path.
 """
 from __future__ import annotations
 
@@ -333,13 +345,373 @@ def validate(result):
     return problems
 
 
+# ---------------------------------------------------------------------------
+# --fleet: multi-replica chaos drill over mxnet_tpu.serving
+# ---------------------------------------------------------------------------
+
+#: (key, prompt seed, prompt len, max_new, priority class[, session])
+FLEET_FIRST = [("fs0", 40, 5, 6, "interactive", "sessA"),
+               ("fs1", 41, 6, 6, "normal"),
+               ("fs2", 42, 7, 6, "normal"),
+               ("fs3", 43, 5, 6, "batch"),
+               ("fs4", 44, 6, 6, "batch"),
+               ("fs5", 45, 7, 6, "normal")]
+#: second burst lands mid-failure (one replica dead, one wedging)
+FLEET_SECOND = [("fb0", 50, 5, 6, "normal"),
+                ("fb1", 51, 6, 6, "interactive"),
+                ("fb2", 52, 7, 6, "batch"),
+                ("fb3", 53, 5, 6, "normal")]
+#: second turn of sessA, submitted once fs0 completed — must land on the
+#: replica holding its prefix pages while that replica is LIVE
+FLEET_SESSION2 = ("fsA2", 46, 5, 6, "interactive", "sessA")
+#: deliberately hopeless deadline: the one request ALLOWED to expire
+FLEET_EXPIRE = ("expire", 60, 6, 8, "batch")
+
+KILL_TICK, WEDGE_TICK, REPLACEMENT_RID = 3, 4, 3
+
+
+def fleet_baseline():
+    """Undisturbed single-engine run of every fleet prompt — the
+    bit-identity reference a redistributed re-run must still match."""
+    from mxnet_tpu.inference import ContinuousBatcher, GenerationEngine
+
+    eng = GenerationEngine(build_net(), batch_size=2, prefill_buckets=(8,),
+                           eos_id=None, pad_id=PAD, paged=True, page_size=4,
+                           num_pages=12)
+    bat = ContinuousBatcher(eng)
+    reqs = {}
+    for spec in (FLEET_FIRST + FLEET_SECOND
+                 + [FLEET_SESSION2, FLEET_EXPIRE]):
+        key, seed, n, budget = spec[:4]
+        reqs[key] = bat.submit(_prompt(n, seed), max_new_tokens=budget)
+    bat.run_until_idle(max_steps=500)
+    return {k: r.result() for k, r in reqs.items()}
+
+
+def _fleet_replica(rid, net, fleet_dir, clock):
+    from mxnet_tpu.inference import ContinuousBatcher, GenerationEngine
+    from mxnet_tpu.serving import ServingReplica
+
+    eng = GenerationEngine(net, batch_size=2, prefill_buckets=(8,),
+                           eos_id=None, pad_id=PAD, paged=True, page_size=4,
+                           num_pages=12)
+    # watchdog disarmed while healthy: the first dispatches of a fresh
+    # replica pay wall-clock jit compiles that a tight drill budget would
+    # misread as stalls; the wedge arms it when the wedge starts
+    bat = ContinuousBatcher(eng, max_queue=8, queue_policy="reject",
+                            watchdog_s=0.0, clock=clock)
+    return ServingReplica(rid, bat, fleet_dir, clock=clock)
+
+
+def run_fleet_drill(max_ticks=60, telemetry_dir=None, fleet_dir=None):
+    """Run the multi-replica drill; returns the evidence dict
+    ``validate_fleet`` judges. One tick = one fake second: the router
+    schedules, then every still-running replica steps (the killed one
+    stops stepping AND publishing; the wedged one publishes heartbeats
+    but every dispatch trips its watchdog)."""
+    import tempfile
+
+    import mxnet_tpu  # noqa: F401  (package init)
+    from mxnet_tpu import observability as obs
+    from mxnet_tpu.observability.fleet import FleetAggregator
+    from mxnet_tpu.serving import DEAD, LIVE, FleetHealth, FleetRouter
+
+    t_wall = time.perf_counter()
+    base = fleet_baseline()
+
+    before = {
+        "redistributed": _counter("gen_requests_total",
+                                  reason="redistributed"),
+        "router_redistributions": _counter("router_redistributions_total"),
+        "stuck": _counter("gen_stuck_dispatch_total"),
+    }
+
+    run_dir = telemetry_dir or os.path.join(
+        "/tmp", f"fleetdrill-{os.getpid()}")
+    fdir = fleet_dir or tempfile.mkdtemp(prefix="fleetdrill-fleet-")
+    obs.enable(run_dir, run_id="fleetdrill")
+
+    clock = FakeClock()
+    net = build_net()
+    replicas = {rid: _fleet_replica(rid, net, fdir, clock)
+                for rid in (0, 1, 2)}
+    health = FleetHealth(hb_timeout=2.5, drain_after=2.0, dead_grace=6.0)
+    router = FleetRouter(fdir, health=health, queue_bound=3, affinity=True,
+                         seed=0, clock=clock)
+    for rep in replicas.values():
+        router.attach(rep)
+
+    reqs = {}
+
+    def sub(key, seed, n, budget, priority, session=None, deadline_s=500.0):
+        reqs[key] = router.submit(_prompt(n, seed), max_new_tokens=budget,
+                                  priority=priority, session=session,
+                                  deadline_s=deadline_s)
+
+    kill_rid = wedge_rid = None
+    affinity = {}
+    sess2_submitted = replacement_attached = False
+    ticks = 0
+    try:
+        for spec in FLEET_FIRST:
+            sub(*spec)
+        while ticks < max_ticks:
+            clock.advance(1.0)
+            ticks += 1
+            if ticks == KILL_TICK:
+                # kill the replica holding the most in-flight work: its
+                # loop AND its publisher stop — a dead process
+                counts = router.assignments()
+                kill_rid = max(replicas,
+                               key=lambda r: (counts.get(r, 0), -r))
+            if ticks == WEDGE_TICK:
+                # wedge the busiest survivor: heartbeats continue, every
+                # dispatch exceeds the watchdog budget
+                counts = router.assignments()
+                wedge_rid = max(
+                    (r for r in replicas if r != kill_rid),
+                    key=lambda r: (counts.get(r, 0), -r))
+                for spec in FLEET_SECOND:  # burst into the failing fleet
+                    sub(*spec)
+                sub(*FLEET_EXPIRE, deadline_s=1.5)
+            router.step()
+            if not sess2_submitted and reqs["fs0"].done:
+                first = (reqs["fs0"].replicas_tried[-1]
+                         if reqs["fs0"].replicas_tried else None)
+                affinity = {"first": first,
+                            "first_state": None if first is None
+                            else router.health.state(first)}
+                sub(*FLEET_SESSION2)
+                sess2_submitted = True
+            if not replacement_attached and wedge_rid is not None \
+                    and router.health.state(wedge_rid) == DEAD:
+                replacement_attached = True
+                replicas[REPLACEMENT_RID] = _fleet_replica(
+                    REPLACEMENT_RID, net, fdir, clock)
+                router.attach(replicas[REPLACEMENT_RID])
+            for rid, rep in replicas.items():
+                if router.health.state(rid) == DEAD:
+                    continue
+                if rid == kill_rid and ticks >= KILL_TICK:
+                    continue
+                if rid == wedge_rid and ticks >= WEDGE_TICK:
+                    wd = rep.batcher.watchdog
+                    wd.timeout_s = 0.05  # the wedge arms the watchdog
+                    with wd.guard("decode", 0):
+                        time.sleep(wd.timeout_s + 0.05)
+                    rep.publish()
+                    continue
+                rep.step()
+            if sess2_submitted and replacement_attached and router.idle \
+                    and all(r.done for r in reqs.values()):
+                break
+        router.publish(generation=0)
+        if sess2_submitted and reqs["fsA2"].replicas_tried:
+            affinity["second"] = reqs["fsA2"].replicas_tried[-1]
+        report = FleetAggregator(fdir).collect()
+        router_summary = report.summary().get("router", {}) if report \
+            else {}
+        events = obs.read_events(run_dir)
+    finally:
+        obs.disable()
+
+    survivors = {rid: rep for rid, rep in replicas.items()
+                 if router.health.state(rid) == LIVE}
+    result = {
+        "ticks": ticks,
+        "max_ticks": max_ticks,
+        "wall_s": time.perf_counter() - t_wall,
+        "baseline": base,
+        "kill_rid": kill_rid,
+        "wedge_rid": wedge_rid,
+        "replacement_attached": replacement_attached,
+        "expected_deadline": ["expire"],
+        "requests": {k: {"reason": r.finish_reason,
+                         "output": list(r.output),
+                         "redistributions": r.redistributions,
+                         "replicas": list(r.replicas_tried),
+                         "priority": r.priority}
+                     for k, r in reqs.items()},
+        "transitions": {rid: [{"to": t["to"], "cause": t["cause"]}
+                              for t in rec.transitions]
+                        for rid, rec in health.records.items()},
+        "counters": {
+            "redistributed": _counter("gen_requests_total",
+                                      reason="redistributed")
+            - before["redistributed"],
+            "router_redistributions":
+                _counter("router_redistributions_total")
+                - before["router_redistributions"],
+            "stuck": _counter("gen_stuck_dispatch_total") - before["stuck"],
+        },
+        "events": {
+            "names": sorted({e["event"] for e in events
+                             if e.get("event", "").startswith("replica_")}),
+            "stuck_replicas": sorted(
+                {e.get("replica") for e in events
+                 if e.get("event") == "gen_stuck_dispatch"}),
+        },
+        "affinity": affinity,
+        "router_state": {"backlog": router.backlog,
+                         "in_flight": router.in_flight},
+        "drained": {rid: {"active": rep.batcher.active,
+                          "pending": rep.batcher.pending,
+                          "free_pages": rep.engine.free_pages,
+                          "num_pages": rep.engine.num_pages,
+                          "reserved": rep.engine.reserved_pages}
+                    for rid, rep in survivors.items()},
+        "router_summary": router_summary,
+    }
+    return result
+
+
+def validate_fleet(result):
+    """Judge a fleet-drill result; returns violations (empty = OK)."""
+    problems = []
+    if result["ticks"] >= result["max_ticks"]:
+        problems.append(f"fleet drill did not settle within "
+                        f"{result['max_ticks']} ticks (possible hang)")
+    base = result["baseline"]
+    expected_deadline = set(result["expected_deadline"])
+    for key, rec in result["requests"].items():
+        reason, out = rec["reason"], rec["output"]
+        if reason is None:
+            problems.append(f"request {key} never terminated "
+                            "(dropped in-deadline work)")
+            continue
+        want = base.get(key, [])
+        if key in expected_deadline:
+            if reason != "deadline":
+                problems.append(f"request {key}: expected the hopeless "
+                                f"deadline to expire, got {reason!r}")
+            elif out != want[:len(out)]:
+                problems.append(f"request {key}: expired tokens are not a "
+                                "prefix of the baseline (corruption)")
+            continue
+        if reason != "length":
+            # every in-deadline request must be SERVED to its budget —
+            # a deadline/shed here is a dropped request
+            problems.append(f"in-deadline request {key} finished "
+                            f"{reason!r} instead of being served")
+        elif out != want:
+            problems.append(f"request {key}: tokens diverge from the "
+                            "undisturbed baseline (corruption across "
+                            "redistribution)")
+    if result["kill_rid"] is None or result["wedge_rid"] is None:
+        problems.append("drill never selected a kill/wedge replica")
+        return problems
+    tr = result["transitions"]
+    wedged = [t["to"] for t in tr.get(result["wedge_rid"], [])]
+    if wedged != ["degraded", "draining", "dead"]:
+        problems.append(f"wedged replica walked {wedged}, expected "
+                        "['degraded', 'draining', 'dead']")
+    wcauses = [t["cause"] for t in tr.get(result["wedge_rid"], [])]
+    if not wcauses or wcauses[0] != "stuck_dispatch":
+        problems.append(f"wedged replica degraded for {wcauses[:1]}, "
+                        "expected 'stuck_dispatch'")
+    killed = tr.get(result["kill_rid"], [])
+    if not killed or killed[-1]["to"] != "dead":
+        problems.append(f"killed replica never reached DEAD: {killed}")
+    elif killed[0]["cause"] != "heartbeat":
+        problems.append(f"killed replica degraded for "
+                        f"{killed[0]['cause']!r}, expected 'heartbeat'")
+    if not result["replacement_attached"]:
+        problems.append("replacement replica never joined the fleet")
+    c = result["counters"]
+    for name in ("redistributed", "router_redistributions", "stuck"):
+        if c[name] < 1:
+            problems.append(f"expected counter {name} >= 1, got {c[name]}")
+    ev = set(result["events"]["names"])
+    for name in ("replica_degraded", "replica_drain", "replica_dead"):
+        if name not in ev:
+            problems.append(f"event {name} missing from telemetry: "
+                            f"{sorted(ev)}")
+    if result["wedge_rid"] not in result["events"]["stuck_replicas"]:
+        problems.append("gen_stuck_dispatch events do not attribute the "
+                        f"wedged replica {result['wedge_rid']}: "
+                        f"{result['events']['stuck_replicas']}")
+    aff = result["affinity"]
+    if aff.get("first") is not None and aff.get("first_state") == "live" \
+            and aff.get("second") != aff["first"]:
+        problems.append(f"session affinity broken: first turn on replica "
+                        f"{aff['first']} (still LIVE), second landed on "
+                        f"{aff.get('second')}")
+    rs = result["router_state"]
+    if rs["backlog"] or rs["in_flight"]:
+        problems.append(f"router not idle: backlog={rs['backlog']} "
+                        f"in_flight={rs['in_flight']}")
+    if not result["drained"]:
+        problems.append("no surviving LIVE replica at the end")
+    for rid, d in result["drained"].items():
+        if d["active"] or d["pending"]:
+            problems.append(f"replica {rid} not drained: "
+                            f"active={d['active']} pending={d['pending']}")
+        if d["free_pages"] != d["num_pages"]:
+            problems.append(f"replica {rid} page leak: "
+                            f"{d['free_pages']}/{d['num_pages']} free")
+        if d["reserved"]:
+            problems.append(f"replica {rid} reservation leaked: "
+                            f"{d['reserved']} pages")
+    rsum = result["router_summary"].get("replicas", {})
+    for rid in (result["kill_rid"], result["wedge_rid"]):
+        if rsum.get(str(rid), {}).get("state") != "dead":
+            problems.append(f"fleet report does not show replica {rid} "
+                            f"dead: {rsum.get(str(rid))}")
+    if not any(rec.get("state") == "live" for rec in rsum.values()):
+        problems.append(f"fleet report shows no live replica: {rsum}")
+    return problems
+
+
+def main_fleet(args):
+    result = run_fleet_drill(max_ticks=args.max_ticks)
+    if args.inject_drop:
+        key = next(iter(result["requests"]))
+        result["requests"][key]["reason"] = None
+    problems = validate_fleet(result)
+
+    c = result["counters"]
+    print(f"fleetdrill: {len(result['requests'])} requests, "
+          f"{result['ticks']} ticks, {result['wall_s']:.1f}s wall")
+    print(f"  killed={result['kill_rid']} wedged={result['wedge_rid']} "
+          f"replacement={'yes' if result['replacement_attached'] else 'NO'}")
+    print(f"  transitions: " + "; ".join(
+        f"r{rid}:" + "->".join(t['to'] for t in trs)
+        for rid, trs in sorted(result["transitions"].items()) if trs))
+    print(f"  redistributed={c['redistributed']:.0f} "
+          f"(router pull-backs={c['router_redistributions']:.0f}) "
+          f"stuck={c['stuck']:.0f}")
+    reasons = sorted({v['reason'] or 'NONE'
+                      for v in result['requests'].values()})
+    print(f"  reasons: {', '.join(reasons)}")
+    print(f"  drained: {result['drained']}")
+    if problems:
+        for p in problems:
+            print(f"fleetdrill: FAIL: {p}")
+        return 1
+    print("fleetdrill: OK — zero in-deadline drops, wedged replica "
+          "degraded->drained->dead with work redistributed, survivors "
+          "drained clean")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--max-steps", type=int, default=250)
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the multi-replica fleet drill "
+                    "(make chaos-fleet) instead of the single-engine one")
+    ap.add_argument("--max-ticks", type=int, default=60,
+                    help="fleet drill tick budget (1 tick = 1 fake second)")
     ap.add_argument("--inject-leak", action="store_true",
                     help="failure-path test hook: corrupt the drained-state "
                     "evidence; the gate must fail")
+    ap.add_argument("--inject-drop", action="store_true",
+                    help="failure-path test hook (--fleet): erase one "
+                    "request's finish reason; the gate must fail")
     args = ap.parse_args(argv)
+
+    if args.fleet:
+        return main_fleet(args)
 
     result = run_drill(max_steps=args.max_steps)
     if args.inject_leak:
