@@ -141,6 +141,13 @@ EXTRA_HOT_PATHS: Dict[str, Tuple[str, ...]] = {
         "step_capture_begin", "CaptureController.begin_if_due",
         "CaptureController._consume_request",
     ),
+    # request-tracing emission probes: span() buffers on every serving
+    # dispatch round and finish()/decide() run per terminal request —
+    # hot-path rules hold them to the injected clock (no wall clock, no
+    # global RNG; the sampling hash is deterministic by construction)
+    "observability/tracing.py": (
+        "Tracer.span", "Tracer.finish", "TailSampler.decide",
+    ),
 }
 
 # function names that wrap a python callable into a compiled/traced one

@@ -158,6 +158,37 @@ _KNOBS: Dict[str, tuple] = {
     "router_seed": (int, 0, ("MXNET_TPU_ROUTER_SEED",),
                     "seed for the power-of-two-choices candidate sampling "
                     "(deterministic routing in drills and tests)"),
+    # -- request tracing + SLO ledger (docs/OBSERVABILITY.md
+    #    "Request tracing & SLO ledger") -------------------------------------
+    "trace": (bool, False, ("MXNET_TPU_TRACE",),
+              "per-request span tracing for the serving tier: router and "
+              "replicas append span JSONL into the fleet dir, joined by "
+              "request id at aggregation (off = one attribute read per "
+              "emission site)"),
+    "trace_sample": (float, 0.01, ("MXNET_TPU_TRACE_SAMPLE",),
+                     "fraction of HEALTHY traces whose spans are kept "
+                     "(deterministic hash of trace id, so router and "
+                     "replicas agree without coordinating); anomalous/"
+                     "slow/low-margin traces are always kept"),
+    "trace_seed": (int, 0, ("MXNET_TPU_TRACE_SEED",),
+                   "seed of the deterministic healthy-sampling hash"),
+    "trace_slow_pct": (float, 95.0, ("MXNET_TPU_TRACE_SLOW_PCT",),
+                       "tail-sampling slow percentile: traces at or above "
+                       "this percentile of recent end-to-end latency are "
+                       "always kept"),
+    "trace_margin_floor": (float, 0.0, ("MXNET_TPU_TRACE_MARGIN_FLOOR",),
+                           "deadline-margin floor (seconds): a trace "
+                           "finishing with less margin is always kept AND "
+                           "requests a measured-profile capture on its "
+                           "replica (prof-request contract); 0 = off"),
+    "trace_slo_target": (float, 0.99, ("MXNET_TPU_TRACE_SLO_TARGET",),
+                         "SLO attainment target the burn rates are "
+                         "computed against (burn = violation rate / "
+                         "(1 - target); > 1 burns budget)"),
+    "trace_slo_windows": (str, "60,300,3600", ("MXNET_TPU_TRACE_SLO_WINDOWS",),
+                          "comma-separated burn-rate window lengths in "
+                          "seconds, anchored at the newest finish "
+                          "timestamp the aggregator sees"),
     # -- compilation (docs/PERFORMANCE.md) -----------------------------------
     "compile_cache": (str, "", ("MXNET_TPU_COMPILE_CACHE",),
                       "persistent XLA compilation-cache directory "

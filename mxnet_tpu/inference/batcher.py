@@ -52,8 +52,15 @@ Serving resilience (docs/RESILIENCE.md "Serving resilience"):
 
 Serving telemetry (docs/OBSERVABILITY.md):
 
-  - ``ttft_seconds``          — submit → first sampled token (includes
-                                queue wait + prefill), per request;
+  - ``ttft_seconds``          — submit → first sampled token (queue wait
+                                + service combined, kept for continuity),
+                                per request;
+  - ``ttft_queue_seconds``    — submit → admission: the queue-wait half
+                                of TTFT, on the batcher clock;
+  - ``ttft_service_seconds``  — admission → first sampled token: the
+                                prefill-service half of TTFT, measured on
+                                the REAL wall clock (fake-clock drills
+                                still see true dispatch cost);
   - ``decode_tokens_per_s``   — generated-token rate after the first token,
                                 per request;
   - ``gen_queue_depth``       — requests waiting for a slot (gauge);
@@ -64,6 +71,16 @@ Serving telemetry (docs/OBSERVABILITY.md):
   - ``gen_requests_total{reason=...}`` — completions by finish reason;
   - ``gen_admission_rejects_total{reason=...}`` — submit-time rejects and
                                 page-bounded admission deferrals.
+
+Request tracing (docs/OBSERVABILITY.md "Request tracing & SLO ledger"):
+when ``self.tracer`` is set (the serving replica attaches one when the
+``trace`` knob is on), every request's residency here becomes spans —
+``replica.queue`` / ``prefill`` / ``decode`` (+ per-dispatch
+``decode.round``) — buffered per trace and tail-sample-flushed at local
+finish. ``trace_id`` rides in through :meth:`submit` (the fleet router
+passes its request id so cross-process traces join); direct clients get
+a local ``b{id}`` trace. Tracing off costs each site one
+``tracer is None`` read.
 """
 from __future__ import annotations
 
@@ -108,6 +125,15 @@ class GenRequest:
         self.first_token_t: Optional[float] = None
         self.finish_t: Optional[float] = None
         self.cancel_requested = False
+        #: trace identity (docs/OBSERVABILITY.md "Request tracing") —
+        #: the router's request id for fleet traffic, a local ``b{id}``
+        #: for direct clients, None when tracing is off
+        self.trace_id: Optional[str] = None
+        #: admission timestamp (batcher clock) — the replica.queue /
+        #: prefill span boundary and the ttft_queue_seconds sample
+        self.admit_t: Optional[float] = None
+        #: decode dispatch rounds this request rode
+        self.rounds = 0
 
     @property
     def done(self) -> bool:
@@ -192,17 +218,26 @@ class ContinuousBatcher:
         self._step_id = 0
         self._head_id: Optional[int] = None
         self._head_deferrals = 0
+        #: per-request span emitter (observability.tracing.Tracer) —
+        #: attached by the serving replica when the ``trace`` knob is
+        #: on; None costs every emission site one attribute read
+        self.tracer = None
         #: drain mode (fleet tier): no new admissions — queued work is
         #: pulled back by the router, in-flight rows finish or expire
         self.draining = False
 
     # -- client side ---------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
-               deadline_s: Optional[float] = None) -> GenRequest:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> GenRequest:
         """Queue a request. Raises ``ValueError`` for prompts that could
         never be served (no bucket / more pages than the pool); returns an
         already-finished handle (``finish_reason == "shed"``) when overload
-        control sheds it — callers must check ``req.done``."""
+        control sheds it — callers must check ``req.done``.
+
+        ``trace_id`` joins this request to a fleet-level trace (the
+        router passes its request id); when tracing is on and no id is
+        given, a local ``b{id}`` trace is opened."""
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if len(prompt) < 1:
@@ -226,6 +261,9 @@ class ContinuousBatcher:
             deadline_s = self.default_deadline_s
         req = GenRequest(next(self._ids), prompt, max_new_tokens,
                          deadline_s=deadline_s, clock=self._clock)
+        if self.tracer is not None:
+            req.trace_id = str(trace_id) if trace_id is not None \
+                else f"b{req.id}"
         now = req.submit_t
         if self.draining:
             # a draining replica takes nothing new — the router routes
@@ -320,6 +358,14 @@ class ContinuousBatcher:
             self._slots[slot] = None
             req.finish_reason = "redistributed"
             req.finish_t = now
+            tr = self.tracer
+            if tr is not None and req.trace_id is not None:
+                tr.span(req.trace_id, "decode",
+                        req.first_token_t if req.first_token_t is not None
+                        else now, now, rounds=req.rounds, slot=slot,
+                        outcome="redistributed", req=req.id)
+                tr.finish(req.trace_id, "redistributed", req.submit_t,
+                          now, deadline=req.deadline_t, req=req.id)
             _obs.counter("gen_requests_total",
                          "completed generation requests").inc(
                              reason="redistributed")
@@ -367,6 +413,27 @@ class ContinuousBatcher:
                        unit="s").observe(max(0.0, now - req.submit_t),
                                          outcome=outcome)
 
+    def _victims(self) -> dict:
+        """slot -> request id for every in-flight row — the watchdog
+        attaches it to a stall event so a wedge names its victims.
+        Computed only when the watchdog is armed."""
+        return {str(s): r.id for s, r in enumerate(self._slots)
+                if r is not None}
+
+    def _trace_queue_exit(self, req: GenRequest, now: float, outcome: str,
+                          terminal: bool, **attrs) -> None:
+        """Span the request's admission-queue residency; when the wait
+        ended the request (shed/expired/withdrawn), close the local
+        trace too — the tail sampler decides whether the spans flush."""
+        tr = self.tracer
+        if tr is None or req.trace_id is None:
+            return
+        tr.span(req.trace_id, "replica.queue", req.submit_t, now,
+                outcome=outcome, req=req.id, **attrs)
+        if terminal:
+            tr.finish(req.trace_id, outcome, req.submit_t, now,
+                      deadline=req.deadline_t, req=req.id)
+
     def _shed(self, req: GenRequest, now: float, cause: str) -> GenRequest:
         req.finish_reason = "shed"
         req.finish_t = now
@@ -375,6 +442,7 @@ class ContinuousBatcher:
         _obs.counter("gen_shed_total",
                      "requests shed by overload control").inc(cause=cause)
         self._queue_age(req, now, "shed")
+        self._trace_queue_exit(req, now, "shed", terminal=True, cause=cause)
         return req
 
     def _finish_queued(self, req: GenRequest, now: float, reason: str):
@@ -389,6 +457,7 @@ class ContinuousBatcher:
                          "requests expired by their deadline").inc(
                              where="queue")
         self._queue_age(req, now, reason)
+        self._trace_queue_exit(req, now, reason, terminal=True)
 
     def _finish(self, slot: int, reason: str):
         req = self._slots[slot]
@@ -396,6 +465,15 @@ class ContinuousBatcher:
         self.engine.release_slot(slot)
         req.finish_reason = reason
         req.finish_t = self._clock()
+        tr = self.tracer
+        if tr is not None and req.trace_id is not None:
+            tr.span(req.trace_id, "decode",
+                    req.first_token_t if req.first_token_t is not None
+                    else req.finish_t,
+                    req.finish_t, rounds=req.rounds, slot=slot,
+                    outcome=reason, req=req.id)
+            tr.finish(req.trace_id, reason, req.submit_t, req.finish_t,
+                      deadline=req.deadline_t, req=req.id)
         _obs.counter("gen_requests_total", "completed generation requests").inc(
             reason=reason)
         if reason == "deadline":
@@ -439,19 +517,38 @@ class ContinuousBatcher:
         allocator mutation)."""
         req.slot = slot
         self._slots[slot] = req
+        req.admit_t = now
         self._queue_age(req, now, "admitted")
+        self._trace_queue_exit(req, now, "admitted", terminal=False,
+                               slot=slot)
+        _obs.histogram("ttft_queue_seconds",
+                       "submit -> admission: the queue-wait half of ttft",
+                       unit="s").observe(max(0.0, now - req.submit_t))
 
         def _dispatch():
             # the watchdog arms per ATTEMPT (inside the retried closure):
             # retry backoff sleeps must never read as a stuck dispatch
-            with self._watchdog.guard("prefill", self._step_id):
+            with self._watchdog.guard("prefill", self._step_id,
+                                      victims={str(slot): req.id}
+                                      if self._watchdog.enabled else None):
                 return self.engine.prefill(req.prompt, slot)
 
+        svc0 = time.perf_counter()
         tok = _retry.retry_call(_dispatch, site="gen.prefill",
                                 policy=self._retry_policy)
+        svc = time.perf_counter() - svc0
         req.first_token_t = self._clock()
         _obs.histogram("ttft_seconds", "submit -> first sampled token",
                        unit="s").observe(req.first_token_t - req.submit_t)
+        _obs.histogram("ttft_service_seconds",
+                       "admission -> first sampled token: the service "
+                       "half of ttft, on the real wall clock",
+                       unit="s").observe(svc)
+        tr = self.tracer
+        if tr is not None and req.trace_id is not None:
+            tr.span(req.trace_id, "prefill", req.admit_t,
+                    req.first_token_t, service_s=round(svc, 6), slot=slot,
+                    req=req.id)
         req.output.append(tok)
         if self.engine.done[slot]:  # first token was EOS
             self._finish(slot, "eos")
@@ -551,18 +648,26 @@ class ContinuousBatcher:
         speculative = getattr(self.engine, "speculative", False)
         use_spec = speculative and (self.governor is None
                                     or self.governor.speculating)
+        tr = self.tracer
         if use_spec:
+            r0 = self._clock() if tr is not None else now
+
             def _round():
-                with self._watchdog.guard("spec_round", self._step_id):
+                with self._watchdog.guard("spec_round", self._step_id,
+                                          victims=self._victims()
+                                          if self._watchdog.enabled
+                                          else None):
                     return self.engine.spec_step()
 
             toks, counts, done = _retry.retry_call(
                 _round, site="gen.decode", policy=self._retry_policy)
+            r1 = self._clock() if tr is not None else now
             if self.governor is not None and self.engine.last_round_drafted:
                 self.governor.observe_round(self.engine.last_round_accepted,
                                             self.engine.last_round_drafted)
             for slot in was_active:
                 req = self._slots[slot]
+                req.rounds += 1
                 n = int(counts[slot])
                 appended = 0
                 for j in range(n):
@@ -570,6 +675,12 @@ class ContinuousBatcher:
                     appended += 1
                     if len(req.output) >= req.max_new_tokens:
                         break
+                if tr is not None and req.trace_id is not None:
+                    tr.span(req.trace_id, "decode.round", r0, r1,
+                            step=self._step_id, mode="spec", slot=slot,
+                            accepted=int(self.engine.last_round_accepted),
+                            drafted=int(self.engine.last_round_drafted),
+                            tokens=appended)
                 if appended < n:  # budget hit inside the window
                     self._finish(slot, "length")
                 elif done[slot]:
@@ -582,15 +693,26 @@ class ContinuousBatcher:
                 else self.engine.decode_step
 
             def _step():
-                with self._watchdog.guard("decode", self._step_id):
+                with self._watchdog.guard("decode", self._step_id,
+                                          victims=self._victims()
+                                          if self._watchdog.enabled
+                                          else None):
                     return step_fn()
 
+            r0 = self._clock() if tr is not None else now
             tok, done, _ = _retry.retry_call(
                 _step, site="gen.decode", policy=self._retry_policy)
+            r1 = self._clock() if tr is not None else now
             if self.governor is not None:
                 self.governor.observe_plain_step()
             for slot in was_active:
                 req = self._slots[slot]
+                req.rounds += 1
+                if tr is not None and req.trace_id is not None:
+                    tr.span(req.trace_id, "decode.round", r0, r1,
+                            step=self._step_id,
+                            mode="plain" if speculative else "decode",
+                            slot=slot, tokens=1)
                 if (self.engine.paged and done[slot]
                         and bool(self.engine.page_exhausted[slot])):
                     # evicted BEFORE the dispatch: the row emitted pad this

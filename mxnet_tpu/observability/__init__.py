@@ -42,11 +42,12 @@ from .events import emit, read_events, set_step  # noqa: F401
 from .metrics import REGISTRY, counter, gauge, histogram  # noqa: F401
 from . import profiling  # noqa: F401  (imports events/metrics above)
 from . import fleet  # noqa: F401  (imports events/metrics/goodput/profiling)
+from . import tracing  # noqa: F401  (imports metrics above)
 
 __all__ = ["metrics", "events", "REGISTRY", "counter", "gauge", "histogram",
            "emit", "set_step", "read_events", "enabled", "enable", "disable",
            "shutdown", "span", "timed_region", "telemetry_dir",
-           "throughput_delta", "fleet", "goodput", "profiling"]
+           "throughput_delta", "fleet", "goodput", "profiling", "tracing"]
 
 
 def throughput_delta(prev):

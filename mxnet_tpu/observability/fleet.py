@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Tuple
 
 from . import events as _events
 from . import metrics as _metrics
+from . import tracing as _tracing
 from .goodput import GoodputReport, goodput_ledger
 
 __all__ = ["FleetSnapshotter", "FleetAggregator", "FleetReport",
@@ -203,21 +204,31 @@ class FleetSnapshotter:
     def _append_range(src: Optional[str], offset: int, dst: str) -> int:
         """Append ``src[offset:]`` to ``dst`` (offsets are uncompressed
         positions; a ``.gz`` source is decompressed on the way through);
-        bytes copied (0 on any miss — a vanished source is a skipped
-        copy, never an error)."""
+        bytes copied (0 on any miss — a swept source is a skipped copy,
+        never an error). A plain rotated segment can vanish BETWEEN the
+        directory listing and the open: the background compressor
+        atomically replaces it with ``<seg>.gz`` and unlinks the plain
+        file. Its bytes still exist, just under the other name — retry
+        the ``.gz`` twin (complete by construction: it only becomes
+        visible via ``os.replace``) so the race loses zero events."""
         if not src:  # lint: disable=JH002 -- host path string, never traced
             return 0
-        try:
-            opener = gzip.open if src.endswith(".gz") else open
-            with opener(src, "rb") as f:
-                f.seek(offset)
-                chunk = f.read()
-            if chunk:
-                with open(dst, "ab") as out:
-                    out.write(chunk)
-            return len(chunk)
-        except (OSError, EOFError):
-            return 0
+        for attempt in ((src, src + ".gz") if not src.endswith(".gz")
+                        else (src,)):
+            try:
+                opener = gzip.open if attempt.endswith(".gz") else open
+                with opener(attempt, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read()
+                if chunk:
+                    with open(dst, "ab") as out:
+                        out.write(chunk)
+                return len(chunk)
+            except FileNotFoundError:
+                continue
+            except (OSError, EOFError):
+                return 0
+        return 0
 
     def maybe_snapshot(self) -> bool:
         """Step-boundary throttle: snapshot when ``interval`` has elapsed
@@ -402,6 +413,15 @@ class FleetReport:
     # mxnet_tpu.serving.FleetRouter.publish): per-replica state /
     # admissions / redistributions, request and completion counts
     router: dict = dataclasses.field(default_factory=dict)
+    # SLO attainment ledger folded from the router's trace "end"
+    # verdict records (observability.tracing.slo_ledger): per-priority-
+    # class attainment fraction, deadline-margin percentiles and
+    # multi-window burn rates — docs/OBSERVABILITY.md "Request tracing
+    # & SLO ledger"
+    slo: dict = dataclasses.field(default_factory=dict)
+    # request-trace census over the span JSONL files (counts only; the
+    # full waterfall view is tools/tracereport.py)
+    traces: dict = dataclasses.field(default_factory=dict)
 
     def summary(self) -> dict:
         return {
@@ -418,6 +438,8 @@ class FleetReport:
             "profiles": {str(r): p for r, p
                          in sorted(self.profiles.items())},
             "router": dict(self.router),
+            "slo": dict(self.slo),
+            "traces": dict(self.traces),
         }
 
 
@@ -681,8 +703,10 @@ class FleetAggregator:
                 continue
             router.fold(metrics)
         profiles = self._collect_profiles(rank_dirs)
+        slo, trace_census = self._collect_traces()
         self._last_torn = list(torn)
         if not events and not torn and not router.summary() \
+                and not trace_census \
                 and not any(s.generations for s in ranks.values()):
             return None
         events.sort(key=lambda e: e.get("ts") or 0.0)
@@ -694,7 +718,34 @@ class FleetAggregator:
             generations=sorted(gens), events=events, stragglers=stragglers,
             skew_timeline=timeline, goodput=ledger,
             serving=serving.summary(), torn_snapshots=len(torn),
-            profiles=profiles, router=router.summary())
+            profiles=profiles, router=router.summary(),
+            slo=slo, traces=trace_census)
+
+    def _collect_traces(self) -> Tuple[dict, dict]:
+        """Join the span JSONL files (router + every replica) by trace
+        id and fold the owner ``end`` verdicts into the SLO ledger.
+        Returns ``(slo, census)`` — both empty when no trace records
+        exist (tracing off, or no serving traffic)."""
+        records = _tracing.collect_records(self.directory)
+        if not records:
+            return {}, {}
+        assembled = _tracing.assemble(records)
+        ends = [t["end"] for t in assembled.values()
+                if t["end"] is not None]
+        kept = sum(1 for e in ends if e.get("keep"))
+        census = {
+            "records": len(records),
+            "traces": len(assembled),
+            "ends": len(ends),
+            "kept": kept,
+            "dropped": len(ends) - kept,
+            # spans whose trace never got an owner end record: in-flight
+            # work at snapshot time, or (the drill's red path) a span
+            # that lost its request
+            "orphans": sum(1 for t in assembled.values()
+                           if t["end"] is None and t["spans"]),
+        }
+        return _tracing.slo_ledger(ends), census
 
     @staticmethod
     def _collect_profiles(rank_dirs) -> Dict[int, dict]:

@@ -213,34 +213,46 @@ class DispatchWatchdog:
     def enabled(self) -> bool:
         return self.timeout_s > 0
 
-    def _alarm(self, family: str, step_id: int) -> None:
+    def _alarm(self, family: str, step_id: int,
+               victims: Optional[dict] = None) -> None:
         replica = self.replica
         if replica is None:
             try:
                 replica = int(os.environ.get("MXNET_TPU_PROCID", "0"))
             except ValueError:
                 replica = 0
+        victims = dict(victims or {})
         with self._lock:
             self.stalls += 1
             self.last_stall = {"family": family, "step_id": step_id,
                                "replica": replica,
-                               "timeout_s": self.timeout_s}
+                               "timeout_s": self.timeout_s,
+                               "victims": victims}
         _obs.counter("gen_stuck_dispatch_total",
                      "serving dispatches that exceeded the watchdog "
                      "budget").inc(family=family)
         _obs.emit("gen_stuck_dispatch", family=family, step_id=step_id,
-                  replica=replica, timeout_s=self.timeout_s)
+                  replica=replica, timeout_s=self.timeout_s,
+                  victims=victims)
         logger.error("stuck dispatch: replica=%s family=%s step_id=%d still "
-                     "running after %.3fs", replica, family, step_id,
-                     self.timeout_s)
+                     "running after %.3fs (victims: %s)", replica, family,
+                     step_id, self.timeout_s,
+                     ", ".join(f"slot {s}: req {r}"
+                               for s, r in victims.items()) or "unknown")
 
     @contextlib.contextmanager
-    def guard(self, family: str, step_id: int = 0):
+    def guard(self, family: str, step_id: int = 0,
+              victims: Optional[dict] = None):
+        """``victims`` is the ``{slot: request_id}`` mapping of the rows
+        riding the guarded dispatch — attached to the stall event so an
+        operator (or the fleet health tier) can see exactly which
+        requests a wedge is sitting on. Callers compute it only when the
+        watchdog is armed; a bare ``guard(family, step)`` still works."""
         if not self.enabled:
             yield
             return
         timer = threading.Timer(self.timeout_s, self._alarm,
-                                args=(family, int(step_id)))
+                                args=(family, int(step_id), victims))
         timer.daemon = True
         timer.start()
         try:

@@ -35,6 +35,7 @@ from typing import Dict, List, Optional
 
 from ..inference.batcher import ContinuousBatcher, GenRequest
 from ..observability import fleet as _fleet
+from ..observability import tracing as _tracing
 
 __all__ = ["ServingReplica", "read_fleet_views"]
 
@@ -53,7 +54,8 @@ class ServingReplica:
     """
 
     def __init__(self, replica_id: int, batcher: ContinuousBatcher,
-                 fleet_dir: str, generation: int = 0, clock=None):
+                 fleet_dir: str, generation: int = 0, clock=None,
+                 tracer=None):
         import time
 
         self.replica_id = int(replica_id)
@@ -61,20 +63,59 @@ class ServingReplica:
         self.engine = batcher.engine
         self.generation = int(generation)
         self._clock = clock or time.time
-        self.directory = os.path.join(os.path.abspath(fleet_dir),
+        self.fleet_dir = os.path.abspath(fleet_dir)
+        self.directory = os.path.join(self.fleet_dir,
                                       f"telemetry-h{self.replica_id}")
         os.makedirs(self.directory, exist_ok=True)
         # stalls carry the replica id from here on (satellite: fleet
         # health attributes gen_stuck_dispatch without guessing)
         batcher.watchdog.replica = self.replica_id
+        # request tracing (docs/OBSERVABILITY.md "Request tracing & SLO
+        # ledger"): attach the replica-side span emitter to the batcher;
+        # a finishing trace whose deadline margin dips below
+        # trace_margin_floor drops a prof-request trigger (PR 14
+        # contract) so this replica's next step gets a measured capture
+        if tracer is None:
+            tracer = _tracing.maybe_tracer(
+                os.path.join(self.directory,
+                             f"spans-g{self.generation}.jsonl"),
+                source=f"h{self.replica_id}", owner=False,
+                clock=self._clock, capture_cb=self._slow_capture)
+        elif tracer.capture_cb is None:
+            tracer.capture_cb = self._slow_capture
+        self.tracer = tracer
+        if tracer is not None:
+            batcher.tracer = tracer
         #: every request routed here, for admission/redistribution counts
         self.requests: List[GenRequest] = []
 
+    def _slow_capture(self, trace_id: str, margin: float) -> None:
+        """A request finished with less deadline margin than
+        ``trace_margin_floor``: request a measured-profile capture on
+        THIS replica via the ``prof-request-h{rid}.json`` trigger the
+        step-capture controller consumes (one pending request per
+        replica; best-effort, like the straggler trigger it
+        complements)."""
+        from ..observability import profiling as _profiling
+
+        path = _profiling.request_path(self.fleet_dir, self.replica_id)
+        if os.path.exists(path):
+            return  # a capture request is already pending here
+        try:
+            _fleet._atomic_write(path, json.dumps({
+                "reason": "slow_request", "kind": "deadline_margin",
+                "trace": str(trace_id), "margin": round(float(margin), 6),
+                "replica": self.replica_id,
+                "ts": round(float(self._clock()), 6)}))
+        except OSError:
+            pass  # advisory telemetry: never fail the serving loop
+
     # -- request side (called by the router) ---------------------------------
     def submit(self, prompt, max_new_tokens: int = 32,
-               deadline_s: Optional[float] = None) -> GenRequest:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> GenRequest:
         req = self.batcher.submit(prompt, max_new_tokens=max_new_tokens,
-                                  deadline_s=deadline_s)
+                                  deadline_s=deadline_s, trace_id=trace_id)
         self.requests.append(req)
         return req
 

@@ -35,6 +35,17 @@ Telemetry: ``router_requests_total{priority=}``,
 health tier's ``router_replica_state{replica=}``; :meth:`publish` drops
 them into ``{fleet_dir}/router/`` so ``tools/fleetreport.py`` renders
 the router columns from snapshots alone.
+
+Request tracing (docs/OBSERVABILITY.md "Request tracing & SLO ledger"):
+with the ``trace`` knob on, the router is the trace *owner* — it spans
+every request's backlog/attempt residency into
+``{fleet_dir}/router/spans-g0.jsonl`` and writes the terminal ``end``
+verdict the SLO ledger folds. The spans telescope (each boundary closes
+one span and opens the next at the same timestamp), so their sum equals
+the end-to-end latency exactly and a killed replica leaves no gap — its
+residency is the router's ``router.attempt`` span. The trace id is the
+router request id, passed to the replica via ``submit(trace_id=...)``
+so the batcher's detail spans join at aggregation.
 """
 from __future__ import annotations
 
@@ -48,6 +59,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import observability as _obs
 from ..observability import fleet as _fleet
+from ..observability import tracing as _tracing
 from . import health as _health
 from .replica import ServingReplica, read_fleet_views
 
@@ -82,6 +94,10 @@ class RouterRequest:
         self.finish_reason: Optional[str] = None
         self.output: List[int] = []
         self.finish_t: Optional[float] = None
+        #: start of the CURRENT trace phase (backlog or attempt) — every
+        #: phase boundary closes a span [phase_t0, now] and resets this
+        #: to now, so the spans telescope to exactly the e2e latency
+        self.phase_t0 = self.submit_t
 
     @property
     def done(self) -> bool:
@@ -113,11 +129,16 @@ class FleetRouter:
                  queue_bound: Optional[int] = None,
                  classes: Optional[Sequence[str]] = None,
                  affinity: Optional[bool] = None,
-                 seed: Optional[int] = None, clock=None):
+                 seed: Optional[int] = None, clock=None, tracer=None):
         from .. import config
 
         self.fleet_dir = os.path.abspath(fleet_dir)
         self._clock = clock or time.time
+        #: owner-side request tracer (None unless the ``trace`` knob is
+        #: on or an explicit Tracer is passed — drills pass sample=1.0)
+        self.tracer = tracer if tracer is not None else _tracing.maybe_tracer(
+            os.path.join(self.fleet_dir, "router", "spans-g0.jsonl"),
+            source="router", owner=True, clock=self._clock)
         self.health = health or _health.FleetHealth()
         self.queue_bound = int(queue_bound if queue_bound is not None
                                else config.get("router_queue_bound"))
@@ -256,6 +277,14 @@ class FleetRouter:
         rreq.current = None
         if rreq.done:
             return
+        if self.tracer is not None:
+            # close the attempt at the pull-back boundary — this span is
+            # what keeps a killed replica's residency gap-free (the dead
+            # replica's own span file may never have flushed)
+            self.tracer.span(str(rreq.id), "router.attempt",
+                             rreq.phase_t0, now, replica=rid,
+                             outcome=cause)
+            rreq.phase_t0 = now
         if rreq.expired(now):
             self._finish(rreq, "deadline", [], now)
             return
@@ -263,6 +292,10 @@ class FleetRouter:
         _obs.counter("router_redistributions_total",
                      "requests pulled back from a replica and "
                      "re-enqueued").inc(replica=str(rid), cause=cause)
+        if self.tracer is not None:
+            self.tracer.span(str(rreq.id), "redistribution", now, now,
+                             replica=rid, cause=cause,
+                             hop=rreq.redistributions)
         self._backlog[rreq.priority].appendleft(rreq)
 
     def _finish(self, rreq: RouterRequest, reason: str, output,
@@ -273,6 +306,15 @@ class FleetRouter:
         _obs.counter("router_completions_total",
                      "router requests completed, by finish reason").inc(
                          reason=reason)
+        if self.tracer is not None:
+            # the owner verdict: tail sampling decides the span flush
+            # here, and the SLO ledger folds exactly these records
+            self.tracer.finish(str(rreq.id), reason, rreq.submit_t, now,
+                               cls=rreq.priority,
+                               deadline=rreq.deadline_t,
+                               hops=rreq.redistributions,
+                               tokens=len(rreq.output),
+                               session=rreq.session)
 
     def _harvest(self, now: float) -> None:
         for key, rreq in list(self._assigned.items()):
@@ -291,6 +333,11 @@ class FleetRouter:
                 # deadline holds
                 self._requeue(rreq, rid, "replica_shed", now)
             else:
+                if self.tracer is not None:
+                    self.tracer.span(str(rreq.id), "router.attempt",
+                                     rreq.phase_t0, now, replica=rid,
+                                     outcome=gr.finish_reason)
+                    rreq.phase_t0 = now
                 self._finish(rreq, gr.finish_reason, gr.output, now)
 
     def _expire_backlog(self, now: float) -> None:
@@ -298,6 +345,11 @@ class FleetRouter:
             keep: deque = deque()
             for rreq in q:
                 if rreq.expired(now):
+                    if self.tracer is not None:
+                        self.tracer.span(str(rreq.id), "router.backlog",
+                                         rreq.phase_t0, now, cls=cls,
+                                         outcome="deadline")
+                        rreq.phase_t0 = now
                     self._finish(rreq, "deadline", [], now)
                 else:
                     keep.append(rreq)
@@ -356,7 +408,9 @@ class FleetRouter:
                 rreq = q[0]
                 gr = self.replicas[rid].submit(
                     rreq.prompt, max_new_tokens=rreq.max_new_tokens,
-                    deadline_s=rreq.remaining(now))
+                    deadline_s=rreq.remaining(now),
+                    trace_id=str(rreq.id) if self.tracer is not None
+                    else None)
                 if gr.done:  # shed at the replica's door
                     blocked.add(rid)
                     continue
@@ -365,6 +419,14 @@ class FleetRouter:
                 rreq.replicas_tried.append(rid)
                 self._assigned[(rid, gr.id)] = rreq
                 added[rid] = added.get(rid, 0) + 1
+                if self.tracer is not None:
+                    tid = str(rreq.id)
+                    self.tracer.span(tid, "router.backlog", rreq.phase_t0,
+                                     now, cls=cls, outcome="placed")
+                    self.tracer.span(tid, "router.place", now, now,
+                                     replica=rid,
+                                     attempt=len(rreq.replicas_tried))
+                    rreq.phase_t0 = now
                 _obs.counter("router_admissions_total",
                              "requests handed to a replica").inc(
                                  replica=str(rid))

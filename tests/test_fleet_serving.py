@@ -14,8 +14,14 @@
   - FleetRouter: priority-ordered dispatch, power-of-two-choices on
     published scores, session affinity (and its drop on degrade),
     redistribution from a dead replica without extending deadlines;
+  - request tracing across the tier (ISSUE 17): watchdog stalls carry a
+    slot -> request-id victims mapping, the real batcher emits
+    replica.queue / prefill / decode spans with the split ttft
+    histograms, a killed replica's trace still assembles gap-free from
+    the router-level spans alone;
   - the `make chaos-fleet` gate (tools/servedrill.py --fleet) goes green
-    on a real drill and red on tampered evidence.
+    on a real drill — including complete reconciled traces — and red on
+    tampered evidence.
 """
 import copy
 import importlib.util
@@ -119,7 +125,8 @@ class FakeBatcher:
         self._slots = []
         self._ids = itertools.count()
 
-    def submit(self, prompt, max_new_tokens=32, deadline_s=None):
+    def submit(self, prompt, max_new_tokens=32, deadline_s=None,
+               trace_id=None):
         r = _FakeReq(next(self._ids), prompt, max_new_tokens)
         if self.draining:
             r.finish_reason = "shed"
@@ -196,6 +203,21 @@ class TestWatchdogReplicaIdentity:
     def test_serving_replica_claims_the_watchdog(self, tmp_path):
         rep = _fake_replica(9, tmp_path, FakeClock())
         assert rep.batcher.watchdog.replica == 9
+
+    def test_stall_record_carries_victims_mapping(self):
+        # ISSUE 17: a stall must name who is stuck behind it —
+        # slot -> request id, straight into the stall record + event
+        wd = DispatchWatchdog(timeout_s=0.05, replica=3)
+        with wd.guard("decode", step_id=1, victims={"0": 11, "1": 12}):
+            time.sleep(0.15)
+        assert wd.stalls == 1
+        assert wd.last_stall["victims"] == {"0": 11, "1": 12}
+
+    def test_stall_without_victims_stays_empty(self):
+        wd = DispatchWatchdog(timeout_s=0.05, replica=3)
+        with wd.guard("decode", step_id=1):
+            time.sleep(0.15)
+        assert wd.last_stall["victims"] == {}
 
 
 # ---------------------------------------------------------------------------
@@ -579,6 +601,102 @@ class TestRouter:
 
 
 # ---------------------------------------------------------------------------
+# request tracing across the fleet (ISSUE 17)
+# ---------------------------------------------------------------------------
+class TestFleetTracing:
+    def _keep_all(self):
+        from mxnet_tpu.observability import tracing
+
+        return tracing.TailSampler(sample=1.0, seed=0, slow_pct=100.0,
+                                   margin_floor=0.0)
+
+    def test_batcher_tracer_defaults_off(self, net):
+        # tracing off = the hot path reads exactly one attribute
+        bat = ContinuousBatcher(_engine(net), clock=FakeClock())
+        assert bat.tracer is None
+
+    def test_real_batcher_emits_spans_and_split_ttft(self, net, tmp_path):
+        from mxnet_tpu.observability import tracing
+
+        def _hist_count(name):
+            h = REGISTRY.get(name)
+            s = h.stats() if h is not None else None
+            return 0 if s is None else s["count"]
+
+        clock = FakeClock()
+        bat = ContinuousBatcher(_engine(net), clock=clock)
+        bat.tracer = tracing.Tracer(str(tmp_path / "spans-g0.jsonl"), "h0",
+                                    sampler=self._keep_all(), clock=clock)
+        before = {n: _hist_count(n) for n in
+                  ("ttft_seconds", "ttft_queue_seconds",
+                   "ttft_service_seconds")}
+        r = bat.submit(_prompt(5, 1), max_new_tokens=4, trace_id="t1")
+        clock.advance(2.0)  # queue wait the split must attribute
+        bat.run_until_idle(max_steps=50)
+        assert r.finish_reason == "length"
+        recs = tracing.read_span_records(str(tmp_path / "spans-g0.jsonl"))
+        names = {rec["name"] for rec in recs if rec["kind"] == "span"}
+        assert {"replica.queue", "prefill", "decode",
+                "decode.round"} <= names
+        ends = [rec for rec in recs if rec["kind"] == "local_end"]
+        assert len(ends) == 1 and ends[0]["outcome"] == "length"
+        # the combined histogram stays, the split adds both halves
+        for n in ("ttft_seconds", "ttft_queue_seconds",
+                  "ttft_service_seconds"):
+            assert _hist_count(n) == before[n] + 1
+        q = REGISTRY.get("ttft_queue_seconds").stats()
+        assert q["max"] >= 2.0  # the fake-clock queue wait is in there
+
+    def test_killed_replica_trace_assembles_gap_free(self, tmp_path):
+        # the dead replica's span file never flushed (a dead process):
+        # the router-level spans alone must still cover submit -> finish
+        # contiguously, including the dead replica's residency
+        from mxnet_tpu.observability import tracing
+
+        clock = FakeClock()
+        clock.advance(1.0)
+        health = FleetHealth(hb_timeout=2.0, drain_after=1.0, dead_grace=3.0)
+        tracer = tracing.Tracer(
+            os.path.join(str(tmp_path), "router", "spans-g0.jsonl"),
+            "router", sampler=self._keep_all(), owner=True, clock=clock)
+        router = FleetRouter(str(tmp_path), health=health, clock=clock,
+                             queue_bound=4, seed=0, tracer=tracer)
+        reps = {}
+        for rid in range(2):
+            reps[rid] = _fake_replica(rid, tmp_path, clock, capacity=1)
+            reps[rid].publish()
+            router.attach(reps[rid])
+        rqs = [router.submit(_prompt(4, s), max_new_tokens=3, session="s",
+                             deadline_s=60.0) for s in range(3)]
+        clock.advance(1.0)
+        router.step()
+        victim = rqs[0].replicas_tried[0]
+        survivor = next(r for r in reps if r != victim)
+        # the victim stops stepping AND publishing; its tracer (none
+        # here — FakeBatcher emits no replica spans) flushes nothing
+        for _ in range(20):
+            clock.advance(1.0)
+            router.step()
+            reps[survivor].step()
+            if all(r.done for r in rqs):
+                break
+        assert health.state(victim) == DEAD
+        assert all(r.finish_reason == "length" for r in rqs)
+        moved = [r for r in rqs if victim in r.replicas_tried]
+        assert moved
+        tracer.close()
+        assembled = tracing.assemble(
+            tracing.collect_records(str(tmp_path)))
+        for r in rqs:
+            chk = tracing.check_trace(assembled[str(r.id)])
+            assert chk["ok"], (r.id, chk["problems"])
+        hops = {str(r.id): r.redistributions for r in rqs}
+        for tid, n in hops.items():
+            assert assembled[tid]["end"]["hops"] == n
+        assert any(n >= 1 for n in hops.values())
+
+
+# ---------------------------------------------------------------------------
 # the chaos-fleet gate (tools/servedrill.py --fleet)
 # ---------------------------------------------------------------------------
 class TestChaosFleetGate:
@@ -634,4 +752,38 @@ class TestChaosFleetGate:
         rid = next(iter(bad["drained"]))
         bad["drained"][rid]["active"] = 1
         assert any("drain" in p.lower()
+                   for p in servedrill.validate_fleet(bad))
+
+    def test_trace_evidence_green(self, drill):
+        tre = drill["traces"]
+        assert tre["missing"] == []
+        assert tre["problems"] == {}
+        assert tre["orphans"] == []
+        assert tre["checked"] == len(drill["requests"])
+        assert tre["phase_err_max"] <= 0.05
+        assert tre["hops"] == int(drill["counters"]
+                                  ["router_redistributions"])
+
+    def test_orphan_span_fails_gate(self, servedrill, drill):
+        bad = copy.deepcopy(drill)
+        bad["traces"]["orphans"] = ["ghost-999"]
+        assert any("orphan" in p.lower()
+                   for p in servedrill.validate_fleet(bad))
+
+    def test_missing_trace_fails_gate(self, servedrill, drill):
+        bad = copy.deepcopy(drill)
+        bad["traces"]["missing"] = ["fs0"]
+        assert any("no assembled trace" in p
+                   for p in servedrill.validate_fleet(bad))
+
+    def test_trace_hop_mismatch_fails_gate(self, servedrill, drill):
+        bad = copy.deepcopy(drill)
+        bad["traces"]["hops"] += 1
+        assert any("does not match" in p
+                   for p in servedrill.validate_fleet(bad))
+
+    def test_trace_phase_drift_fails_gate(self, servedrill, drill):
+        bad = copy.deepcopy(drill)
+        bad["traces"]["phase_err_max"] = 0.2
+        assert any("exceeds 5%" in p
                    for p in servedrill.validate_fleet(bad))

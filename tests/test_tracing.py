@@ -1,0 +1,274 @@
+"""Request tracing + SLO ledger (ISSUE 17, docs/OBSERVABILITY.md
+"Request tracing & SLO ledger"):
+
+  - TailSampler policy: anomalous outcomes / redistributions / thin
+    deadline margins / the slow percentile always kept, the healthy rest
+    sampled by a deterministic (seed, trace id) hash — two samplers with
+    the same seed agree record-for-record, a different seed diverges;
+  - Tracer mechanics: spans buffer until the local end, a kept trace
+    flushes spans + verdict in one append, a dropped trace writes only
+    the verdict (the ledger measures the population), owner writes
+    ``end`` / non-owner ``local_end``, discard drops silently,
+    capture_cb fires on thin margins;
+  - torn-final-line span files parse (crash-mid-write signature);
+  - assemble/check_trace: telescoping router-level spans reconcile
+    exactly, gaps / phase-sum drift / hop mismatches / missing end
+    records (orphans) are each flagged;
+  - slo_ledger: attainment excludes client cancellations from the
+    denominator, margins aggregate per class, burn = miss-rate over
+    window / error budget;
+  - the one-read hot-path gate: ``maybe_tracer`` is None unless the
+    ``trace`` knob is on, and the emitting methods are registered in the
+    AST-lint EXTRA_HOT_PATHS tier.
+"""
+import json
+import os
+
+import pytest
+
+from mxnet_tpu.observability import tracing
+from mxnet_tpu.observability.tracing import (ANOMALY_OUTCOMES, TailSampler,
+                                             Tracer, assemble, check_trace,
+                                             slo_ledger)
+
+
+def _sampler(**kw):
+    kw.setdefault("sample", 0.0)
+    kw.setdefault("seed", 0)
+    kw.setdefault("slow_pct", 95.0)
+    kw.setdefault("margin_floor", 0.0)
+    return TailSampler(**kw)
+
+
+class TestTailSampler:
+    def test_anomalous_outcomes_always_kept(self):
+        s = _sampler()
+        for outcome in sorted(ANOMALY_OUTCOMES):
+            keep, why = s.decide("t1", outcome)
+            assert keep and why == f"outcome:{outcome}"
+
+    def test_redistributed_kept_even_when_served(self):
+        keep, why = _sampler().decide("t1", "eos", redistributed=True)
+        assert keep and why == "redistributed"
+
+    def test_margin_floor(self):
+        s = _sampler(margin_floor=0.5)
+        assert s.decide("t1", "eos", margin=0.4) == (True, "margin")
+        keep, why = s.decide("t2", "eos", margin=0.6)
+        assert why != "margin"
+        # floor 0 disables the rule entirely
+        assert _sampler().decide("t3", "eos", margin=-5.0)[1] != "margin"
+
+    def test_slow_percentile_needs_history(self):
+        s = _sampler(min_history=4)
+        # cold reservoir: nothing flagged slow
+        for i in range(4):
+            assert s.decide(f"w{i}", "eos", e2e=1.0)[1] == "dropped"
+        # now a clear outlier lands above p95 of the recent window
+        keep, why = s.decide("slowpoke", "eos", e2e=50.0)
+        assert keep and why == "slow"
+
+    def test_healthy_sampling_is_deterministic_per_seed(self):
+        a = [_sampler(sample=0.5, seed=7).decide(f"t{i}", "eos")[0]
+             for i in range(200)]
+        b = [_sampler(sample=0.5, seed=7).decide(f"t{i}", "eos")[0]
+             for i in range(200)]
+        c = [_sampler(sample=0.5, seed=8).decide(f"t{i}", "eos")[0]
+             for i in range(200)]
+        assert a == b          # same seed: identical keep set, any process
+        assert a != c          # different seed: different subset
+        assert 40 < sum(a) < 160   # ...and roughly the configured rate
+
+    def test_sample_bounds(self):
+        assert _sampler(sample=1.0).decide("t", "eos") == (True, "sampled")
+        assert _sampler(sample=0.0).decide("t", "eos") == (False, "dropped")
+        with pytest.raises(ValueError):
+            _sampler(sample=1.5)
+        with pytest.raises(ValueError):
+            _sampler(slow_pct=0.0)
+
+
+class TestTracer:
+    def _tracer(self, tmp_path, **kw):
+        kw.setdefault("sampler", _sampler(sample=1.0))
+        return Tracer(str(tmp_path / "spans.jsonl"), "h0", **kw)
+
+    def test_spans_buffer_until_finish(self, tmp_path):
+        tr = self._tracer(tmp_path)
+        tr.span("t1", "prefill", 1.0, 2.0, slot=0)
+        assert not os.path.exists(tr.path)  # nothing written yet
+        assert tr.finish("t1", "eos", 0.0, 3.0) is True
+        recs = tracing.read_span_records(tr.path)
+        assert [r["kind"] for r in recs] == ["span", "local_end"]
+        assert recs[0]["name"] == "prefill" and recs[0]["slot"] == 0
+        assert recs[1]["e2e"] == 3.0 and recs[1]["keep"] is True
+
+    def test_dropped_trace_writes_only_the_verdict(self, tmp_path):
+        tr = self._tracer(tmp_path, sampler=_sampler(sample=0.0))
+        tr.span("t1", "prefill", 1.0, 2.0)
+        assert tr.finish("t1", "eos", 0.0, 3.0) is False
+        recs = tracing.read_span_records(tr.path)
+        # the end record survives for the SLO ledger; the spans do not
+        assert [r["kind"] for r in recs] == ["local_end"]
+        assert recs[0]["keep"] is False and recs[0]["why"] == "dropped"
+
+    def test_owner_writes_end_kind(self, tmp_path):
+        tr = self._tracer(tmp_path, owner=True)
+        tr.finish("t1", "eos", 0.0, 1.0, cls="interactive", deadline=5.0)
+        rec = tracing.read_span_records(tr.path)[0]
+        assert rec["kind"] == "end"
+        assert rec["cls"] == "interactive" and rec["margin"] == 4.0
+
+    def test_discard_drops_silently(self, tmp_path):
+        tr = self._tracer(tmp_path)
+        tr.span("t1", "prefill", 1.0, 2.0)
+        tr.discard("t1")
+        tr.finish("t2", "eos", 0.0, 1.0)
+        assert all(r["trace"] == "t2"
+                   for r in tracing.read_span_records(tr.path))
+
+    def test_capture_cb_fires_below_margin_floor(self, tmp_path):
+        hits = []
+        tr = self._tracer(tmp_path,
+                          sampler=_sampler(sample=1.0, margin_floor=1.0),
+                          capture_cb=lambda tid, m: hits.append((tid, m)))
+        tr.finish("fat", "eos", 0.0, 1.0, deadline=10.0)
+        tr.finish("thin", "eos", 0.0, 1.0, deadline=1.5)
+        assert hits == [("thin", 0.5)]
+
+    def test_torn_final_line_is_skipped(self, tmp_path):
+        tr = self._tracer(tmp_path)
+        tr.span("t1", "prefill", 1.0, 2.0)
+        tr.finish("t1", "eos", 0.0, 3.0)
+        tr.close()
+        with open(tr.path, "a") as f:
+            f.write('{"kind": "span", "trace": "t2", "na')  # crash mid-write
+        recs = tracing.read_span_records(tr.path)
+        assert len(recs) == 2 and all(r["trace"] == "t1" for r in recs)
+
+
+def _mk_end(tid, outcome="eos", t0=0.0, t1=10.0, deadline=None, cls=None,
+            hops=0):
+    margin = None if deadline is None else deadline - t1
+    return {"kind": "end", "trace": tid, "outcome": outcome, "cls": cls,
+            "t0": t0, "t1": t1, "e2e": t1 - t0, "deadline": deadline,
+            "margin": margin, "hops": hops, "keep": True, "why": "sampled",
+            "src": "router"}
+
+
+def _span(tid, name, t0, t1, **attrs):
+    rec = {"kind": "span", "trace": tid, "name": name, "t0": t0, "t1": t1,
+           "src": "router"}
+    rec.update(attrs)
+    return rec
+
+
+class TestAssembleAndCheck:
+    def test_telescoping_trace_reconciles_exactly(self):
+        recs = [
+            _span("t", "router.backlog", 0.0, 2.0),
+            _span("t", "router.attempt", 2.0, 5.0, replica=0),
+            _span("t", "redistribution", 5.0, 5.0, hop=1),
+            _span("t", "router.backlog", 5.0, 6.0),
+            _span("t", "router.attempt", 6.0, 10.0, replica=1),
+            _span("t", "prefill", 6.5, 7.0),  # nested detail, not summed
+            _mk_end("t", t1=10.0, hops=1),
+        ]
+        trace = assemble(recs)["t"]
+        chk = check_trace(trace)
+        assert chk["ok"], chk["problems"]
+        assert chk["phase_sum"] == pytest.approx(10.0)
+        assert chk["rel_err"] == pytest.approx(0.0)
+        assert chk["hops"] == 1
+        assert chk["phases"]["router.attempt"] == pytest.approx(7.0)
+
+    def test_gap_between_router_spans_flags(self):
+        recs = [_span("t", "router.backlog", 0.0, 2.0),
+                _span("t", "router.attempt", 3.0, 10.0),  # 1s hole
+                _mk_end("t")]
+        chk = check_trace(assemble(recs)["t"])
+        assert not chk["ok"]
+        assert any("gap/overlap" in p for p in chk["problems"])
+
+    def test_phase_sum_drift_flags(self):
+        recs = [_span("t", "router.backlog", 0.0, 8.0), _mk_end("t")]
+        chk = check_trace(assemble(recs)["t"])
+        assert any("phase sum" in p for p in chk["problems"])
+
+    def test_hop_count_mismatch_flags(self):
+        recs = [_span("t", "router.backlog", 0.0, 10.0),
+                _mk_end("t", hops=2)]
+        chk = check_trace(assemble(recs)["t"])
+        assert any("hops" in p for p in chk["problems"])
+
+    def test_orphan_trace(self):
+        trace = assemble([_span("ghost", "router.backlog", 0.0, 1.0)])
+        chk = check_trace(trace["ghost"])
+        assert not chk["ok"]
+        assert chk["problems"] == ["orphan: no end record"]
+
+    def test_collect_records_walks_router_and_replica_files(self, tmp_path):
+        os.makedirs(tmp_path / "router")
+        os.makedirs(tmp_path / "telemetry-h1")
+        for p, tid in ((tmp_path / "router" / "spans-g0.jsonl", "a"),
+                       (tmp_path / "telemetry-h1" / "spans-g0.jsonl", "b")):
+            with open(p, "w") as f:
+                f.write(json.dumps(_span(tid, "router.backlog", 0, 1))
+                        + "\n")
+        recs = tracing.collect_records(str(tmp_path))
+        assert sorted(r["trace"] for r in recs) == ["a", "b"]
+
+
+class TestSloLedger:
+    def test_attainment_margins_and_burn(self):
+        ends = [
+            _mk_end("a", t1=10.0, deadline=14.0, cls="interactive"),
+            _mk_end("b", t1=20.0, deadline=22.0, cls="interactive"),
+            _mk_end("c", outcome="deadline", t1=30.0, deadline=29.0,
+                    cls="interactive"),
+            _mk_end("d", outcome="cancelled", t1=30.0, cls="interactive"),
+            _mk_end("e", outcome="length", t1=30.0, deadline=40.0,
+                    cls="batch", hops=2),
+        ]
+        led = slo_ledger(ends, windows=[100.0], target=0.9, now=30.0)
+        it = led["classes"]["interactive"]
+        # cancelled is exempt: 3 eligible, 2 attained
+        assert it["count"] == 4 and it["eligible"] == 3
+        assert it["attainment"] == pytest.approx(2 / 3, abs=1e-4)
+        assert it["margin"]["min"] == pytest.approx(-1.0)
+        assert led["classes"]["batch"]["redistributed"] == 1
+        # burn: 1 miss / 3 eligible in window over a 0.1 error budget
+        assert it["burn"]["100s"] == pytest.approx((1 / 3) / 0.1,
+                                                   abs=1e-3)
+        assert led["total"]["eligible"] == 4
+        assert led["windows"] == ["100s"]
+
+    def test_empty_ends(self):
+        assert slo_ledger([]) == {}
+        # span records never count as ledger material
+        assert slo_ledger([_span("t", "router.backlog", 0, 1)]) == {}
+
+    def test_parse_windows(self):
+        assert tracing.parse_windows("60, 300,junk,-5,") == [60.0, 300.0]
+
+
+class TestHotPathGate:
+    def test_maybe_tracer_none_unless_knob_on(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("MXNET_TPU_TRACE", raising=False)
+        assert tracing.maybe_tracer(str(tmp_path / "s.jsonl"), "h0") is None
+        monkeypatch.setenv("MXNET_TPU_TRACE", "1")
+        tr = tracing.maybe_tracer(str(tmp_path / "s.jsonl"), "h0",
+                                  owner=True)
+        assert isinstance(tr, Tracer) and tr.owner
+
+    def test_emitters_registered_in_lint_hot_paths(self):
+        # the structural contract: the tracing emitters stay on the
+        # AST-lint hot-path tier, and the registered qualnames exist
+        from mxnet_tpu.analysis import astlint
+
+        names = astlint.EXTRA_HOT_PATHS.get("observability/tracing.py")
+        assert names is not None
+        assert "Tracer.span" in names and "Tracer.finish" in names
+        for qual in names:
+            cls_name, meth = qual.split(".")
+            assert callable(getattr(getattr(tracing, cls_name), meth))

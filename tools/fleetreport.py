@@ -189,6 +189,41 @@ def render(s: dict) -> str:
             if rt.get(name):
                 w(f"   {name}: " + ", ".join(
                     f"{k}={v}" for k, v in sorted(rt[name].items())))
+
+    slo = s.get("slo") or {}
+    if slo:
+        # per-priority-class SLO attainment + burn rates folded from the
+        # request-trace end records (docs/OBSERVABILITY.md "Request
+        # tracing & SLO ledger"); burn > 1 spends error budget faster
+        # than it accrues over that window
+        w(f"-- slo (target {slo['target']:.4g}, windows "
+          f"{','.join(slo['windows'])})")
+        hdr = (f"   {'class':>12} {'n':>5} {'attain':>8} "
+               f"{'margin p50':>11} {'margin p95':>11} {'redist':>7}")
+        w(hdr + "".join(f" {'burn ' + win:>10}" for win in slo["windows"]))
+        rows = list(sorted(slo.get("classes", {}).items()))
+        rows.append(("TOTAL", slo.get("total", {})))
+        for cls, rec in rows:
+            if not rec:
+                continue
+            att = rec.get("attainment")
+            m = rec.get("margin") or {}
+            line = (f"   {cls:>12} {rec.get('eligible', 0):>5} "
+                    f"{att if att is None else format(att, '.4f'):>8} "
+                    f"{_fmt_s(m.get('p50')):>11} {_fmt_s(m.get('p95')):>11} "
+                    f"{rec.get('redistributed', 0):>7}")
+            for win in slo["windows"]:
+                b = (rec.get("burn") or {}).get(win)
+                line += f" {'-' if b is None else format(b, '.3f'):>10}"
+            w(line)
+
+    tc = s.get("traces") or {}
+    if tc:
+        w("-- traces")
+        w(f"   traces={tc.get('traces', 0)} ends={tc.get('ends', 0)} "
+          f"kept={tc.get('kept', 0)} dropped={tc.get('dropped', 0)} "
+          f"orphans={tc.get('orphans', 0)} "
+          f"(waterfalls: tools/tracereport.py)")
     return "\n".join(out)
 
 

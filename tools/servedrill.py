@@ -48,11 +48,18 @@ bit-identical to an undisturbed single-engine baseline), the wedged
 replica walks DEGRADED→DRAINING→DEAD with its work redistributed, a
 replacement replica joins under a fresh id, session affinity holds while
 the pinned replica stays LIVE, and the surviving replicas drain to a
-clean empty end state. ``--inject-drop`` is its tested failure path.
+clean empty end state. Request tracing runs keep-everything: the gate
+additionally asserts every terminal request assembled a gap-free trace
+whose router-level phase sums match its end-to-end latency within 5%
+and whose hop count matches ``router_redistributions_total``
+(docs/OBSERVABILITY.md "Request tracing & SLO ledger").
+``--inject-drop`` and ``--inject-orphan-span`` are its tested failure
+paths.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -388,8 +395,18 @@ def fleet_baseline():
     return {k: r.result() for k, r in reqs.items()}
 
 
+def _drill_sampler():
+    """Keep-everything tail sampler: the drill's gate needs a complete
+    trace for EVERY terminal request, not a sample."""
+    from mxnet_tpu.observability import tracing
+
+    return tracing.TailSampler(sample=1.0, seed=0, slow_pct=100.0,
+                               margin_floor=0.0)
+
+
 def _fleet_replica(rid, net, fleet_dir, clock):
     from mxnet_tpu.inference import ContinuousBatcher, GenerationEngine
+    from mxnet_tpu.observability import tracing
     from mxnet_tpu.serving import ServingReplica
 
     eng = GenerationEngine(net, batch_size=2, prefill_buckets=(8,),
@@ -400,19 +417,31 @@ def _fleet_replica(rid, net, fleet_dir, clock):
     # misread as stalls; the wedge arms it when the wedge starts
     bat = ContinuousBatcher(eng, max_queue=8, queue_policy="reject",
                             watchdog_s=0.0, clock=clock)
-    return ServingReplica(rid, bat, fleet_dir, clock=clock)
+    tr = tracing.Tracer(
+        os.path.join(fleet_dir, f"telemetry-h{rid}", "spans-g0.jsonl"),
+        source=f"h{rid}", sampler=_drill_sampler(), clock=clock)
+    return ServingReplica(rid, bat, fleet_dir, clock=clock, tracer=tr)
 
 
-def run_fleet_drill(max_ticks=60, telemetry_dir=None, fleet_dir=None):
+def run_fleet_drill(max_ticks=60, telemetry_dir=None, fleet_dir=None,
+                    inject_orphan_span=False):
     """Run the multi-replica drill; returns the evidence dict
     ``validate_fleet`` judges. One tick = one fake second: the router
     schedules, then every still-running replica steps (the killed one
     stops stepping AND publishing; the wedged one publishes heartbeats
-    but every dispatch trips its watchdog)."""
+    but every dispatch trips its watchdog).
+
+    Request tracing runs with a keep-everything tail sampler; after the
+    drill the evidence includes, per terminal request, whether its
+    assembled trace is gap-free with phase sums reconciling against the
+    end-to-end latency (docs/OBSERVABILITY.md "Request tracing & SLO
+    ledger"). ``inject_orphan_span`` appends a span with a trace id no
+    request owns before assembly — the tested red path."""
     import tempfile
 
     import mxnet_tpu  # noqa: F401  (package init)
     from mxnet_tpu import observability as obs
+    from mxnet_tpu.observability import tracing
     from mxnet_tpu.observability.fleet import FleetAggregator
     from mxnet_tpu.serving import DEAD, LIVE, FleetHealth, FleetRouter
 
@@ -437,7 +466,11 @@ def run_fleet_drill(max_ticks=60, telemetry_dir=None, fleet_dir=None):
                 for rid in (0, 1, 2)}
     health = FleetHealth(hb_timeout=2.5, drain_after=2.0, dead_grace=6.0)
     router = FleetRouter(fdir, health=health, queue_bound=3, affinity=True,
-                         seed=0, clock=clock)
+                         seed=0, clock=clock,
+                         tracer=tracing.Tracer(
+                             os.path.join(fdir, "router", "spans-g0.jsonl"),
+                             source="router", sampler=_drill_sampler(),
+                             owner=True, clock=clock))
     for rep in replicas.values():
         router.attach(rep)
 
@@ -515,6 +548,39 @@ def run_fleet_drill(max_ticks=60, telemetry_dir=None, fleet_dir=None):
     finally:
         obs.disable()
 
+    # flush every tracer, then join the span files exactly like a
+    # post-mortem would: by trace id from the shared fleet dir
+    router.tracer.close()
+    for rep in replicas.values():
+        if rep.tracer is not None:
+            rep.tracer.close()
+    if inject_orphan_span:
+        with open(os.path.join(fdir, "router", "spans-g0.jsonl"),
+                  "a") as f:
+            f.write(json.dumps({"kind": "span", "trace": "ghost-999",
+                                "name": "router.backlog", "t0": 0.0,
+                                "t1": 1.0, "src": "router"}) + "\n")
+    assembled = tracing.assemble(tracing.collect_records(fdir))
+    checks = {tid: tracing.check_trace(t) for tid, t in assembled.items()}
+    id_of = {k: str(r.id) for k, r in reqs.items()}
+    ends = [t["end"] for t in assembled.values() if t["end"] is not None]
+    traces_ev = {
+        "checked": len(ends),
+        # terminal requests whose trace never assembled (no end record)
+        "missing": sorted(k for k, tid in id_of.items()
+                          if assembled.get(tid, {}).get("end") is None),
+        "problems": {tid: c["problems"] for tid, c in checks.items()
+                     if assembled[tid]["end"] is not None and not c["ok"]},
+        "orphans": sorted(tid for tid, t in assembled.items()
+                          if t["end"] is None and t["spans"]),
+        "hops": sum(int(e.get("hops") or 0) for e in ends),
+        "phase_err_max": max((checks[tid]["rel_err"]
+                              for tid, t in assembled.items()
+                              if t["end"] is not None
+                              and checks[tid]["rel_err"] is not None),
+                             default=0.0),
+    }
+
     survivors = {rid: rep for rid, rep in replicas.items()
                  if router.health.state(rid) == LIVE}
     result = {
@@ -561,6 +627,8 @@ def run_fleet_drill(max_ticks=60, telemetry_dir=None, fleet_dir=None):
                           "reserved": rep.engine.reserved_pages}
                     for rid, rep in survivors.items()},
         "router_summary": router_summary,
+        "traces": traces_ev,
+        "fleet_dir": fdir,
     }
     return result
 
@@ -652,6 +720,26 @@ def validate_fleet(result):
         if d["reserved"]:
             problems.append(f"replica {rid} reservation leaked: "
                             f"{d['reserved']} pages")
+    tre = result.get("traces") or {}
+    if tre:
+        # every terminal request must carry a complete, gap-free trace
+        # whose router-level phase sums reconcile against its e2e latency
+        if tre["missing"]:
+            problems.append("requests with no assembled trace end record: "
+                            f"{tre['missing']}")
+        for tid, probs in sorted(tre["problems"].items()):
+            problems.append(f"trace {tid} failed reconciliation: {probs}")
+        if tre["orphans"]:
+            problems.append(f"orphaned spans with no owning request: "
+                            f"{tre['orphans']}")
+        if tre["phase_err_max"] > 0.05:
+            problems.append(f"worst trace phase-sum error "
+                            f"{tre['phase_err_max']:.1%} exceeds 5%")
+        if tre["hops"] != int(c["router_redistributions"]):
+            problems.append(
+                f"trace hop count {tre['hops']} does not match "
+                f"router_redistributions_total "
+                f"{c['router_redistributions']:.0f}")
     rsum = result["router_summary"].get("replicas", {})
     for rid in (result["kill_rid"], result["wedge_rid"]):
         if rsum.get(str(rid), {}).get("state") != "dead":
@@ -663,7 +751,8 @@ def validate_fleet(result):
 
 
 def main_fleet(args):
-    result = run_fleet_drill(max_ticks=args.max_ticks)
+    result = run_fleet_drill(max_ticks=args.max_ticks,
+                             inject_orphan_span=args.inject_orphan_span)
     if args.inject_drop:
         key = next(iter(result["requests"]))
         result["requests"][key]["reason"] = None
@@ -683,14 +772,22 @@ def main_fleet(args):
     reasons = sorted({v['reason'] or 'NONE'
                       for v in result['requests'].values()})
     print(f"  reasons: {', '.join(reasons)}")
+    tre = result.get("traces") or {}
+    if tre:
+        print(f"  traces: checked={tre['checked']} "
+              f"missing={len(tre['missing'])} "
+              f"broken={len(tre['problems'])} orphans={len(tre['orphans'])} "
+              f"hops={tre['hops']} "
+              f"phase_err_max={tre['phase_err_max']:.2%} "
+              f"(waterfalls: tools/tracereport.py {result['fleet_dir']})")
     print(f"  drained: {result['drained']}")
     if problems:
         for p in problems:
             print(f"fleetdrill: FAIL: {p}")
         return 1
     print("fleetdrill: OK — zero in-deadline drops, wedged replica "
-          "degraded->drained->dead with work redistributed, survivors "
-          "drained clean")
+          "degraded->drained->dead with work redistributed, gap-free "
+          "traces reconciled, survivors drained clean")
     return 0
 
 
@@ -708,6 +805,10 @@ def main(argv=None):
     ap.add_argument("--inject-drop", action="store_true",
                     help="failure-path test hook (--fleet): erase one "
                     "request's finish reason; the gate must fail")
+    ap.add_argument("--inject-orphan-span", action="store_true",
+                    help="failure-path test hook (--fleet): append a span "
+                    "owned by no request to the router span file; the "
+                    "trace gate must fail")
     args = ap.parse_args(argv)
 
     if args.fleet:
