@@ -24,6 +24,10 @@
 #             drops, newly exposed collectives and exposed-comm-byte
 #             regressions per mesh axis vs
 #             mxnet_tpu/analysis/goldens/sched_*.json
+#   kernelcheck - Pallas kernel correctness gate: CPU interpret-mode
+#             parity/bit-identity suites for every custom kernel (flash
+#             attention, fused layernorm, paged decode attention, fused
+#             Adam, fused softmax-xent), docs/PERFORMANCE.md
 #   profcheck - measured-profiling gate (tools/profcheck.py): traces two
 #             shared golden families for real, asserts non-empty device
 #             op timelines, a predicted/measured calibration table
@@ -34,7 +38,8 @@
 #   fast    - pytest without @slow (target < 10 min on 8 virtual CPU devs)
 #   slow    - the @slow remainder (model compiles, 4-process launches)
 #   ci      - sanity + lint + native + fast + audit + shardcheck +
-#             memcheck + schedcheck + chaos-elastic + chaos-serve +
+#             memcheck + schedcheck + profcheck + kernelcheck +
+#             chaos-elastic + chaos-serve +
 #             chaos-fleet (the pre-merge gate; chaos-elastic is the slow
 #             4-process kill-a-worker drill, chaos-serve the
 #             serving-resilience drill: injected gen.* faults + deadlines
@@ -51,9 +56,9 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity lint audit shardcheck memcheck schedcheck profcheck native fast slow test chaos chaos-elastic chaos-serve chaos-fleet obs obsfleet perfwin genbench ampbench bench clean
+.PHONY: ci sanity lint audit shardcheck memcheck schedcheck profcheck kernelcheck native fast slow test chaos chaos-elastic chaos-serve chaos-fleet obs obsfleet perfwin genbench ampbench bench clean
 
-ci: sanity lint native fast audit shardcheck memcheck schedcheck profcheck chaos-elastic chaos-serve chaos-fleet obsfleet
+ci: sanity lint native fast audit shardcheck memcheck schedcheck profcheck kernelcheck chaos-elastic chaos-serve chaos-fleet obsfleet
 
 sanity:
 	$(PY) -m compileall -q mxnet_tpu tools tests examples bench.py __graft_entry__.py
@@ -107,6 +112,14 @@ schedcheck:
 # tested via `python tools/profcheck.py --inject-empty-trace`
 profcheck:
 	$(PY) tools/profcheck.py
+
+# Pallas kernel correctness gate (docs/PERFORMANCE.md "Custom kernels"):
+# every kernel's CPU interpret-mode parity/bit-identity suite, runnable
+# standalone before blessing perf artifacts on hardware
+kernelcheck:
+	$(PY) -m pytest tests/test_flash_attention.py tests/test_pallas_layernorm.py \
+	    tests/test_pallas_paged_attention.py tests/test_pallas_optimizer.py \
+	    tests/test_pallas_softmax_xent.py -q
 
 native:
 	$(MAKE) -C native
@@ -193,9 +206,12 @@ perfwin: native
 #   spec vs paged    — self-drafting speculative decode >= 1.5x amortized
 #                      tokens/sec over the paged non-speculative engine,
 #                      exactly (buckets + 1 decode + 1 verify) programs.
-# artifact committed as GENBENCH_r02.json
+# artifact committed per measurement round as GENBENCH_$(GENBENCH_ROUND).json
+# (override GENBENCH_ROUND to rebless an old round; the default is the
+# current round so a rerun never silently clobbers an earlier artifact)
+GENBENCH_ROUND ?= r03
 genbench:
-	$(PY) tools/genbench.py --out GENBENCH_r02.json
+	$(PY) tools/genbench.py --out GENBENCH_$(GENBENCH_ROUND).json
 
 # compiled mixed-precision gate (docs/PERFORMANCE.md "Mixed precision"):
 # HLO dtype assertions (bf16 dots + f32 master update, f16 loss scaling

@@ -44,6 +44,20 @@ _KNOBS: Dict[str, tuple] = {
                          "FlashAttention-2 Pallas backward kernels (dq + "
                          "dkv); off = XLA chunked-recompute backward "
                          "(~2.5x slower on v5e but kernel-free)"),
+    "paged_attention_kernel": (bool, True, ("MXNET_TPU_PAGED_ATTENTION_KERNEL",),
+                               "paged decode/verify read path through the "
+                               "Pallas page-table kernel (in-kernel page "
+                               "gather, no pool-wide materialization); off "
+                               "= XLA pool[page_table] gather fallback"),
+    "fused_adam": (bool, False, ("MXNET_TPU_FUSED_ADAM",),
+                   "route Adam/AdamW updates through the fused Pallas "
+                   "kernel on TPU (one pass over grad/m/v/master; off "
+                   "until hardware-validated; interpret-mode tested)"),
+    "fused_softmax_xent": (bool, False, ("MXNET_TPU_FUSED_SOFTMAX_XENT",),
+                           "fused softmax-cross-entropy Pallas kernel "
+                           "(custom VJP) for sparse-label gluon loss on "
+                           "TPU (off until hardware-validated; "
+                           "interpret-mode tested)"),
     "default_dtype": (str, "float32", ("MXNET_DEFAULT_DTYPE",), "creation dtype"),
     "storage_fallback_warn": (bool, True, ("MXNET_STORAGE_FALLBACK_WARN",),
                               "warn when a sparse input densifies at an op "

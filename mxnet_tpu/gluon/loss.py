@@ -90,6 +90,19 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
+        from ..ops import pallas_softmax_xent as _psx
+
+        if (self._sparse_label and not self._from_logits
+                and _psx.xent_kernel_supported(getattr(pred, "_data", pred),
+                                               self._axis)):
+            # fused logsumexp-minus-pick Pallas kernel on TPU (custom VJP;
+            # see ops/pallas_softmax_xent.py) — the (N, C) log-softmax
+            # intermediate of the composition below never materializes
+            loss = F.softmax_cross_entropy_fused(pred, label)
+            loss = _apply_weighting(F, loss, self._weight, sample_weight)
+            if loss.ndim <= 1:
+                return loss
+            return loss.reshape((loss.shape[0], -1)).mean(axis=1)
         if not self._from_logits:
             pred = F.log_softmax(pred, axis=self._axis)
         if self._sparse_label:

@@ -9,6 +9,7 @@ from . import core  # noqa: F401
 from . import nn  # noqa: F401
 from . import attention  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import pallas_softmax_xent  # noqa: F401
 from . import random_ops  # noqa: F401
 from . import contrib_vision  # noqa: F401
 from . import linalg  # noqa: F401

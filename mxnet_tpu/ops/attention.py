@@ -182,12 +182,22 @@ def _paged_cached_mha(q, k_new, v_new, k_pool, v_pool, page_table, position):
 
     Writes scatter each new token into ``pool[table[pos // ps], :, pos % ps]``
     (positions past the table's capacity, and any slot a released row's
-    cleared table maps to, redirect to the trash page). Reads gather the
-    whole per-row history ``pool[page_table]`` back into a (B, H, cap, Ch)
-    view and run the shared :func:`_frontier_masked_attention` — masked
-    entries (stale/trash/garbage K/V) get a softmax weight of exactly 0.0,
-    so logits are bit-identical to the contiguous cache.
+    cleared table maps to, redirect to the trash page). Reads run the
+    Pallas paged-attention kernel when it qualifies
+    (:mod:`mxnet_tpu.ops.pallas_paged_attention` — the per-row page gather
+    happens *inside* the kernel, so no pool-wide ``pool[page_table]``
+    materialization ever exists in the program); otherwise the XLA
+    fallback gathers the row histories into a (B, H, cap, Ch) view and
+    runs the shared :func:`_frontier_masked_attention`. Both paths mask
+    stale/trash/garbage K/V to a softmax weight of exactly 0.0, and the
+    kernel replicates the fallback's op order — so logits are
+    bit-identical to the contiguous cache either way.
     """
+    from . import pallas_paged_attention as ppa
+
+    if ppa.paged_attention_supported(q, k_pool, page_table):
+        return ppa.paged_attention(q, k_new, v_new, k_pool, v_pool,
+                                   page_table, position)
     b, h, tq, ch = q.shape
     ps = k_pool.shape[2]
     n_pages = page_table.shape[1]

@@ -108,6 +108,17 @@ class Optimizer:
         changes never retrigger XLA compilation."""
         raise NotImplementedError
 
+    def update_raw_mp(self, w, g, state, lr, wd, t, out_dtype):
+        """Master-weight variant of :meth:`update_raw`: also returns the
+        updated weight cast to the stored low precision —
+        ``(new_w, new_state, new_w_lowp)``. The default is the two-pass
+        composition (update, then cast); optimizers with a fused Pallas
+        kernel (Adam, see ``ops/pallas_optimizer.py``) override it to emit
+        the cast as a second kernel output in the same pass over the
+        weight bytes."""
+        new_w, new_state = self.update_raw(w, g, state, lr, wd, t)
+        return new_w, new_state, new_w.astype(out_dtype)
+
     # -- fp32 master weights (reference: multi_precision optimizers) ---------
     def _needs_master(self, raw):
         return self.multi_precision and raw.dtype in (jnp.float16, jnp.bfloat16)
@@ -159,9 +170,11 @@ class Optimizer:
             new_master = master_nd._data
         else:
             graw = grad._data if hasattr(grad, "_data") else grad
-            new_master, new_base = self.update_raw(
+            new_master, new_base, low = self.update_raw_mp(
                 master, graw.astype(jnp.float32), base,
-                jnp.float32(lr), jnp.float32(wd), jnp.int32(t))
+                jnp.float32(lr), jnp.float32(wd), jnp.int32(t), raw.dtype)
+            weight._data = low
+            return {"master": new_master, "base": new_base}
         weight._data = new_master.astype(raw.dtype)
         return {"master": new_master, "base": new_base}
 
@@ -280,21 +293,50 @@ class Adam(Optimizer):
         raw = weight._data if hasattr(weight, "_data") else weight
         return (jnp.zeros_like(raw, jnp.float32), jnp.zeros_like(raw, jnp.float32))
 
-    def update_raw(self, w, g, state, lr, wd, t):
-        mean, var = state
+    def _lr_t(self, lr, t):
         tf = jnp.asarray(t, jnp.float32)
         # bias correction folded into lr like the reference adam_update
         coef1 = 1.0 - jnp.power(self.beta1, tf)
         coef2 = 1.0 - jnp.power(self.beta2, tf)
-        lr_t = lr * jnp.sqrt(coef2) / coef1
+        return lr * jnp.sqrt(coef2) / coef1
+
+    def update_raw(self, w, g, state, lr, wd, t):
+        from .ops import pallas_optimizer as _po
+
+        mean, var = state
+        lr_t = self._lr_t(lr, t)
+        if _po.fused_adam_supported(w, g, mean):
+            new_w, m, v = _po.adam_update_fused(
+                w, g, mean, var, lr_t, beta1=self.beta1, beta2=self.beta2,
+                epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient)
+            return new_w, (m, v)
         new_w, m, v = _oo.adam_update(w, g, mean, var, lr_t, self.beta1, self.beta2,
                                       self.epsilon, wd, self.rescale_grad, self.clip_gradient)
         return new_w, (m, v)
+
+    def update_raw_mp(self, w, g, state, lr, wd, t, out_dtype):
+        from .ops import pallas_optimizer as _po
+
+        mean, var = state
+        if _po.fused_adam_supported(w, g, mean):
+            new_w, m, v, low = _po.adam_update_fused(
+                w, g, mean, var, self._lr_t(lr, t), beta1=self.beta1,
+                beta2=self.beta2, epsilon=self.epsilon, wd=wd,
+                rescale_grad=self.rescale_grad,
+                clip_gradient=self.clip_gradient, out_dtype=out_dtype)
+            return new_w, (m, v), low
+        return super().update_raw_mp(w, g, state, lr, wd, t, out_dtype)
 
 
 @register
 class AdamW(Adam):
     """Decoupled weight decay (used by BERT fine-tune scripts)."""
+
+    def update_raw_mp(self, w, g, state, lr, wd, t, out_dtype):
+        # decoupled decay is applied after the Adam step, so it cannot ride
+        # the fused coupled-wd kernel pass Adam overrides this with
+        return Optimizer.update_raw_mp(self, w, g, state, lr, wd, t, out_dtype)
 
     def update_raw(self, w, g, state, lr, wd, t):
         mean, var = state

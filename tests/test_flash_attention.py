@@ -18,16 +18,43 @@ def _dense(q, k, v, causal):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
 
 
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 2e-4),
+                                       (jnp.bfloat16, 3e-2)])
 @pytest.mark.parametrize("causal", [False, True])
-def test_flash_forward_matches_dense(causal):
+def test_flash_forward_matches_dense(causal, dtype, tol):
     rs = np.random.RandomState(0)
     B, H, T, D = 2, 2, 256, 128
-    q = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
-    k = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
-    v = jnp.asarray(rs.randn(B, H, T, D), jnp.float32)
+    q = jnp.asarray(rs.randn(B, H, T, D), dtype)
+    k = jnp.asarray(rs.randn(B, H, T, D), dtype)
+    v = jnp.asarray(rs.randn(B, H, T, D), dtype)
     out = fa.flash_attention(q, k, v, causal=causal, interpret=True)
+    assert out.dtype == dtype
     ref = _dense(q, k, v, causal)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.float32, 3e-4),
+                                       (jnp.bfloat16, 3e-2)])
+@pytest.mark.parametrize("T", [320, 192])
+def test_flash_forward_ragged_lengths(T, dtype, tol):
+    """Sequence lengths with no MXU-friendly divisor (the final block is
+    ragged — `_pick_block` falls back to a whole-length tile) combined
+    with a sub-lane head dim (D=64 rides the `_lane_pad` path): the same
+    padded/ragged-final-page edge cases the paged decode kernel must get
+    right."""
+    rs = np.random.RandomState(21)
+    B, H, D = 2, 2, 64
+    q = jnp.asarray(rs.randn(B, H, T, D), dtype)
+    k = jnp.asarray(rs.randn(B, H, T, D), dtype)
+    v = jnp.asarray(rs.randn(B, H, T, D), dtype)
+    out = fa.flash_attention(q, k, v, causal=True, interpret=True)
+    assert out.shape == (B, H, T, D) and out.dtype == dtype
+    ref = _dense(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=tol, atol=tol)
 
 
 @pytest.mark.parametrize("tq,tk", [(128, 384), (384, 128)])

@@ -1,8 +1,8 @@
 """Golden-program memory gate (ISSUE 12, docs/ANALYSIS.md "Memory"):
 `make memcheck` as a test — the committed mem_* goldens match the current
-programs, an injected >5% peak regression fails the build, the known
-paged-decode gather-materialize class is pinned (not failing), and the
---update-golden rebless workflow round-trips.
+programs, an injected >5% peak regression fails the build, the paged families
+stay gather-free under the hard assert_gather_free() invariant
+(ISSUE 18), and the --update-golden rebless workflow round-trips.
 
 Runs tools/memcheck.py in-process (importlib) so each case can pick one
 cheap program family and capture the JSON verdict without a subprocess
@@ -58,18 +58,29 @@ def test_injected_peak_regression_fails_gate(memcheck, capsys):
     assert "peak residency regressed" in out
 
 
-def test_paged_gather_materialize_is_pinned_not_failing(memcheck, capsys):
-    """The paged decode's XLA gather-materialize of the pool (ROADMAP:
-    removed by the future Pallas decode kernel) is a KNOWN class recorded
-    in the golden — the gate passes while still pinning it, so a NEW
-    class elsewhere would fail."""
+def test_paged_gather_free_is_asserted_not_just_blessed(memcheck, capsys):
+    """ISSUE 18: the paged decode reads the page table inside the Pallas
+    kernel, so the family is gather-FREE — and not merely because the
+    golden says so: assert_gather_free() hard-fails on any
+    kv_gather_materialize in the paged families, even during a rebless."""
     rc = memcheck.main(["--family", "decode_paged", "--skip-validate"])
     row, _ = _verdict(capsys)
     assert rc == 0 and row["ok"]
     fam = row["families"]["decode_paged"]
-    assert fam["materializations"].get("kv_gather_materialize", 0) > 0
+    assert fam["materializations"].get("kv_gather_materialize", 0) == 0
     assert fam["by_category"]["kv_pages"] > 0
     assert fam["carry_donation"] == 1.0
+    # failure path: a reappearing gather fails regardless of the goldens
+    fails = []
+    memcheck.assert_gather_free(
+        "verify_spec", {"materializations": {"kv_gather_materialize": 2}},
+        fails)
+    assert fails and "kv_gather_materialize" in fails[0]
+    # ...and only the paged families carry the invariant
+    fails = []
+    memcheck.assert_gather_free(
+        "decode", {"materializations": {"kv_gather_materialize": 2}}, fails)
+    assert not fails
 
 
 def test_validation_cross_checks_memory_analysis(memcheck, capsys):

@@ -349,16 +349,39 @@ def test_dense_decode_kv_category_and_no_materializations(engines):
 
 
 def test_paged_decode_kv_pages_attribution_and_gather_detector(engines):
-    """The paged decode's pool+table bytes are auditor-attributed exactly,
-    and the known XLA gather-materialize of the pool (ROADMAP: what the
-    Pallas decode kernel will remove) is detected — one gather per K/V
-    pool per layer."""
+    """The paged decode's pool+table bytes are auditor-attributed exactly
+    and the compiled program is gather-free with the paged attention
+    kernel on (ISSUE 18) — while the detector still proves it would
+    catch the pool gather if the kernel were bypassed (knob off: one
+    gather per K/V pool per layer, as before the kernel existed)."""
+    from mxnet_tpu import config as _config
+
     _, paged = engines
     mem = paged.audit().memory
     hand = int(sum(b.nbytes for layer in paged.pools for b in layer)) \
         + int(paged.page_table.nbytes)
     assert mem.by_category["kv_pages"] == hand
-    kinds = mem.materialization_kinds()
+    assert mem.materialization_kinds().get("kv_gather_materialize", 0) == 0
+    # a FRESH engine with the kernel knob off re-traces the gather path
+    # (the knob is trace-time; an existing engine's decode jaxpr is cached,
+    # so toggling it on `paged` would silently audit the old trace)
+    from mxnet_tpu.inference import GenerationEngine
+    from mxnet_tpu.models import gpt2
+    from mxnet_tpu import nd
+
+    _config.set("paged_attention_kernel", False)
+    try:
+        net = gpt2.get_gpt2("gpt2_tiny", dropout=0.0, num_layers=2,
+                            units=32, num_heads=2, max_length=64,
+                            vocab_size=64)
+        net.initialize()
+        _ = net(nd.array(np.zeros((1, 4), np.int32)))
+        gathering = GenerationEngine(net, batch_size=2, max_length=64,
+                                     prefill_buckets=(8, 16), paged=True,
+                                     page_size=16)
+        kinds = gathering.audit().memory.materialization_kinds()
+    finally:
+        _config.set("paged_attention_kernel", True)
     assert kinds.get("kv_gather_materialize") == 4  # 2 layers x (K, V)
 
 

@@ -1,0 +1,131 @@
+"""Paged decode-attention Pallas kernel vs the XLA gather path (interpret
+mode on CPU). The contract is BIT-identity, not tolerance: the engine's
+dense-vs-paged logits test (`test_paged_inference.py`) asserts exact
+equality per decode step, so the kernel must replicate the gather path's
+op order to the last ulp."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu import config as _config
+from mxnet_tpu.ops import attention as att
+from mxnet_tpu.ops import pallas_paged_attention as ppa
+
+
+def _mk_case(rs, b, h, tq, ch, ps, n_pages, pool_pages, dtype=jnp.float32,
+             with_trash_rows=False):
+    k_pool = jnp.asarray(rs.randn(pool_pages + 1, h, ps, ch), dtype)
+    v_pool = jnp.asarray(rs.randn(pool_pages + 1, h, ps, ch), dtype)
+    table = jnp.asarray(rs.randint(1, pool_pages + 1, (b, n_pages)), jnp.int32)
+    if with_trash_rows:
+        # released rows map every slot to the trash page (id 0) — their
+        # garbage K/V must still be read and exactly masked
+        table = table.at[0].set(0)
+    cap = n_pages * ps
+    position = jnp.asarray(rs.randint(0, cap - tq + 1, (b,)), jnp.int32)
+    q = jnp.asarray(rs.randn(b, h, tq, ch), jnp.float32)
+    k_new = jnp.asarray(rs.randn(b, h, tq, ch), jnp.float32)
+    v_new = jnp.asarray(rs.randn(b, h, tq, ch), jnp.float32)
+    return q, k_new, v_new, k_pool, v_pool, table, position
+
+
+def _gather_reference(q, k_new, v_new, k_pool, v_pool, table, position):
+    """The XLA pool-gather path, forced by disabling the kernel knob."""
+    _config.set("paged_attention_kernel", False)
+    try:
+        return att._paged_cached_mha(q, k_new, v_new, k_pool, v_pool,
+                                     table, position)
+    finally:
+        _config.set("paged_attention_kernel", True)
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("tq", [1, 5])
+def test_paged_kernel_bit_identical(dtype, tq):
+    rs = np.random.RandomState(0)
+    case = _mk_case(rs, b=3, h=2, tq=tq, ch=16, ps=8, n_pages=8,
+                    pool_pages=12, dtype=dtype)
+    out_r, kp_r, vp_r = _gather_reference(*case)
+    out_k, kp_k, vp_k = ppa.paged_attention(*case, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out_r), np.asarray(out_k))
+    np.testing.assert_array_equal(np.asarray(kp_r, np.float32),
+                                  np.asarray(kp_k, np.float32))
+    np.testing.assert_array_equal(np.asarray(vp_r, np.float32),
+                                  np.asarray(vp_k, np.float32))
+
+
+@pytest.mark.parametrize("ps,n_pages", [(6, 11), (8, 3)])
+def test_paged_kernel_ragged_final_page(ps, n_pages):
+    """Odd page sizes / capacities (cap = n_pages*ps not a power of two,
+    final page partially filled) — positions at the very frontier of the
+    last page must mask exactly like the gather path."""
+    rs = np.random.RandomState(1)
+    q, k_new, v_new, k_pool, v_pool, table, _ = _mk_case(
+        rs, b=2, h=2, tq=1, ch=16, ps=ps, n_pages=n_pages, pool_pages=14)
+    cap = ps * n_pages
+    # one row mid-page, one row writing the LAST slot of the last page
+    position = jnp.asarray([ps + 2, cap - 1], jnp.int32)
+    args = (q, k_new, v_new, k_pool, v_pool, table, position)
+    out_r, kp_r, vp_r = _gather_reference(*args)
+    out_k, kp_k, vp_k = ppa.paged_attention(*args, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out_r), np.asarray(out_k))
+    np.testing.assert_array_equal(np.asarray(kp_r), np.asarray(kp_k))
+
+
+def test_paged_kernel_trash_page_rows():
+    """A released row (all table slots = 0) attends over trash-page garbage
+    past its frontier — weights must be exactly 0.0, identical to XLA."""
+    rs = np.random.RandomState(2)
+    case = _mk_case(rs, b=3, h=2, tq=1, ch=16, ps=8, n_pages=4,
+                    pool_pages=10, with_trash_rows=True)
+    out_r, _, _ = _gather_reference(*case)
+    out_k, _, _ = ppa.paged_attention(*case, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out_r), np.asarray(out_k))
+
+
+def test_paged_kernel_under_jit():
+    """The kernel must trace cleanly inside jit (the engine's compiled
+    decode program) and stay bit-identical."""
+    rs = np.random.RandomState(3)
+    case = _mk_case(rs, b=2, h=2, tq=1, ch=16, ps=8, n_pages=4, pool_pages=6)
+    out_r, _, _ = _gather_reference(*case)
+    out_k, _, _ = jax.jit(
+        lambda *a: ppa.paged_attention(*a, interpret=True))(*case)
+    np.testing.assert_array_equal(np.asarray(out_r), np.asarray(out_k))
+
+
+def test_paged_supported_gating():
+    q = jnp.zeros((2, 2, 1, 16), jnp.float32)
+    k_pool = jnp.zeros((5, 2, 8, 16), jnp.float32)
+    table = jnp.zeros((2, 4), jnp.int32)
+    # CPU interpret mode: always qualifies (this is what keeps the compiled
+    # CI decode/verify programs gather-free in the memory goldens)
+    assert ppa.paged_attention_supported(q, k_pool, table)
+    _config.set("paged_attention_kernel", False)
+    try:
+        assert not ppa.paged_attention_supported(q, k_pool, table)
+    finally:
+        _config.set("paged_attention_kernel", True)
+
+
+def test_paged_supported_tpu_shape_rules():
+    """The hardware gate wants lane-aligned heads, 8-aligned pages, and a
+    VMEM-bounded scratch history."""
+    import unittest.mock as mock
+
+    table = jnp.zeros((2, 4), jnp.int32)
+    with mock.patch.object(ppa, "_on_tpu", return_value=True):
+        ok_q = jnp.zeros((2, 2, 1, 128), jnp.float32)
+        ok_pool = jnp.zeros((5, 2, 8, 128), jnp.float32)
+        assert ppa.paged_attention_supported(ok_q, ok_pool, table)
+        # Ch not lane-aligned
+        assert not ppa.paged_attention_supported(
+            jnp.zeros((2, 2, 1, 96), jnp.float32),
+            jnp.zeros((5, 2, 8, 96), jnp.float32), table)
+        # page_size not sublane-aligned
+        assert not ppa.paged_attention_supported(
+            ok_q, jnp.zeros((5, 2, 6, 128), jnp.float32), table)
+        # scratch history past the VMEM budget
+        big_table = jnp.zeros((2, 4096), jnp.int32)
+        assert not ppa.paged_attention_supported(ok_q, ok_pool, big_table)

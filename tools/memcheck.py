@@ -15,7 +15,11 @@ The gate FAILS when:
     ``f32_upcast`` / ``long_lived_temp``) that the golden doesn't have —
     a fusion/layout change started materializing something it didn't;
   - **donation coverage drops** below the golden (a donated carry lost
-    its in-place update, doubling its residency).
+    its in-place update, doubling its residency);
+  - a ``kv_gather_materialize`` buffer appears in the paged decode/verify
+    families at all (:data:`GATHER_FREE_FAMILIES`) — those programs read
+    the page table inside the paged attention kernel (ISSUE 18) and must
+    stay gather-free even across reblesses.
 
 Category-attribution drift and peak *improvements* beyond tolerance pass
 but are reported, so wins can be locked in by reblessing. The gate also
@@ -185,6 +189,26 @@ def validate(fails, notes):
     return out
 
 
+# families whose compiled program must stay free of pool-wide KV gather
+# materialization FOREVER (ISSUE 18: the paged decode-attention kernel
+# reads the page table in-kernel; this asserts the gather can never
+# silently come back, independent of what the goldens say — it applies
+# even while reblessing)
+GATHER_FREE_FAMILIES = ("decode_paged", "verify_spec")
+
+
+def assert_gather_free(name: str, cur: dict, fails: list):
+    if name not in GATHER_FREE_FAMILIES:
+        return
+    n = cur["materializations"].get("kv_gather_materialize", 0)
+    if n:
+        fails.append(
+            f"{name}: {n} kv_gather_materialize buffer(s) in a family the "
+            "paged attention kernel must keep gather-free — the in-kernel "
+            "page read was bypassed (check the paged_attention_kernel knob "
+            "and paged_attention_supported())")
+
+
 def _golden_path(name: str) -> str:
     return os.path.join(GOLDEN_DIR, f"mem_{name}.json")
 
@@ -218,6 +242,7 @@ def main(argv=None):
             cur["peak_bytes"] = int(cur["peak_bytes"] * 1.2)
             cur["temp_peak_bytes"] = int(cur["temp_peak_bytes"] * 1.2)
         row["families"][name] = cur
+        assert_gather_free(name, cur, fails)
         if args.update_golden:
             os.makedirs(GOLDEN_DIR, exist_ok=True)
             with open(_golden_path(name), "w") as f:
