@@ -209,7 +209,7 @@ perfwin: native
 # artifact committed per measurement round as GENBENCH_$(GENBENCH_ROUND).json
 # (override GENBENCH_ROUND to rebless an old round; the default is the
 # current round so a rerun never silently clobbers an earlier artifact)
-GENBENCH_ROUND ?= r03
+GENBENCH_ROUND ?= r04
 genbench:
 	$(PY) tools/genbench.py --out GENBENCH_$(GENBENCH_ROUND).json
 

@@ -11,11 +11,16 @@ TTFT / throughput. Runs in seconds on CPU:
   python examples/generate_gpt2.py --model gpt2_117m --batch-size 8
   python examples/generate_gpt2.py --paged --num-pages 24
   python examples/generate_gpt2.py --paged --speculate 4
+  python examples/generate_gpt2.py --share-prefix --samples 4
 
 ``--paged`` swaps the dense per-slot cache for the page-pool cache
 (admission bounded by free pages; pages-in-use printed per run) and
 ``--speculate k`` adds self-drafting speculative decoding on top (accept
-rate printed; greedy tokens stay identical).
+rate printed; greedy tokens stay identical). ``--share-prefix`` turns on
+the radix prefix cache and gives every request the same system-prompt
+head (prefix-hit rate and CoW copies printed); ``--samples N`` draws N
+parallel samples from ONE prompt — the first prefills, the other N-1 are
+admitted by copy-on-write fork (watch their near-zero TTFT).
 """
 import argparse
 import os
@@ -53,7 +58,15 @@ def main():
                     help="pool capacity in pages (default: dense-equivalent)")
     ap.add_argument("--speculate", type=int, default=0, metavar="K",
                     help="self-drafting speculative decode, K tokens/round "
-                         "(implies --paged, forces greedy)")
+                         "(implies --paged)")
+    ap.add_argument("--share-prefix", action="store_true",
+                    help="radix prefix cache (implies --paged): every "
+                         "request shares a system-prompt head; hit rate "
+                         "and CoW copies printed")
+    ap.add_argument("--samples", type=int, default=1, metavar="N",
+                    help="N-way parallel sampling from ONE prompt via "
+                         "copy-on-write fork (implies --paged; switches "
+                         "greedy to temperature so samples can diverge)")
     args = ap.parse_args()
 
     mx.random.seed(0)
@@ -62,32 +75,52 @@ def main():
     net.initialize()
     _ = net(nd.array(np.zeros((1, 4)), dtype="int32"))  # materialize params
 
-    paged = args.paged or args.speculate > 0
-    sampling = ("greedy" if args.speculate else
-                SamplingConfig(method=args.sampling,
-                               temperature=args.temperature))
+    paged = (args.paged or args.speculate > 0 or args.share_prefix
+             or args.samples > 1)
+    method = args.sampling
+    if args.samples > 1 and method == "greedy":
+        method = "temperature"  # identical greedy samples would be no demo
+    sampling = SamplingConfig(method=method, temperature=args.temperature)
     eng = GenerationEngine(
         net, batch_size=args.batch_size, max_length=args.max_length,
         prefill_buckets=(16, 32, 64), eos_id=None, pad_id=0,
         sampling=sampling, paged=paged, page_size=args.page_size,
-        num_pages=args.num_pages,
+        num_pages=args.num_pages, prefix_cache=args.share_prefix,
         draft_net=net if args.speculate else None,
         speculate_k=args.speculate)
     bat = ContinuousBatcher(eng)
 
     rs = np.random.RandomState(1)
-    reqs = [bat.submit(list(rs.randint(1, args.vocab, rs.randint(4, 48))),
-                       max_new_tokens=args.max_new_tokens)
-            for _ in range(args.requests)]
+    if args.samples > 1:
+        # one prompt, N samples: the leader prefills, the rest are
+        # copy-on-write forks that share its prompt pages
+        leader = bat.submit(list(rs.randint(1, args.vocab, 32)),
+                            max_new_tokens=args.max_new_tokens,
+                            samples=args.samples)
+        reqs = leader.samples
+    elif args.share_prefix:
+        # same system-prompt head on every request; the first prefill
+        # computes it, later ones adopt the cached pages
+        head = list(rs.randint(1, args.vocab, 32))
+        reqs = [bat.submit(head + list(rs.randint(1, args.vocab,
+                                                  rs.randint(4, 16))),
+                           max_new_tokens=args.max_new_tokens)
+                for _ in range(args.requests)]
+    else:
+        reqs = [bat.submit(list(rs.randint(1, args.vocab, rs.randint(4, 48))),
+                           max_new_tokens=args.max_new_tokens)
+                for _ in range(args.requests)]
     peak_pages = 0
     while bat.step():
         peak_pages = max(peak_pages, eng.pages_in_use)
 
     for r in reqs:
         toks = r.result()
+        tag = "  (forked)" if r.forked else ""
         print(f"req {r.id}: prompt={len(r.prompt):3d} tok  "
               f"ttft={1e3 * r.ttft:7.1f} ms  generated={len(toks):3d}  "
-              f"[{', '.join(map(str, toks[:8]))}{', ...' if len(toks) > 8 else ''}]")
+              f"[{', '.join(map(str, toks[:8]))}"
+              f"{', ...' if len(toks) > 8 else ''}]{tag}")
     programs = REGISTRY.get("gen_recompiles_total")
     kind = ("prefill buckets used + 1 draft + 1 verify" if eng.speculative
             else "prefill buckets used + 1 decode")
@@ -96,6 +129,18 @@ def main():
     if paged:
         print(f"pages: peak {peak_pages}/{eng.num_pages} in use "
               f"(page_size {eng.page_size}, now {eng.pages_in_use} held)")
+    if args.share_prefix or args.samples > 1:
+        def _total(name):
+            c = REGISTRY.get(name)
+            return int(c.total()) if c else 0
+
+        hits, hit_toks = (_total("gen_prefix_hits_total"),
+                          _total("gen_prefix_hit_tokens"))
+        prefills = len([r for r in reqs if not r.forked and r.done])
+        print(f"prefix sharing: {hits}/{prefills} prefill(s) hit the radix "
+              f"cache ({hit_toks} prompt tokens adopted, zero recompute), "
+              f"{_total('gen_cow_copies_total')} CoW page copies, "
+              f"{_total('gen_forks_total')} forks")
     if eng.speculative:
         rate = REGISTRY.get("gen_spec_accept_rate")
         acc = REGISTRY.get("gen_spec_accepted_tokens_total")

@@ -172,6 +172,11 @@ _KNOBS: Dict[str, tuple] = {
     "router_seed": (int, 0, ("MXNET_TPU_ROUTER_SEED",),
                     "seed for the power-of-two-choices candidate sampling "
                     "(deterministic routing in drills and tests)"),
+    "router_prefix_tokens": (int, 16, ("MXNET_TPU_ROUTER_PREFIX_TOKENS",),
+                             "sessionless affinity: requests whose first N "
+                             "prompt tokens match are routed to the same "
+                             "replica so its radix prefix cache keeps the "
+                             "shared pages hot; 0 disables"),
     # -- request tracing + SLO ledger (docs/OBSERVABILITY.md
     #    "Request tracing & SLO ledger") -------------------------------------
     "trace": (bool, False, ("MXNET_TPU_TRACE",),

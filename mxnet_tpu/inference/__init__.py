@@ -23,6 +23,7 @@ Two pieces:
 """
 from .engine import GenerationEngine, SamplingConfig  # noqa: F401
 from .batcher import ContinuousBatcher, GenRequest  # noqa: F401
+from .prefix_cache import RadixPrefixCache  # noqa: F401
 
 __all__ = ["GenerationEngine", "SamplingConfig", "ContinuousBatcher",
-           "GenRequest"]
+           "GenRequest", "RadixPrefixCache"]

@@ -134,6 +134,14 @@ class GenRequest:
         self.admit_t: Optional[float] = None
         #: decode dispatch rounds this request rode
         self.rounds = 0
+        #: N-way sampling (``submit(..., samples=N)``): the leader request
+        #: this one should fork from at admission (None = independent),
+        #: and — on the leader — the whole sample group's handles
+        self._fork_of: Optional["GenRequest"] = None
+        self.samples: Optional[List["GenRequest"]] = None
+        #: True when this request was admitted by a copy-on-write fork
+        #: (refcount bump) instead of a prefill
+        self.forked = False
 
     @property
     def done(self) -> bool:
@@ -229,11 +237,22 @@ class ContinuousBatcher:
     # -- client side ---------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 32,
                deadline_s: Optional[float] = None,
-               trace_id: Optional[str] = None) -> GenRequest:
+               trace_id: Optional[str] = None,
+               samples: int = 1) -> GenRequest:
         """Queue a request. Raises ``ValueError`` for prompts that could
         never be served (no bucket / more pages than the pool); returns an
         already-finished handle (``finish_reason == "shed"``) when overload
         control sheds it — callers must check ``req.done``.
+
+        ``samples=N`` (paged engines) requests N-way parallel sampling
+        from one prompt: the returned *leader* prefills once and N-1
+        sibling rows are admitted by copy-on-write fork (refcount bump,
+        zero recompute, first sibling token resampled from the leader's
+        prefill logits). All N handles land on the leader's ``samples``
+        list. Siblings ride the normal overload controls; if the leader
+        finishes or sheds before a sibling is forked, the sibling falls
+        back to an ordinary prefill (the prefix cache, when enabled,
+        still makes that cheap).
 
         ``trace_id`` joins this request to a fleet-level trace (the
         router passes its request id); when tracing is on and no id is
@@ -242,13 +261,25 @@ class ContinuousBatcher:
             raise ValueError("max_new_tokens must be >= 1")
         if len(prompt) < 1:
             raise ValueError("empty prompt")
+        if samples < 1:
+            raise ValueError("samples must be >= 1")
+        if samples > 1 and not self.engine.paged:
+            raise ValueError("samples > 1 needs a paged engine "
+                             "(copy-on-write fork)")
         try:
             self.engine.bucket_for(len(prompt))  # reject oversize prompts now
         except ValueError:
-            _obs.counter("gen_admission_rejects_total",
-                         "requests rejected or deferred at admission").inc(
-                             reason="prompt_length")
-            raise
+            # a prompt longer than every bucket is still admissible when
+            # a cached prefix (multi-turn session resume) shrinks the
+            # suffix into a bucket — the engine's can_admit probes that
+            if not (self.engine.paged
+                    and getattr(self.engine, "prefix_cache", None) is not None
+                    and self.engine.can_admit(prompt)):
+                _obs.counter(
+                    "gen_admission_rejects_total",
+                    "requests rejected or deferred at admission").inc(
+                        reason="prompt_length")
+                raise
         if (self.engine.paged
                 and self.engine.pages_for(len(prompt)) > self.engine.num_pages):
             _obs.counter("gen_admission_rejects_total",
@@ -270,10 +301,17 @@ class ContinuousBatcher:
             # around it; a direct client gets an explicit shed
             return self._shed(req, now, cause="draining")
         # -- overload control (docs/RESILIENCE.md "Serving resilience") ------
-        if (self.engine.paged and self.shed_page_floor > 0
-                and self.engine.free_pages < self.shed_page_floor
-                and (self._queue or self.active == self.engine.batch_size)):
-            return self._shed(req, now, cause="page_floor")
+        if self.engine.paged and self.shed_page_floor > 0:
+            # the watermark charges only what this request would actually
+            # allocate: a cached prefix (pages_needed < pages_for) credits
+            # the free-page balance, so a fully cached prompt never sheds
+            # on page pressure it does not create
+            cached = (self.engine.pages_for(len(prompt))
+                      - self.engine.pages_needed(prompt))
+            if (self.engine.free_pages + cached < self.shed_page_floor
+                    and (self._queue or self.active
+                         == self.engine.batch_size)):
+                return self._shed(req, now, cause="page_floor")
         if self.max_queue > 0 and len(self._queue) >= self.max_queue:
             victim = None
             if self.queue_policy == "shed":
@@ -284,6 +322,19 @@ class ContinuousBatcher:
             self._queue.remove(victim)
             self._shed(victim, now, cause="queue_full")
         self._queue.append(req)
+        if samples > 1:
+            req.samples = [req]
+            for _ in range(samples - 1):
+                sib = GenRequest(next(self._ids), prompt, max_new_tokens,
+                                 deadline_s=deadline_s, clock=self._clock)
+                sib._fork_of = req
+                if self.tracer is not None:
+                    sib.trace_id = f"b{sib.id}"
+                req.samples.append(sib)
+                if self.max_queue > 0 and len(self._queue) >= self.max_queue:
+                    self._shed(sib, sib.submit_t, cause="queue_full")
+                    continue
+                self._queue.append(sib)
         self._gauges()
         return req
 
@@ -462,6 +513,13 @@ class ContinuousBatcher:
     def _finish(self, slot: int, reason: str):
         req = self._slots[slot]
         self._slots[slot] = None
+        if (reason in ("eos", "length", "cache_full")
+                and getattr(self.engine, "prefix_cache", None) is not None):
+            # index the clean finish's full pages before release: a
+            # multi-turn follow-up (prompt + output + next user turn)
+            # then resumes by refcount bump instead of re-prefill
+            self.engine.cache_sequence(slot, list(req.prompt)
+                                       + [int(t) for t in req.output])
         self.engine.release_slot(slot)
         req.finish_reason = reason
         req.finish_t = self._clock()
@@ -550,10 +608,62 @@ class ContinuousBatcher:
                     req.first_token_t, service_s=round(svc, 6), slot=slot,
                     req=req.id)
         req.output.append(tok)
+        if (req.samples is not None and self.engine.paged
+                and not self.engine.done[slot]):
+            # fork before the leader can finish: siblings need its pages
+            self._admit_forks(req, now)
         if self.engine.done[slot]:  # first token was EOS
             self._finish(slot, "eos")
         elif req.max_new_tokens == 1:
             self._finish(slot, "length")
+
+    def _admit_forks(self, leader: GenRequest, now: float):
+        """Admit the leader's still-queued siblings into free slots by
+        copy-on-write fork — a refcount bump plus one resample from the
+        leader's stored prefill logits, no prefill and no new pages.
+        Siblings that do not fit now stay queued; they fork on a later
+        boundary while the leader lives, or fall back to prefill."""
+        eng = self.engine
+        for sib in [r for r in self._queue if r._fork_of is leader]:
+            if eng.done[leader.slot]:
+                break  # leader finished mid-loop (sampled EOS on fork)
+            slot = next((s for s in range(eng.batch_size)
+                         if self._slots[s] is None), None)
+            if slot is None:
+                break
+            self._queue.remove(sib)
+            sib.slot = slot
+            sib.forked = True
+            self._slots[slot] = sib
+            sib.admit_t = now
+            self._queue_age(sib, now, "admitted")
+            self._trace_queue_exit(sib, now, "admitted", terminal=False,
+                                   slot=slot, forked=True)
+            svc0 = time.perf_counter()
+            tok = eng.fork_slot(leader.slot, slot, resample_first=True)
+            svc = time.perf_counter() - svc0
+            sib.first_token_t = self._clock()
+            _obs.histogram("ttft_queue_seconds",
+                           "submit -> admission: the queue-wait half of "
+                           "ttft", unit="s").observe(
+                               max(0.0, now - sib.submit_t))
+            _obs.histogram("ttft_seconds", "submit -> first sampled token",
+                           unit="s").observe(
+                               sib.first_token_t - sib.submit_t)
+            _obs.histogram("ttft_service_seconds",
+                           "admission -> first sampled token: the service "
+                           "half of ttft, on the real wall clock",
+                           unit="s").observe(svc)
+            tr = self.tracer
+            if tr is not None and sib.trace_id is not None:
+                tr.span(sib.trace_id, "fork", sib.admit_t,
+                        sib.first_token_t, service_s=round(svc, 6),
+                        slot=slot, src=leader.slot, req=sib.id)
+            sib.output.append(tok)
+            if eng.done[slot]:  # resampled first token was EOS
+                self._finish(slot, "eos")
+            elif sib.max_new_tokens == 1:
+                self._finish(slot, "length")
 
     def _admit(self, now: float):
         """Step-boundary admission: fill free slots FIFO. On a paged
@@ -564,6 +674,13 @@ class ContinuousBatcher:
         if self.draining:
             return  # drain mode: in-flight only, nothing new starts
         eng = self.engine
+        if eng.paged and getattr(eng, "prefix_cache", None) is not None:
+            # a head admitted past the bucket check on the strength of a
+            # cached prefix can lose that prefix to eviction while
+            # queued; shed it now rather than let prefill raise
+            while self._queue and not eng.can_admit(self._queue[0].prompt):
+                self._shed(self._queue.popleft(), now,
+                           cause="prefix_evicted")
         deferral_counted = False
         for slot in range(eng.batch_size):
             if not self._queue:
@@ -574,8 +691,13 @@ class ContinuousBatcher:
             if not eng.paged:
                 self._admit_into(slot, self._queue.popleft(), now)
                 continue
-            need = eng.pages_for(len(head.prompt))
-            if eng.free_pages >= need:
+            # charge only the pages the prefill will actually allocate: a
+            # cached prefix is adopted by refcount bump, so its pages are
+            # free as far as admission is concerned; eviction headroom
+            # (available_pages >= free_pages) counts too — prefill evicts
+            # cache-only pages itself when the free list runs short
+            need = eng.pages_needed(head.prompt)
+            if eng.available_pages >= need:
                 eng.reserve_pages(0)
                 self._head_id = None
                 self._head_deferrals = 0
@@ -602,7 +724,7 @@ class ContinuousBatcher:
             # (the head keeps its queue position)
             avail = eng.free_pages - eng.reserved_pages
             cand = next((i for i in range(1, len(self._queue))
-                         if eng.pages_for(len(self._queue[i].prompt))
+                         if eng.pages_needed(self._queue[i].prompt)
                          <= avail), None)
             if cand is None:
                 break

@@ -34,11 +34,36 @@ Two serving-scale extensions ride the same no-shape-change discipline:
     output is token-identical to the non-speculative path; each round costs
     2 dispatches for up to k+1 tokens.
 
+A third serving-scale extension builds on the paged allocator
+(docs/INFERENCE.md "Prefix sharing"):
+
+  - **prefix sharing** (``prefix_cache=True``) — the host allocator keeps
+    per-page *refcounts*, so a page can back several rows at once.
+    ``fork_slot`` clones a row by bumping refcounts (zero pool bytes
+    moved); the first write into a shared page triggers a page-granular
+    compiled *copy-on-write* program. A radix tree over token-id prefixes
+    (:class:`~mxnet_tpu.inference.prefix_cache.RadixPrefixCache`) maps
+    prompt heads to cached page runs: prefill adopts the longest cached
+    prefix (refcount bump, zero recompute) and runs only the suffix
+    through the bucketed prefill programs — the same per-bucket program
+    family, with the start offset a traced argument. Under free-page
+    pressure, refcount-1 (cache-only) entries are LRU-evicted. Released
+    forks decrement refcounts and only refcount-0 pages return to the
+    free list, preserving the trash-page-safe reclaim contract.
+
+Speculative decoding composes with stochastic sampling through
+*rejection sampling*: the draft scan samples from its own distribution q
+(recording q per drafted token), and the verify program accepts token x
+with probability ``min(1, p(x)/q(x))`` against the target distribution p,
+resampling the first rejection from the normalized residual
+``max(p - q, 0)`` — the emitted tokens are distributed exactly as plain
+sampled decode.
+
 Nothing in the serving loop changes a shape, so the compiled-program count
-is exactly ``len(buckets used) + 1`` (+1 verify when speculating) — counted
-through the observability registry (``gen_recompiles_total{reason=
-"prefill_bucket"|"decode"|"verify"}``), the same discipline as
-``train_recompiles_total``.
+is exactly ``len(buckets used) + 1`` (+1 verify when speculating, +1 the
+first copy-on-write dispatch) — counted through the observability registry
+(``gen_recompiles_total{reason="prefill_bucket"|"decode"|"verify"|
+"cow_copy"}``), the same discipline as ``train_recompiles_total``.
 """
 from __future__ import annotations
 
@@ -57,6 +82,7 @@ from ..ndarray import NDArray
 from ..ops import random_ops as _rops
 from ..resilience import faults as _faults
 from ..resilience import retry as _retry
+from .prefix_cache import RadixPrefixCache
 
 __all__ = ["GenerationEngine", "SamplingConfig"]
 
@@ -114,9 +140,14 @@ class GenerationEngine:
         Default: ``batch_size * ceil(max_length / page_size)`` (the
         dense-equivalent capacity — size it DOWN to oversubscribe slots).
     draft_net : small initialized model drafting ``speculate_k`` tokens per
-        round through its own paged cache (requires ``paged=True`` and
-        greedy sampling; pass ``net`` itself to self-draft).
+        round through its own paged cache (requires ``paged=True``; pass
+        ``net`` itself to self-draft). Greedy sampling verifies by exact
+        prefix match; stochastic sampling verifies by rejection sampling
+        (distribution-identical to plain sampled decode).
     speculate_k : draft window length per speculative round.
+    prefix_cache : index computed prefixes in a radix tree so later
+        prompts sharing them skip recompute (requires ``paged=True``;
+        docs/INFERENCE.md "Prefix sharing").
     """
 
     def __init__(self, net, batch_size: int = 4, max_length: Optional[int] = None,
@@ -125,7 +156,8 @@ class GenerationEngine:
                  sampling=None, cache_dtype: str = "float32",
                  paged: bool = False, page_size: int = 16,
                  num_pages: Optional[int] = None,
-                 draft_net=None, speculate_k: int = 0):
+                 draft_net=None, speculate_k: int = 0,
+                 prefix_cache: bool = False):
         self.net = net
         self.batch_size = int(batch_size)
         self.max_length = int(max_length or net._max_length)
@@ -160,9 +192,17 @@ class GenerationEngine:
         if draft_net is not None and not self.paged:
             raise ValueError("speculative decoding rides the paged cache; "
                              "pass paged=True")
-        if self.speculate_k and self.sampling.method != "greedy":
-            raise ValueError("speculative decoding supports greedy sampling "
-                             "only (verification is exact prefix matching)")
+        if (self.speculate_k and self.sampling.method != "greedy"
+                and not self.sampling.stochastic):
+            # temperature=0 stochastic methods degenerate to argmax but
+            # the rejection-sampling residual would be ill-defined
+            raise ValueError("speculative decoding needs greedy sampling "
+                             "or a stochastic config (temperature > 0): "
+                             "stochastic rounds verify by rejection "
+                             "sampling, greedy by exact prefix match")
+        if prefix_cache and not self.paged:
+            raise ValueError("prefix_cache=True rides the paged allocator; "
+                             "pass paged=True")
 
         if self.paged:
             if self.page_size < 1:
@@ -198,11 +238,24 @@ class GenerationEngine:
             # worst-case NEW pages per row per dispatch (window k spans at
             # most k//ps + 2 page slots from an arbitrary start offset)
             self._upd_width = self.speculate_k // self.page_size + 2
+            #: per-page refcounts (index 0 = trash page, never counted):
+            #: a page may back several rows / the prefix cache at once;
+            #: only refcount-0 pages return to the free list
+            self._page_rc = np.zeros(self.num_pages + 1, np.int32)
+            #: copy-on-write copies per compiled dispatch (chunked)
+            self._cow_width = self.batch_size
+            self._cow_jit = None  # lazily lowered page-copy program
+            #: per-slot prefill logits (device (V,) arrays) — fork_slot's
+            #: resample_first draws an independent first token from them
+            self._prefill_logits = {}
+            self.prefix_cache = (RadixPrefixCache(self.page_size)
+                                 if prefix_cache else None)
             self._page_gauges()
         else:
             #: device state: per-layer (k_buf, v_buf), the donated carry
             self.cache = net.init_cache(self.batch_size, self.max_length,
                                         dtype=cache_dtype)
+            self.prefix_cache = None
 
         if draft_net is not None:
             self._draft_plist = [p for _, p in
@@ -246,9 +299,16 @@ class GenerationEngine:
             self._prefill_jit = jax.jit(self._spec_prefill_fn,
                                         donate_argnums=(2,),
                                         keep_unused=True)
-            self._draft_jit = jax.jit(self._draft_fn, donate_argnums=(1,),
+            # stochastic sampling swaps the greedy prefix-match round for
+            # the rejection-sampling pair (sampled draft scan records q;
+            # verify accepts with min(1, p/q) and resamples residuals)
+            draft_fn = (self._draft_sample_fn if self.sampling.stochastic
+                        else self._draft_fn)
+            verify_fn = (self._verify_sample_fn if self.sampling.stochastic
+                         else self._verify_fn)
+            self._draft_jit = jax.jit(draft_fn, donate_argnums=(1,),
                                       keep_unused=True)
-            self._verify_jit = jax.jit(self._verify_fn, donate_argnums=(1,),
+            self._verify_jit = jax.jit(verify_fn, donate_argnums=(1,),
                                        keep_unused=True)
         else:
             self._prefill_jit = jax.jit(self._paged_prefill_fn,
@@ -301,6 +361,56 @@ class GenerationEngine:
         """Pages a ``length``-token sequence occupies."""
         return -(-int(length) // self.page_size)
 
+    def suffix_for(self, prompt) -> int:
+        """Tokens a prefill would actually compute for ``prompt`` after
+        prefix adoption (the full length without a prefix cache). Probes
+        the radix tree without touching its LRU clock — admission sizing
+        is not traffic."""
+        n = len(prompt)
+        if not self.paged or self.prefix_cache is None or n == 0:
+            return n
+        _, mtok = self.prefix_cache.lookup(list(prompt), touch=False)
+        return n - min(mtok, n - 1)
+
+    def pages_needed(self, prompt) -> int:
+        """NEW pages admitting ``prompt`` must supply after prefix reuse
+        (paged mode): adopted full pages are refcount bumps, not
+        allocations — the admission/shed watermarks must charge only
+        these, or fully-cached prompts would shed on a busy pool."""
+        if not self.paged:
+            return 0
+        n = len(prompt)
+        adopted_full = (n - self.suffix_for(prompt)) // self.page_size
+        return self.pages_for(n) - adopted_full
+
+    def can_admit(self, prompt) -> bool:
+        """Whether a prefill of ``prompt`` has a bucket to run in: the
+        suffix after prefix adoption must fit a prefill bucket and the
+        prompt must fit the row. Session-resume prompts longer than the
+        largest bucket are admissible exactly when their cached history
+        shrinks the suffix into one."""
+        n = len(prompt)
+        if n == 0 or (self.paged and n >= self.max_length):
+            return False
+        try:
+            self.bucket_for(self.suffix_for(prompt))
+        except ValueError:
+            return False
+        return True
+
+    @property
+    def available_pages(self) -> int:
+        """Free pages plus prefix-cache pages evictable under pressure —
+        the admission headroom (``free_pages`` alone undercounts once the
+        cache holds refcount-1 pages the allocator can LRU-reclaim)."""
+        if not self.paged:
+            return 0
+        n = len(self._free_pages)
+        if self.prefix_cache is not None:
+            n += self.prefix_cache.collectable(
+                lambda pid: self._page_rc[pid] == 1)
+        return n
+
     @property
     def reserved_pages(self) -> int:
         """Free pages currently held back for a parked queue head."""
@@ -330,50 +440,122 @@ class GenerationEngine:
         _obs.gauge("gen_pages_in_use",
                    "allocated pages in the paged KV pool").set(
                        self.num_pages - free)
+        _obs.gauge("gen_page_refcount_max",
+                   "highest per-page refcount (sharing depth)").set(
+                       int(self._page_rc.max()) if self.num_pages else 0)
+
+    def _unref_pages(self, pages) -> int:
+        """Drop one reference from each page; refcount-0 pages return to
+        the free list (the trash-page-safe reclaim contract: a page still
+        backing another row or the prefix cache stays allocated)."""
+        freed = 0
+        for pid in pages:
+            self._page_rc[pid] -= 1
+            if self._page_rc[pid] <= 0:
+                self._page_rc[pid] = 0
+                self._free_pages.append(pid)
+                freed += 1
+        return freed
 
     def _reclaim_row(self, slot: int) -> int:
         pages = self._row_pages[slot]
         if not pages:
             return 0
-        self._free_pages.extend(pages)
         self._row_pages[slot] = []
-        _obs.counter("gen_pages_reclaimed_total",
-                     "pages returned to the free pool").inc(len(pages))
+        freed = self._unref_pages(pages)
+        if freed:
+            _obs.counter("gen_pages_reclaimed_total",
+                         "pages returned to the free pool").inc(freed)
         self._page_gauges()
-        return len(pages)
+        return freed
+
+    def _avail(self) -> int:
+        # pages past the reservation are off-limits to growth: they are
+        # being accumulated for a parked queue head (reserve_pages)
+        return len(self._free_pages) - self._reserved_pages
+
+    def _evict_prefix(self, n: int, protect=()) -> int:
+        """Free up to ``n`` pages by LRU-evicting cache-only (refcount-1)
+        prefix-cache entries. Pages still shared with a live row are
+        refused by the predicate."""
+        if self.prefix_cache is None:
+            return 0
+        evicted = self.prefix_cache.evict(
+            n, lambda pid: self._page_rc[pid] == 1, protect=protect)
+        if evicted:
+            self._unref_pages(evicted)
+            _obs.counter("gen_prefix_evictions_total",
+                         "prefix-cache pages evicted under free-page "
+                         "pressure").inc(len(evicted))
+            self._page_gauges()
+        return len(evicted)
+
+    def _take_page(self) -> int:
+        """One page off the free list (refcount 1), LRU-evicting prefix
+        cache entries under pressure. Returns 0 (the trash page id —
+        never allocated) when nothing can be freed."""
+        if self._avail() <= 0 and not self._evict_prefix(1):
+            return 0
+        pid = self._free_pages.popleft()
+        self._page_rc[pid] = 1
+        return pid
 
     def _grow_pages(self, window: int):
         """Allocate pages so every active row's table covers positions
         ``p .. min(p + window, max_length - 1)``; rows that cannot even
         cover their next write are force-finished (evicted) with
-        ``gen_page_evictions_total``. Returns the (B, U) update vectors the
-        compiled program scatters into the page-table carry."""
+        ``gen_page_evictions_total``. Shared (refcount > 1) pages inside
+        the write window get a private copy first — the copy-on-write
+        point: the compiled copy program runs before the decode dispatch,
+        so a forked row's writes can never mutate a page another row or
+        the prefix cache still reads. Returns the (B, U) update vectors
+        the compiled program scatters into the page-table carry."""
         ps = self.page_size
         upd_slots = np.zeros((self.batch_size, self._upd_width), np.int32)
         upd_pages = np.zeros((self.batch_size, self._upd_width), np.int32)
         allocated = 0
-        # pages past the reservation are off-limits to growth: they are
-        # being accumulated for a parked queue head (reserve_pages)
-        avail = len(self._free_pages) - self._reserved_pages
+        copies = []  # (row, slot, src, dst) for the compiled copy program
+
+        def _evict_row(row):
+            self.done[row] = True
+            self.page_exhausted[row] = True
+            _obs.counter(
+                "gen_page_evictions_total",
+                "rows force-finished on page exhaustion").inc(
+                    reason="exhausted")
+
         for row in range(self.batch_size):
             if self.done[row]:
                 continue
             p = int(self.positions[row])
             need = min(p + window, self.max_length - 1) // ps + 1
+            # copy-on-write: every existing page slot the window writes
+            # into must be private before the next program dispatches
+            short = False
+            for s in range(p // ps, min(need, len(self._row_pages[row]))):
+                pid = self._row_pages[row][s]
+                if self._page_rc[pid] <= 1:
+                    continue
+                new = self._take_page()
+                if not new:
+                    short = True
+                    break
+                allocated += 1
+                copies.append((row, s, pid, new))
+                self._page_rc[pid] -= 1
+                self._row_pages[row][s] = new
+            if short:
+                # a shared page it cannot copy = a write it cannot make
+                _evict_row(row)
+                continue
             u = 0
             while len(self._row_pages[row]) < need:
-                if avail <= 0:
+                pid = self._take_page()
+                if not pid:
                     if len(self._row_pages[row]) * ps <= p:
                         # cannot write the next token: evict the row
-                        self.done[row] = True
-                        self.page_exhausted[row] = True
-                        _obs.counter(
-                            "gen_page_evictions_total",
-                            "rows force-finished on page exhaustion").inc(
-                                reason="exhausted")
+                        _evict_row(row)
                     break
-                avail -= 1
-                pid = self._free_pages.popleft()
                 upd_slots[row, u] = len(self._row_pages[row])
                 upd_pages[row, u] = pid
                 self._row_pages[row].append(pid)
@@ -384,7 +566,44 @@ class GenerationEngine:
                          "pages taken from the free pool").inc(
                              allocated, site="decode")
             self._page_gauges()
+        self._dispatch_cow(copies)
         return upd_slots, upd_pages
+
+    def _dispatch_cow(self, copies) -> None:
+        """Run the page-granular copy-on-write program: each (row, slot,
+        src, dst) entry copies pool page ``src`` into the private ``dst``
+        (every layer; target AND draft pools on a speculative engine) and
+        repoints the row's page-table entry — all in-program on the
+        donated carry, BEFORE the step program that writes. Entries are
+        chunked to a fixed width so the copy program never relowers."""
+        if not copies:
+            return
+        if self._cow_jit is None:
+            self._cow_jit = jax.jit(self._cow_copy_fn, donate_argnums=(0,),
+                                    keep_unused=True)
+        W = self._cow_width
+        for i in range(0, len(copies), W):
+            chunk = copies[i:i + W]
+            rows = np.zeros(W, np.int32)
+            slots = np.zeros(W, np.int32)
+            src = np.zeros(W, np.int32)  # dst=0 pads: trash-page no-ops
+            dst = np.zeros(W, np.int32)
+            for j, (r, s, sp, dp) in enumerate(chunk):
+                rows[j], slots[j], src[j], dst[j] = r, s, sp, dp
+            self._note_program(("cow", W), "cow_copy")
+            if self.speculative:
+                carry = (self.page_table, self.pools, self.draft_pools)
+                carry = self._cow_jit(carry, jnp.asarray(rows),
+                                      jnp.asarray(slots), jnp.asarray(src),
+                                      jnp.asarray(dst))
+                self.page_table, self.pools, self.draft_pools = carry
+            else:
+                carry = self._cow_jit((self.page_table, self.pools),
+                                      jnp.asarray(rows), jnp.asarray(slots),
+                                      jnp.asarray(src), jnp.asarray(dst))
+                self.page_table, self.pools = carry
+        _obs.counter("gen_cow_copies_total",
+                     "copy-on-write page copies").inc(len(copies))
 
     def _take_clear_mask(self):
         """Rows released since the last dispatch: their device page-table
@@ -408,6 +627,21 @@ class GenerationEngine:
         return _rops.top_k_sampling(logits2d, k=cfg.top_k,
                                     temperature=cfg.temperature, key=key)
 
+    def _sample_logits(self, logits):
+        """The EXACT logit transform the stochastic samplers draw through
+        (ops/random_ops.py): optional top-k masking, then temperature
+        scaling. ``softmax`` of the result is the sampling distribution —
+        the p and q of the rejection-sampling verify must match it
+        bit-for-bit or acceptance tests would drift off the plain-decode
+        distribution."""
+        cfg = self.sampling
+        if cfg.method == "top_k":
+            k, vocab = int(cfg.top_k), logits.shape[-1]
+            if 0 < k < vocab:
+                kth = jax.lax.top_k(logits, k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return logits.astype(jnp.float32) / float(cfg.temperature)
+
     def _next_key(self):
         if not self.sampling.stochastic:
             if self._fixed_key is None:
@@ -420,6 +654,11 @@ class GenerationEngine:
 
     def _params(self):
         return tuple(p._nd._data for p in self._plist)
+
+    def _last_vocab(self) -> int:
+        """Logits width of the target model (the tied word embedding's
+        input dim) — shape info for audit()'s stochastic-verify dummy."""
+        return int(self.net.word_embed._input_dim)
 
     def _draft_params(self):
         return tuple(p._nd._data for p in self._draft_plist)
@@ -478,16 +717,18 @@ class GenerationEngine:
         return jnp.where(clear[:, None], 0, table)
 
     def _paged_prefill_fn(self, params, carry, tokens, slot, length,
-                          new_row, key):
+                          new_row, start, key):
         """Paged admission: install the row's freshly allocated page table,
         run the cached causal forward through the pools (scatter writes land
-        only in this row's pages + trash), sample the TTFT token."""
+        only in this row's pages + trash), sample the TTFT token. ``start``
+        ((1,) int32, traced) is the adopted-prefix length: a prefix-cache
+        hit runs only the suffix through this same per-bucket program
+        (cold prefill passes 0 — no extra lowering)."""
         table, pools = carry
         table = jax.lax.dynamic_update_slice(table, new_row[None, :],
                                              (slot, 0))
         row_table = jax.lax.dynamic_slice(table, (slot, 0),
                                           (1, self._n_row_pages))
-        start = jnp.zeros((1,), jnp.int32)
         with _HybridTrace(self._plist, list(params), False, key):
             logits, new_pools = self.net(
                 NDArray(tokens), cache=self._cache_nd(pools),
@@ -500,7 +741,7 @@ class GenerationEngine:
         return (table, new_pools), tok, last
 
     def _spec_prefill_fn(self, params, dparams, carry, tokens, slot, length,
-                         new_row, key):
+                         new_row, start, key):
         """Speculative admission: one program writes the prompt's K/V into
         BOTH the target and the draft page pools (shared page table)."""
         table, pools, dpools = carry
@@ -508,7 +749,6 @@ class GenerationEngine:
                                              (slot, 0))
         row_table = jax.lax.dynamic_slice(table, (slot, 0),
                                           (1, self._n_row_pages))
-        start = jnp.zeros((1,), jnp.int32)
         with _HybridTrace(self._plist, list(params), False, key):
             logits, new_pools = self.net(
                 NDArray(tokens), cache=self._cache_nd(pools),
@@ -610,6 +850,128 @@ class GenerationEngine:
             done = done | (emit & (g == self.eos_id)).any(axis=1)
         return (table, new_pools), out, m, done, acc
 
+    def _cow_copy_fn(self, carry, rows, slots, src, dst):
+        """The copy-on-write program: page-granular pool copies on the
+        donated carry. For each entry, pool page ``src`` is copied into
+        the freshly allocated ``dst`` in every layer (target and draft
+        pools share page tables, so a speculative engine copies both) and
+        the owning row's page-table slot is repointed. Padding entries
+        carry ``dst == 0``: their copy lands in the trash page (garbage
+        by contract) and the table is left untouched."""
+        if self.speculative:
+            table, pools, dpools = carry
+        else:
+            (table, pools), dpools = carry, None
+
+        def copy(ps):
+            return [tuple(b.at[dst].set(b[src]) for b in layer)
+                    for layer in ps]
+
+        pools = copy(pools)
+        if dpools is not None:
+            dpools = copy(dpools)
+        cur = table[rows, slots]
+        table = table.at[rows, slots].set(jnp.where(dst > 0, dst, cur))
+        return ((table, pools, dpools) if self.speculative
+                else (table, pools))
+
+    def _draft_sample_fn(self, dparams, carry, tokens, positions, done,
+                         upd_slots, upd_pages, clear, key):
+        """Stochastic draft scan (rejection-sampling speculation): the
+        same k+1-step structure as :meth:`_draft_fn`, but each next token
+        is SAMPLED from the draft's own decoding distribution q (the
+        identical top-k/temperature transform plain decode compiles in),
+        and q itself is recorded per drafted token — the verify program's
+        ``min(1, p/q)`` accept test needs it. Returns ``(carry',
+        (B, k) drafted tokens, (B, k, V) q distributions)``."""
+        table, pools = carry
+        table = self._apply_table_updates(table, upd_slots, upd_pages, clear)
+
+        def step(c, i):
+            pools_c, tok = c
+            with _HybridTrace(self._draft_plist, list(dparams), False, key):
+                logits, new_pools = self.draft_net(
+                    NDArray(tok.reshape(self.batch_size, 1)),
+                    cache=self._cache_nd(pools_c),
+                    start_pos=NDArray(positions + i),
+                    page_table=NDArray(table))
+            new_pools = [tuple(b._data for b in layer)
+                         for layer in new_pools]
+            lg = self._sample_logits(logits._data[:, 0])  # (B, V)
+            q = jax.nn.softmax(lg, axis=-1)
+            nxt = jax.random.categorical(
+                jax.random.fold_in(key, i), lg, axis=-1).astype(jnp.int32)
+            return (new_pools, nxt), (nxt, q)
+
+        (pools, _), (drafted, qdist) = jax.lax.scan(
+            step, (pools, tokens),
+            jnp.arange(self.speculate_k + 1, dtype=jnp.int32))
+        k = self.speculate_k
+        # drafted: (k+1, B) -> (B, k); qdist: (k+1, B, V) -> (B, k, V)
+        return (table, pools), drafted[:k].T, jnp.moveaxis(qdist[:k], 0, 1)
+
+    def _verify_sample_fn(self, params, carry, tokens, drafted, qdist,
+                          positions, done, room, key):
+        """Rejection-sampling verify: one target forward scores all k+1
+        positions; drafted token x_i is accepted with probability
+        ``min(1, p_i(x_i)/q_i(x_i))`` (uniform draw), the first rejection
+        is resampled from the normalized residual ``max(p_i - q_i, 0)``,
+        and a full accept earns a bonus token drawn from p_k — the
+        standard speculative-sampling rule, so the emitted tokens are
+        distributed EXACTLY as plain sampled decode (gated statistically
+        in tests). EOS/room/done clamps mirror the greedy verify."""
+        table, pools = carry
+        k = self.speculate_k
+        B = self.batch_size
+        x = jnp.concatenate([tokens[:, None], drafted], axis=1)  # (B, k+1)
+        with _HybridTrace(self._plist, list(params), False, key):
+            logits, new_pools = self.net(
+                NDArray(x), cache=self._cache_nd(pools),
+                start_pos=NDArray(positions), page_table=NDArray(table))
+        logits = logits._data  # (B, k+1, vocab)
+        new_pools = [tuple(b._data for b in layer) for layer in new_pools]
+        p = jax.nn.softmax(self._sample_logits(logits), axis=-1)
+        bidx = jnp.arange(B, dtype=jnp.int32)[:, None]
+        iidx = jnp.arange(k, dtype=jnp.int32)[None, :]
+        p_tok = p[:, :k][bidx, iidx, drafted]  # (B, k) target prob of draft
+        q_tok = qdist[bidx, iidx, drafted]     # (B, k) draft prob of draft
+        ukey, rkey = jax.random.split(jax.random.fold_in(key, 7))
+        u = jax.random.uniform(ukey, (B, k), jnp.float32)
+        # u < p/q  <=>  u*q < p (q(x) > 0 a.s.: x was sampled from q)
+        accept = (u * q_tok < p_tok).astype(jnp.int32)
+        acc = jnp.cumprod(accept, axis=1).sum(axis=1)  # accepted drafts
+        # the token at out-index `acc`: residual resample on a rejection,
+        # the bonus draw from p_k on a full accept. All k+1 candidate
+        # distributions are sampled at once, then gathered at acc.
+        resid = jnp.maximum(p[:, :k] - qdist, 0.0)  # (B, k, V)
+        rs = resid.sum(axis=-1, keepdims=True)
+        # p == q exactly -> empty residual: any draw from p is unbiased
+        resid = jnp.where(rs > 0, resid / jnp.maximum(rs, 1e-30), p[:, :k])
+        cand = jnp.concatenate([resid, p[:, k:]], axis=1)  # (B, k+1, V)
+        corr = jax.random.categorical(
+            rkey, jnp.log(jnp.maximum(cand, 1e-38)), axis=-1).astype(
+                jnp.int32)  # (B, k+1)
+        correction = corr[jnp.arange(B, dtype=jnp.int32), acc]
+        pos_idx = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+        padded = jnp.concatenate(
+            [drafted, jnp.zeros((B, 1), jnp.int32)], axis=1)
+        g = jnp.where(pos_idx < acc[:, None], padded,
+                      jnp.where(pos_idx == acc[:, None], correction[:, None],
+                                jnp.int32(self.pad_id)))
+        m = acc + 1
+        if self.eos_id is not None:
+            is_eos = (g == self.eos_id) & (pos_idx <= acc[:, None])
+            first = jnp.argmax(is_eos, axis=1).astype(jnp.int32)
+            m = jnp.minimum(m, jnp.where(is_eos.any(axis=1), first + 1,
+                                         k + 1))
+        m = jnp.minimum(m, jnp.maximum(room, 0))
+        m = jnp.where(done, 0, m)
+        emit = pos_idx < m[:, None]
+        out = jnp.where(emit, g, jnp.int32(self.pad_id))
+        if self.eos_id is not None:
+            done = done | (emit & (out == self.eos_id)).any(axis=1)
+        return (table, new_pools), out, m, done, acc
+
     # -- host API ------------------------------------------------------------
     def bucket_for(self, length: int) -> int:
         for b in self.prefill_buckets:
@@ -635,18 +997,47 @@ class GenerationEngine:
         # (ContinuousBatcher wraps prefill in retry_call) must replay
         # against untouched page/clear state
         _faults.fire("gen.prefill")
-        bucket = self.bucket_for(length)
-        padded = np.full((1, bucket), self.pad_id, np.int32)
-        padded[0, :length] = prompt
         t0 = time.perf_counter()
         if self.paged:
-            need = self.pages_for(length)
+            if length >= self.max_length:
+                raise ValueError(f"prompt length {length} >= max_length="
+                                 f"{self.max_length}")
+            ps = self.page_size
+            total = self.pages_for(length)
+            # prefix adoption: walk the radix cache for the longest cached
+            # page run, keeping >= 1 suffix token so this prefill still
+            # produces the last-prompt-position logits (the TTFT sample)
+            adopt: List[int] = []
+            tail_src = 0
+            start = 0
+            if self.prefix_cache is not None:
+                cpages, mtok = self.prefix_cache.lookup(prompt.tolist())
+                start = min(mtok, length - 1)
+                adopt = cpages[:start // ps]
+                if start % ps:
+                    # adoption ends inside a cached page: CoW-copy it into
+                    # a private page — stale positions past `start` stay
+                    # frontier-masked until the suffix overwrites them
+                    tail_src = cpages[start // ps]
+            suffix = length - start
+            bucket = self.bucket_for(suffix)
+            need = total - len(adopt)
             # capacity check BEFORE any allocator mutation: a failed
             # admission must leave the slot's pending table-clear (and its
             # reclaimable pages) untouched, or a released row's stale
             # device table could keep pointing at pages later handed to
-            # someone else (its masked writes would corrupt them)
-            if len(self._free_pages) + len(self._row_pages[slot]) < need:
+            # someone else (its masked writes would corrupt them). Pages
+            # being adopted are off-limits to the eviction headroom.
+            protect = set(adopt)
+            if tail_src:
+                protect.add(tail_src)
+            own = sum(1 for pid in self._row_pages[slot]
+                      if self._page_rc[pid] == 1 and pid not in protect)
+            headroom = len(self._free_pages) + own
+            if headroom < need and self.prefix_cache is not None:
+                headroom += self.prefix_cache.collectable(
+                    lambda pid: self._page_rc[pid] == 1, protect=protect)
+            if headroom < need:
                 raise RuntimeError(
                     f"insufficient free pages for a {length}-token prompt "
                     f"({need} needed, {len(self._free_pages)} free); release "
@@ -654,31 +1045,58 @@ class GenerationEngine:
             self._reclaim_row(slot)  # previous occupant's pages, if any
             self._pending_clear.discard(slot)  # the new row replaces it
             self.page_exhausted[slot] = False
-            pages = [self._free_pages.popleft() for _ in range(need)]
-            self._row_pages[slot] = pages
-            _obs.counter("gen_page_allocs_total",
-                         "pages taken from the free pool").inc(
-                             need, site="prefill")
+            short = need - len(self._free_pages)
+            if short > 0:
+                self._evict_prefix(short, protect=protect)
+            for pid in adopt:  # adopted prefix: refcount bump, no compute
+                self._page_rc[pid] += 1
+            fresh = []
+            for _ in range(need):
+                pid = self._free_pages.popleft()
+                self._page_rc[pid] = 1
+                fresh.append(pid)
+            pages = adopt + fresh
+            self._row_pages[slot] = list(pages)
+            if need:
+                _obs.counter("gen_page_allocs_total",
+                             "pages taken from the free pool").inc(
+                                 need, site="prefill")
+            if start:
+                _obs.counter("gen_prefix_hits_total",
+                             "prefills that adopted a cached prefix").inc()
+                _obs.counter("gen_prefix_hit_tokens",
+                             "prompt tokens served from the prefix "
+                             "cache").inc(int(start))
             self._page_gauges()
+            if tail_src:
+                # the copy must land before the prefill dispatch writes
+                # the suffix into the same page
+                self._dispatch_cow([(slot, len(adopt), tail_src, fresh[0])])
+            padded = np.full((1, bucket), self.pad_id, np.int32)
+            padded[0, :suffix] = prompt[start:]
             new_row = np.zeros(self._n_row_pages, np.int32)
-            new_row[:need] = pages
+            new_row[:total] = pages
             self._note_program(("prefill", bucket), "prefill_bucket")
+            start_v = jnp.full((1,), start, jnp.int32)
             if self.speculative:
                 carry = (self.page_table, self.pools, self.draft_pools)
                 carry, tok, last = self._prefill_jit(
                     self._params(), self._draft_params(), carry,
                     jnp.asarray(padded), jnp.asarray(slot, jnp.int32),
-                    jnp.asarray(length, jnp.int32), jnp.asarray(new_row),
-                    self._next_key())
+                    jnp.asarray(suffix, jnp.int32), jnp.asarray(new_row),
+                    start_v, self._next_key())
                 self.page_table, self.pools, self.draft_pools = carry
             else:
                 carry, tok, last = self._prefill_jit(
                     self._params(), (self.page_table, self.pools),
                     jnp.asarray(padded), jnp.asarray(slot, jnp.int32),
-                    jnp.asarray(length, jnp.int32), jnp.asarray(new_row),
-                    self._next_key())
+                    jnp.asarray(suffix, jnp.int32), jnp.asarray(new_row),
+                    start_v, self._next_key())
                 self.page_table, self.pools = carry
         else:
+            bucket = self.bucket_for(length)
+            padded = np.full((1, bucket), self.pad_id, np.int32)
+            padded[0, :length] = prompt
             self._note_program(("prefill", bucket), "prefill_bucket")
             cache, tok, last = self._prefill_jit(
                 self._params(), self.cache, jnp.asarray(padded),
@@ -689,6 +1107,16 @@ class GenerationEngine:
         self.positions[slot] = length
         self.last_tokens[slot] = tok
         self.done[slot] = (self.eos_id is not None and tok == self.eos_id)
+        if self.paged:
+            self._prefill_logits[slot] = last
+            if self.prefix_cache is not None:
+                # index this prompt's full pages so later prompts sharing
+                # the prefix adopt them (newly indexed pages gain a cache
+                # reference; already-cached prefixes are kept as-is)
+                for pid in self.prefix_cache.insert(prompt.tolist(),
+                                                    self._row_pages[slot]):
+                    self._page_rc[pid] += 1
+                self._page_gauges()
         if _obs.enabled():
             _obs.histogram("gen_prefill_seconds", "prompt prefill wall clock",
                            unit="s").observe(time.perf_counter() - t0,
@@ -801,11 +1229,22 @@ class GenerationEngine:
                 - int(self.positions[row])
         key = self._next_key()
         self._note_program(("draft", self.batch_size, k), "decode")
-        (table, dpools), drafted = self._draft_jit(
-            self._draft_params(), (self.page_table, self.draft_pools),
-            jnp.asarray(self.last_tokens), jnp.asarray(self.positions),
-            jnp.asarray(self.done), jnp.asarray(upd_slots),
-            jnp.asarray(upd_pages), jnp.asarray(clear), key)
+        stochastic = self.sampling.stochastic
+        qdist = None
+        if stochastic:
+            # rejection-sampling round: the draft records its sampling
+            # distribution q per drafted token, device-resident into verify
+            (table, dpools), drafted, qdist = self._draft_jit(
+                self._draft_params(), (self.page_table, self.draft_pools),
+                jnp.asarray(self.last_tokens), jnp.asarray(self.positions),
+                jnp.asarray(self.done), jnp.asarray(upd_slots),
+                jnp.asarray(upd_pages), jnp.asarray(clear), key)
+        else:
+            (table, dpools), drafted = self._draft_jit(
+                self._draft_params(), (self.page_table, self.draft_pools),
+                jnp.asarray(self.last_tokens), jnp.asarray(self.positions),
+                jnp.asarray(self.done), jnp.asarray(upd_slots),
+                jnp.asarray(upd_pages), jnp.asarray(clear), key)
         # commit the draft half's carry BEFORE the verify dispatch: the
         # old page_table buffer was donated to the draft program, and the
         # gen.verify fault site below must leave the engine re-entrant (a
@@ -816,6 +1255,12 @@ class GenerationEngine:
 
         def _dispatch_verify():
             _faults.fire("gen.verify")
+            if stochastic:
+                return self._verify_jit(
+                    self._params(), (self.page_table, self.pools),
+                    jnp.asarray(self.last_tokens), drafted, qdist,
+                    jnp.asarray(self.positions), jnp.asarray(self.done),
+                    jnp.asarray(room), key)
             return self._verify_jit(
                 self._params(), (self.page_table, self.pools),
                 jnp.asarray(self.last_tokens), drafted,
@@ -881,7 +1326,10 @@ class GenerationEngine:
         check. With ``bucket=`` the prefill program for that bucket length
         is audited instead (same donated cache). On a speculative engine,
         ``program="decode"`` audits the draft program (its decode-family
-        program) and ``program="verify"`` the verify pass.
+        program) and ``program="verify"`` the verify pass. On any paged
+        engine ``program="cow"`` audits the copy-on-write page-copy
+        program (prefix sharing / forks): carry-only inputs, 100%
+        donation, zero collectives.
 
         ``audit(...).memory`` is the buffer-liveness residency estimate:
         cache bytes appear under the ``kv_pages`` (paged) / ``kv_cache``
@@ -923,6 +1371,7 @@ class GenerationEngine:
                 bucket = self.bucket_for(bucket)
                 tokens = jnp.full((1, bucket), self.pad_id, jnp.int32)
                 new_row = jnp.zeros((self._n_row_pages,), jnp.int32)
+                start0 = jnp.zeros((1,), jnp.int32)
                 if self.speculative:
                     dparams = self._draft_params()
                     n_pre += len(jax.tree_util.tree_leaves(dparams))
@@ -930,12 +1379,28 @@ class GenerationEngine:
                     lowered = self._prefill_jit.lower(
                         params, dparams, carry, tokens,
                         jnp.asarray(0, jnp.int32),
-                        jnp.asarray(bucket, jnp.int32), new_row, key)
+                        jnp.asarray(bucket, jnp.int32), new_row, start0,
+                        key)
                 else:
                     carry = (self.page_table, self.pools)
                     lowered = self._prefill_jit.lower(
                         params, carry, tokens, jnp.asarray(0, jnp.int32),
-                        jnp.asarray(bucket, jnp.int32), new_row, key)
+                        jnp.asarray(bucket, jnp.int32), new_row, start0,
+                        key)
+            elif program == "cow":
+                # the copy-on-write page-copy program: no params at all —
+                # the donated carry's leaves lead the flat input order
+                if self._cow_jit is None:
+                    self._cow_jit = jax.jit(self._cow_copy_fn,
+                                            donate_argnums=(0,),
+                                            keep_unused=True)
+                n_pre = 0
+                vec = jnp.zeros((self._cow_width,), jnp.int32)
+                if self.speculative:
+                    carry = (self.page_table, self.pools, self.draft_pools)
+                else:
+                    carry = (self.page_table, self.pools)
+                lowered = self._cow_jit.lower(carry, vec, vec, vec, vec)
             elif program == "verify":
                 if not self.speculative:
                     raise ValueError("program='verify' needs a speculative "
@@ -944,9 +1409,17 @@ class GenerationEngine:
                 drafted = jnp.zeros((self.batch_size, self.speculate_k),
                                     jnp.int32)
                 room = jnp.zeros((self.batch_size,), jnp.int32)
-                lowered = self._verify_jit.lower(params, carry, toks,
-                                                 drafted, pos, done, room,
-                                                 key)
+                if self.sampling.stochastic:
+                    vocab = self._last_vocab()
+                    qd = jnp.zeros((self.batch_size, self.speculate_k,
+                                    vocab), jnp.float32)
+                    lowered = self._verify_jit.lower(params, carry, toks,
+                                                     drafted, qd, pos,
+                                                     done, room, key)
+                else:
+                    lowered = self._verify_jit.lower(params, carry, toks,
+                                                     drafted, pos, done,
+                                                     room, key)
             elif self.speculative:
                 dparams = self._draft_params()
                 n_pre = len(jax.tree_util.tree_leaves(dparams))
@@ -983,7 +1456,7 @@ class GenerationEngine:
             mem_cats[i] = "io"
         if program == "verify":
             default_cat = "verify_temp"
-        elif self.speculative and bucket is None:
+        elif self.speculative and bucket is None and program != "cow":
             default_cat = "draft_temp"
         else:
             default_cat = "activations"
@@ -1033,16 +1506,87 @@ class GenerationEngine:
                                                    band=band)
         return cap
 
+    def fork_slot(self, src: int, dst: int,
+                  resample_first: bool = False) -> int:
+        """Copy-on-write fork: row ``dst`` becomes a live clone of row
+        ``src`` sharing every page — a refcount bump per page, zero pool
+        bytes moved. Divergence is lazy: the first write either row makes
+        into a shared page triggers the page-granular copy program
+        (:meth:`_grow_pages`), so N forks of a P-page prompt cost P pages
+        total plus each fork's private suffix.
+
+        ``resample_first=True`` draws an independent first token from the
+        source row's prefill logits (N-way parallel sampling: fork right
+        after :meth:`prefill`, before any decode step — later forks would
+        re-sample a stale position). Returns ``dst``'s current last token.
+        """
+        if not self.paged:
+            raise RuntimeError("fork_slot needs a paged engine")
+        if src == dst or not (0 <= src < self.batch_size
+                              and 0 <= dst < self.batch_size):
+            raise ValueError(f"bad fork {src} -> {dst}")
+        if self.done[src] or not self._row_pages[src]:
+            raise RuntimeError(f"cannot fork finished/empty row {src}")
+        self._reclaim_row(dst)  # previous occupant's pages, if any
+        self._pending_clear.discard(dst)
+        self.page_exhausted[dst] = False
+        pages = list(self._row_pages[src])
+        for pid in pages:
+            self._page_rc[pid] += 1
+        self._row_pages[dst] = pages
+        row = np.zeros(self._n_row_pages, np.int32)
+        row[:len(pages)] = pages
+        # eager device-table install: forks happen at admission
+        # boundaries, not in the per-token hot loop
+        self.page_table = self.page_table.at[dst].set(jnp.asarray(row))
+        self.positions[dst] = self.positions[src]
+        tok = int(self.last_tokens[src])
+        if resample_first:
+            logits = self._prefill_logits.get(src)
+            if logits is None:
+                raise RuntimeError(f"row {src} has no prefill logits to "
+                                   "resample from")
+            tok = int(self._sample(logits[None, :], self._next_key())[0])
+            self._prefill_logits[dst] = logits
+        self.last_tokens[dst] = tok
+        self.done[dst] = (self.eos_id is not None and tok == self.eos_id)
+        self._page_gauges()
+        _obs.counter("gen_forks_total", "copy-on-write row forks").inc()
+        return tok
+
+    def cache_sequence(self, slot: int, tokens) -> int:
+        """Index a live row's computed pages under ``tokens`` (the
+        sequence the row holds K/V for: prompt + generated output) in the
+        radix prefix cache — the multi-turn session-resume hook: the
+        batcher calls this right before releasing a finished row, and the
+        next turn's prompt (history + new text) adopts the whole history
+        as a prefix hit. Only positions the row has actually written
+        (``positions[slot]``) and only full pages are indexed. Returns
+        the number of tokens now served from cache for this sequence."""
+        if not self.paged or self.prefix_cache is None:
+            return 0
+        n = min(len(tokens), int(self.positions[slot]))
+        if n < self.page_size:
+            return 0
+        for pid in self.prefix_cache.insert(list(tokens)[:n],
+                                            self._row_pages[slot]):
+            self._page_rc[pid] += 1
+        self._page_gauges()
+        return (n // self.page_size) * self.page_size
+
     def release_slot(self, slot: int) -> None:
         """Mark a row free (emits pad, frontier frozen) — the next prefill
-        into this slot overwrites it. In paged mode, the row's pages return
-        to the free pool and its device page-table row is cleared before
-        the next compiled step writes anything."""
+        into this slot overwrites it. In paged mode, the row's references
+        are dropped and only refcount-0 pages return to the free pool
+        (pages still backing a fork or the prefix cache stay allocated);
+        the row's device page-table row is cleared before the next
+        compiled step writes anything."""
         self.done[slot] = True
         self.last_tokens[slot] = self.pad_id
         if self.paged:
             self._reclaim_row(slot)
             self._pending_clear.add(slot)
+            self._prefill_logits.pop(slot, None)
 
     # -- convenience: whole-batch generation ---------------------------------
     def generate(self, prompts, max_new_tokens: int = 32) -> List[List[int]]:

@@ -21,7 +21,10 @@ Scheduling is one ``step()`` per tick:
   4. expire backlogged requests past their deadline;
   5. dispatch the backlog in priority-class order: session-affine
      requests go to the replica already holding their prefix pages
-     (while it is LIVE); everything else is placed by
+     (while it is LIVE) — sessionless requests get the same treatment
+     keyed by a hash of their first ``router_prefix_tokens`` prompt
+     tokens, so template-sharing traffic concentrates its radix
+     prefix-cache hits on one replica; everything else is placed by
      power-of-two-choices over the published
      ``free_pages - queue_depth - queue_age_p95`` score, and only onto
      replicas whose published queue depth is within
@@ -54,6 +57,7 @@ import json
 import os
 import random
 import time
+import zlib
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -83,6 +87,10 @@ class RouterRequest:
         self.max_new_tokens = int(max_new_tokens)
         self.priority = priority
         self.session = session
+        #: affinity-map key: the session id, or (sessionless) a hash of
+        #: the leading prompt tokens so template-sharing requests land on
+        #: the replica whose prefix cache already holds their pages
+        self.affinity_key: Optional[str] = session
         self.submit_t = float(now)
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.deadline_t = None if self.deadline_s is None \
@@ -129,6 +137,7 @@ class FleetRouter:
                  queue_bound: Optional[int] = None,
                  classes: Optional[Sequence[str]] = None,
                  affinity: Optional[bool] = None,
+                 prefix_tokens: Optional[int] = None,
                  seed: Optional[int] = None, clock=None, tracer=None):
         from .. import config
 
@@ -149,6 +158,8 @@ class FleetRouter:
             raise ValueError("router needs at least one priority class")
         self.affinity = bool(affinity if affinity is not None
                              else config.get("router_affinity"))
+        self.prefix_tokens = int(prefix_tokens if prefix_tokens is not None
+                                 else config.get("router_prefix_tokens"))
         self._rng = random.Random(int(seed if seed is not None
                                       else config.get("router_seed")))
         self.replicas: Dict[int, ServingReplica] = {}
@@ -186,6 +197,11 @@ class FleetRouter:
                              f"(configured: {self.classes})")
         req = RouterRequest(next(self._ids), prompt, max_new_tokens, cls,
                             session, deadline_s, self._clock())
+        if (session is None and self.affinity and self.prefix_tokens > 0
+                and len(req.prompt) >= self.prefix_tokens):
+            head = ",".join(str(int(t))
+                            for t in req.prompt[:self.prefix_tokens])
+            req.affinity_key = f"prefix:{zlib.crc32(head.encode()):08x}"
         self.requests.append(req)
         self._backlog[cls].append(req)
         _obs.counter("router_requests_total",
@@ -365,8 +381,8 @@ class FleetRouter:
     def _pick(self, rreq: RouterRequest, candidates: List[int],
               views: Dict[int, dict], added: Dict[int, int]
               ) -> Optional[int]:
-        if self.affinity and rreq.session is not None:
-            rid = self._sessions.get(rreq.session)
+        if self.affinity and rreq.affinity_key is not None:
+            rid = self._sessions.get(rreq.affinity_key)
             if rid is not None and rid in candidates:
                 return rid  # prefix pages live here; affinity wins
         if not candidates:
@@ -430,8 +446,8 @@ class FleetRouter:
                 _obs.counter("router_admissions_total",
                              "requests handed to a replica").inc(
                                  replica=str(rid))
-                if self.affinity and rreq.session is not None:
-                    self._sessions[rreq.session] = rid
+                if self.affinity and rreq.affinity_key is not None:
+                    self._sessions[rreq.affinity_key] = rid
 
     # -- telemetry -----------------------------------------------------------
     def publish(self, generation: int = 0) -> bool:

@@ -423,9 +423,16 @@ class TestSpeculative:
             _engine(net, speculate_k=4)  # draft_net missing
         with pytest.raises(ValueError):
             _engine(net, paged=False, draft_net=net, speculate_k=4)
+        # stochastic speculation is legal (rejection-sampling verify,
+        # tests/test_prefix_sharing.py) — only the degenerate
+        # temperature=0 non-greedy config is refused (residual undefined)
+        from mxnet_tpu.inference import SamplingConfig
+        assert _engine(net, draft_net=net, speculate_k=4,
+                       sampling="temperature").speculative
         with pytest.raises(ValueError):
             _engine(net, draft_net=net, speculate_k=4,
-                    sampling="temperature")
+                    sampling=SamplingConfig(method="temperature",
+                                            temperature=0.0))
         with pytest.raises(ValueError):
             _engine(net, num_pages=0)  # explicit 0 must not hit the default
 

@@ -3,7 +3,7 @@
 
 ``make shardcheck`` (sharding + comm), ``make memcheck`` (buffer
 liveness) and ``make schedcheck`` (critical path + overlap) all audit the
-same seven representative programs; this module owns their constructors
+same eight representative programs; this module owns their constructors
 so a family change can never drift between gates (ISSUE 13). Builders are
 memoized where two families audit the SAME object (the two fsdp families
 share one TrainStep — step vs window program — and the serving families
@@ -28,7 +28,7 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
 #: gate-facing family order (memcheck/schedcheck default ordering)
 FAMILY_NAMES = ("step_dp8", "step_fsdp", "window_fsdp", "prefill",
-                "decode", "decode_paged", "verify_spec")
+                "decode", "decode_paged", "verify_spec", "decode_prefix")
 
 
 def load():
@@ -157,6 +157,33 @@ def family_verify_spec():
     return _paged_engines()[1].audit(program="verify")
 
 
+@functools.lru_cache(maxsize=None)
+def _prefix_engine():
+    """A prefix-cache paged engine over the same tiny net — audited on
+    the copy-on-write page-copy program (prefix sharing, ISSUE 19)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.inference import GenerationEngine
+    from mxnet_tpu.models import gpt2
+
+    mx.random.seed(0)
+    net = gpt2.get_gpt2("gpt2_tiny", dropout=0.0, num_layers=2, units=32,
+                        num_heads=2, max_length=64, vocab_size=64)
+    net.initialize()
+    _ = net(nd.array(np.zeros((1, 4), np.int32)))
+    return GenerationEngine(net, batch_size=2, max_length=64,
+                            prefill_buckets=(8, 16), paged=True,
+                            page_size=16, prefix_cache=True)
+
+
+def family_decode_prefix():
+    """The CoW page-copy program behind prefix sharing: carry-only
+    inputs, 100% donation, zero collectives — same serving contract."""
+    return _prefix_engine().audit(program="cow")
+
+
 FAMILIES = {
     "step_dp8": family_step_dp8,
     "step_fsdp": family_step_fsdp,
@@ -165,4 +192,5 @@ FAMILIES = {
     "prefill": family_prefill,
     "decode_paged": family_decode_paged,
     "verify_spec": family_verify_spec,
+    "decode_prefix": family_decode_prefix,
 }

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """A/B gates for compiled KV-cache generation (`make genbench`).
 
-Three gated sections on a tiny GPT-2 (CPU, greedy, identical token
+Four gated sections on a tiny GPT-2 (CPU, greedy, identical token
 streams required everywhere):
 
   1. **cached vs naive** — the engine's bucketed prefill + single compiled
@@ -24,13 +24,21 @@ streams required everywhere):
      --min-spec-speedup amortized tokens/sec over the paged
      non-speculative engine on the same prompts, tokens identical, and
      exactly (buckets used + 1 decode + 1 verify) programs.
+  4. **prefix sharing** (docs/INFERENCE.md "Prefix sharing") — radix
+     prefix-cache hits against cold prefill. Gates: fully-cached TTFT
+     <= 0.5x cold at the longest bucket and dropping monotonically with
+     shared-prefix length; greedy tokens bit-identical to the no-cache
+     path; M sharers of a P-page prefix hold P + M*suffix pool pages
+     (auditor-attributed ``kv_pages`` bytes), not M*(P + suffix); zero
+     ``free_pages`` admission rejects on a fully-cached prompt.
 
 Methodology mirrors ``make perfwin``: warm both sides first (compiles out
 of the timed region), then alternate A/B measurement pairs and take the
 MEDIAN per-pair speedup, so background load hits both sides of a pair
 equally.
 
-Artifact: ``GENBENCH_r02.json`` (committed).
+Artifact: ``GENBENCH_$(GENBENCH_ROUND).json`` (committed; r04 added the
+prefix section — earlier rounds stay untouched).
 """
 from __future__ import annotations
 
@@ -267,6 +275,192 @@ def section_paged_vs_dense(args, fails):
     return row
 
 
+def section_prefix(args, fails):
+    """Prefix sharing (ISSUE 19): radix-cache hits cut TTFT ~linearly
+    with shared-prefix length, tokens stay bit-identical to the no-cache
+    path, and M sharers of a P-page prefix hold P + M*suffix pool pages
+    (auditor-verified bytes), not M*(P + suffix)."""
+    import numpy as np
+
+    from mxnet_tpu.inference import ContinuousBatcher, GenerationEngine
+    from mxnet_tpu.observability import REGISTRY
+
+    def _counter(name, **labels):
+        c = REGISTRY.get(name)
+        if c is None:
+            return 0
+        return c.value(**labels) if labels else c.total()
+
+    # a deeper net than the other sections: TTFT here must be dominated
+    # by prefill compute, not per-dispatch overhead, for the hit-vs-cold
+    # ratio to measure what production would see
+    seq_cap = 256  # longer than the other sections: the cold side
+    #                must be compute-dominated for the ratio to measure
+    #                what production sees, not per-dispatch overhead
+    net = build_net(args.vocab, seq_cap, num_layers=4, units=192)
+    ps = 8
+    buckets = (8, 64, 128, 192, 248)
+    base_len = 244  # NOT page-aligned: a full-prefix hit adopts every
+    #                 full page and prefills only the 4-token tail
+    eng = GenerationEngine(net, batch_size=4, max_length=seq_cap,
+                           prefill_buckets=buckets, eos_id=None,
+                           paged=True, page_size=ps, num_pages=320,
+                           prefix_cache=True)
+    ctrl = GenerationEngine(net, batch_size=1, max_length=seq_cap,
+                            prefill_buckets=buckets, eos_id=None,
+                            paged=True, page_size=ps)
+    rs = np.random.RandomState(31)
+    base = [int(t) for t in rs.randint(1, args.vocab, base_len)]
+
+    # -- bit-identity: cold prefill, cached-hit prefill and the no-cache
+    #    engine must emit the same greedy stream
+    out_cold = eng.generate([base], max_new_tokens=8)[0]    # seeds the cache
+    out_hit = eng.generate([base], max_new_tokens=8)[0]     # full-prefix hit
+    out_ctrl = ctrl.generate([base], max_new_tokens=8)[0]
+    hits0 = _counter("gen_prefix_hits_total")
+    if not (out_cold == out_hit == out_ctrl):
+        fails.append("prefix: greedy tokens diverge between cold prefill, "
+                     "cached-hit prefill and the no-cache engine")
+    if hits0 < 1:
+        fails.append("prefix: the repeated prompt never hit the radix cache")
+
+    # -- TTFT vs shared-prefix length: probes share s tokens with the
+    #    cached base and carry a FRESH random suffix (so reps never
+    #    accidentally find their own suffix cached); each share lands on
+    #    a successively smaller suffix bucket
+    shares = [0, 64, 128, 192, base_len]
+
+    def probe(s):
+        if s == base_len:
+            return list(base)
+        tail = [int(t) for t in rs.randint(1, args.vocab, base_len - s)]
+        return base[:s] + tail
+
+    for s in shares:  # warm every bucket out of the timed region
+        eng.prefill(probe(s), 0)
+        eng.release_slot(0)
+    ttft_ms = {}
+    for s in shares:
+        reps = []
+        for _ in range(max(args.pairs, 5)):
+            p = probe(s)
+            t0 = time.perf_counter()
+            eng.prefill(p, 0)
+            reps.append(time.perf_counter() - t0)
+            eng.release_slot(0)
+        ttft_ms[s] = statistics.median(reps) * 1e3
+    cold_ms, full_ms = ttft_ms[0], ttft_ms[base_len]
+    ratio = full_ms / cold_ms if cold_ms else float("inf")
+    if ratio > 0.5:
+        fails.append(f"prefix: fully-cached TTFT {full_ms:.2f}ms is "
+                     f"{ratio:.2f}x cold prefill {cold_ms:.2f}ms at the "
+                     "longest bucket, gate needs <= 0.5x")
+    for a, b in zip(shares, shares[1:]):
+        if ttft_ms[b] > ttft_ms[a] * 1.15:
+            fails.append(f"prefix: TTFT rose from {ttft_ms[a]:.2f}ms at "
+                         f"{a} shared tokens to {ttft_ms[b]:.2f}ms at {b} "
+                         "— not dropping with shared-prefix length")
+
+    # -- copy-on-write tail adoption: a fully-cached page-aligned prompt
+    #    must still compute its last-token logits, so the engine adopts
+    #    the final cached page by page-granular copy (the row's suffix
+    #    write may not touch the shared page); tokens must still match
+    cow0 = _counter("gen_cow_copies_total")
+    p_mid = base[:56]  # 7 full pages, all cached
+    tok_mid = eng.prefill(p_mid, 0)
+    eng.release_slot(0)
+    cow_delta = _counter("gen_cow_copies_total") - cow0
+    if cow_delta < 1:
+        fails.append("prefix: aligned full-prefix adoption dispatched no "
+                     "copy-on-write page copy")
+    if [tok_mid] != ctrl.generate([p_mid], max_new_tokens=1)[0]:
+        fails.append("prefix: CoW tail adoption changed the first greedy "
+                     "token vs the no-cache engine")
+
+    # -- M sharers of a P-page prefix: the pool holds P + M*suffix pages
+    pre_pages = 16
+    shared = base[:pre_pages * ps]
+    m = 3
+    rows = []
+    for slot in range(m):
+        suffix = [int(t) for t in rs.randint(1, args.vocab,
+                                             base_len - pre_pages * ps)]
+        eng.prefill(shared + suffix, slot)
+        rows.append(list(eng._row_pages[slot]))
+    distinct = len(set(p for r in rows for p in r))
+    suffix_pages = len(rows[0]) - pre_pages
+    want = pre_pages + m * suffix_pages
+    naive = m * (pre_pages + suffix_pages)
+    pool = eng.audit().memory.by_category.get("kv_pages", 0)
+    per_page = pool / (eng.num_pages + 1)  # +1: the trash page
+    for slot in range(m):
+        eng.release_slot(slot)
+    if distinct != want:
+        fails.append(f"prefix: {m} sharers of a {pre_pages}-page prefix "
+                     f"hold {distinct} distinct pool pages, want {want} "
+                     f"(naive copying would take {naive})")
+
+    # -- admission accounting: a fully-cached prompt admits on suffix
+    #    pages alone — reason=free_pages must NOT fire. Sized so the old
+    #    whole-prompt pricing WOULD defer: at the boundary only 1 page is
+    #    free, the cached prompt needs 2 cold but 1 after adoption
+    adm = GenerationEngine(net, batch_size=3, max_length=args.max_length,
+                           prefill_buckets=(16, 32, 48), eos_id=None,
+                           paged=True, page_size=16, num_pages=9,
+                           prefix_cache=True)
+    bat = ContinuousBatcher(adm)
+    seed_p = [int(t) for t in rs.randint(1, args.vocab, 32)]
+    first = bat.submit(seed_p, max_new_tokens=2)
+    while bat.step():
+        pass
+    rej0 = _counter("gen_admission_rejects_total", reason="free_pages")
+    holders = [bat.submit([int(t) for t in rs.randint(1, args.vocab, 40)],
+                          max_new_tokens=8) for _ in range(2)]
+    again = bat.submit(seed_p, max_new_tokens=2)
+    while bat.step():
+        pass
+    rejects = _counter("gen_admission_rejects_total",
+                       reason="free_pages") - rej0
+    if rejects:
+        fails.append(f"prefix: {rejects} free_pages admission rejects on a "
+                     "fully-cached prompt — admission still prices the "
+                     "whole prompt, not the suffix")
+    if not all(h.finish_reason == "length" for h in holders):
+        fails.append("prefix: page holders did not finish cleanly in the "
+                     "admission scenario")
+    if again.result() != first.result():
+        fails.append("prefix: cached re-serve of the same prompt changed "
+                     "its greedy tokens")
+
+    row = {
+        "model": "gpt2-tiny-cfg(4x192x2h)",
+        "page_size": ps,
+        "prefill_buckets": list(buckets),
+        "ttft_ms_by_shared_tokens": {str(s): round(v, 3)
+                                     for s, v in ttft_ms.items()},
+        "full_hit_ttft_ratio": round(ratio, 3),
+        "tokens_identical": out_cold == out_hit == out_ctrl,
+        "prefix_hits_total": int(_counter("gen_prefix_hits_total")),
+        "prefix_hit_tokens": int(_counter("gen_prefix_hit_tokens")),
+        "cow_copies_total": int(_counter("gen_cow_copies_total")),
+        "sharers": m,
+        "prefix_pages": pre_pages,
+        "suffix_pages_each": suffix_pages,
+        "pool_pages_shared": distinct,
+        "pool_pages_naive": naive,
+        "pool_bytes_shared": round(distinct * per_page),
+        "pool_bytes_naive": round(naive * per_page),
+        "pool_bytes_source": "MemoryReport.by_category kv_pages (auditor)",
+        "fully_cached_free_pages_rejects": int(rejects),
+        "compiled_programs": eng.compiled_programs,
+    }
+    # 5 prefill buckets + 1 decode + 1 CoW copy — no hidden recompiles
+    if eng.compiled_programs != 7:
+        fails.append(f"prefix: engine lowered {eng.compiled_programs} "
+                     "programs, expected 7 (5 buckets + decode + cow)")
+    return row
+
+
 def section_spec_vs_paged(args, fails):
     import numpy as np
 
@@ -353,7 +547,7 @@ def main():
     ap.add_argument("--speculate-k", type=int, default=6)
     ap.add_argument("--min-spec-speedup", type=float, default=1.5)
     ap.add_argument("--section", action="append",
-                    choices=["cached", "paged", "spec"],
+                    choices=["cached", "paged", "spec", "prefix"],
                     help="restrict to named sections (repeatable)")
     ap.add_argument("--out", default="GENBENCH_r02.json")
     args = ap.parse_args()
@@ -363,7 +557,7 @@ def main():
     jax.config.update("jax_platforms", "cpu")
 
     fails: list = []
-    sections = args.section or ["cached", "paged", "spec"]
+    sections = args.section or ["cached", "paged", "spec", "prefix"]
     row = {
         "ts": _utc(),
         "bench": "genbench",
@@ -379,6 +573,8 @@ def main():
         row["paged_vs_dense"] = section_paged_vs_dense(args, fails)
     if "spec" in sections:
         row["spec_vs_paged"] = section_spec_vs_paged(args, fails)
+    if "prefix" in sections:
+        row["prefix"] = section_prefix(args, fails)
     row["ok"] = not fails
     if fails:
         row["failures"] = fails
@@ -405,6 +601,11 @@ def main():
         s = row["spec_vs_paged"]
         bits.append(f"speculative {s['speedup_median_of_pairs']}x at "
                     f"accept {s['accept_rate']}")
+    if "prefix" in row:
+        x = row["prefix"]
+        bits.append(f"prefix hit ttft {x['full_hit_ttft_ratio']}x cold, "
+                    f"{x['sharers']} sharers on {x['pool_pages_shared']} "
+                    f"pages (naive {x['pool_pages_naive']})")
     print("OK: " + "; ".join(bits))
     return 0
 

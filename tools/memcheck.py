@@ -194,7 +194,7 @@ def validate(fails, notes):
 # reads the page table in-kernel; this asserts the gather can never
 # silently come back, independent of what the goldens say — it applies
 # even while reblessing)
-GATHER_FREE_FAMILIES = ("decode_paged", "verify_spec")
+GATHER_FREE_FAMILIES = ("decode_paged", "verify_spec", "decode_prefix")
 
 
 def assert_gather_free(name: str, cur: dict, fails: list):
