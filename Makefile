@@ -56,7 +56,7 @@ PY ?= python
 # 3-attempt retry policy can never see an injected failure twice in a row.
 CHAOS_FAULTS ?= ckpt.save:every=3;ckpt.load:every=3;kv.save_states:every=2;kv.load_states:every=3;kv.dcn_psum:every=4;kv.dcn_psum_batch:every=4;data.batch:every=7;seed=1234
 
-.PHONY: ci sanity lint audit shardcheck memcheck schedcheck profcheck kernelcheck native fast slow test chaos chaos-elastic chaos-serve chaos-fleet obs obsfleet perfwin genbench ampbench bench clean
+.PHONY: ci sanity lint audit shardcheck memcheck schedcheck profcheck kernelcheck native fast slow test chaos chaos-elastic chaos-serve chaos-fleet obs obsfleet perfwin multichip genbench ampbench bench clean
 
 ci: sanity lint native fast audit shardcheck memcheck schedcheck profcheck kernelcheck chaos-elastic chaos-serve chaos-fleet obsfleet
 
@@ -194,6 +194,14 @@ obsfleet: native
 # the single-step path; artifact committed as BENCH_r06.json
 perfwin: native
 	$(PY) tools/benchall.py --window 4 --out BENCH_r06.json
+
+# async-collective overlap artifact (docs/PARALLELISM.md "Hiding
+# collective time"): the mesh families priced sync vs through the
+# asyncify pass — per-axis comm bytes + critical-path/overlap deltas;
+# fails unless every family beats the 0.0 sync baseline. Committed as
+# MULTICHIP_r06.json
+multichip: native
+	$(PY) tools/benchall.py --overlap --out MULTICHIP_r06.json
 
 # compiled-generation gates (docs/INFERENCE.md), tiny GPT-2, CPU, median
 # of alternating A/B pairs, identical greedy tokens required everywhere:

@@ -48,6 +48,11 @@ from .schedule import (  # noqa: F401
     SerializationPoint,
     schedule_report,
 )
+from .overlap import (  # noqa: F401
+    ASYNCABLE_OPS,
+    OverlapStats,
+    asyncify,
+)
 from .comm import (  # noqa: F401
     CollectiveCost,
     CommReport,
@@ -78,6 +83,7 @@ __all__ = [
     "jax_expected_peak", "VALIDATION_TOLERANCE",
     "ScheduleReport", "CollectiveSpan", "SerializationPoint",
     "schedule_report",
+    "ASYNCABLE_OPS", "OverlapStats", "asyncify",
     "CollectiveCost", "CommReport", "Reshard", "comm_report",
     "detect_accidental_reshards",
     "ContractViolation", "check_contract", "expected_tiles",

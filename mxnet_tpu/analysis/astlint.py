@@ -95,9 +95,9 @@ RULES: Dict[str, str] = {
              "device futures and materialize once after the loop",
 }
 
-#: the MeshConfig axis vocabulary (mirror of parallel.mesh.AXES — kept
-#: literal so the linter stays stdlib-only; tests/test_analysis.py pins
-#: the two in sync)
+#: the mesh-axis vocabulary (mirror of parallel.layout.AXES, the
+#: declarative layout spec that owns it — kept literal so the linter
+#: stays stdlib-only; tests/test_analysis.py pins the two in sync)
 _MESH_AXES = frozenset({"dp", "fsdp", "tp", "sp", "pp", "ep"})
 
 # JH006: call names that take PartitionSpec axis-name strings. `P` is the

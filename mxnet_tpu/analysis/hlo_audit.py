@@ -994,6 +994,10 @@ class ProgramAudit:
     # static schedule model (analysis.schedule.ScheduleReport): critical
     # path, exposed vs hidden collective time, overlap fraction, MFU bound
     schedule: Optional[object] = None
+    # what the asyncify pass did (analysis.overlap.OverlapStats): async
+    # start→done pairs created in the audited program, None when the
+    # layout's overlap policy is off (schedule model stays sync)
+    overlap: Optional[object] = None
 
     def carry_donation(self) -> float:
         """Donation coverage of the carry (params/opt-state for TrainStep,
@@ -1020,6 +1024,11 @@ class ProgramAudit:
             out["memory"] = self.memory.summary()
         if self.schedule is not None:
             out["schedule"] = self.schedule.summary()
+        if self.overlap is not None:
+            out["overlap"] = {
+                "async_pairs": self.overlap.async_pairs,
+                "deferred": self.overlap.deferred,
+                "per_computation": dict(self.overlap.per_computation)}
         return out
 
 

@@ -1,0 +1,202 @@
+"""Async-collective overlap modeling: the ``asyncify`` pass
+(docs/PARALLELISM.md "Hiding collective time", docs/ANALYSIS.md
+"Schedule & overlap").
+
+The schedule model (:mod:`.schedule`) prices overlap from the program
+text: compute placed between an async collective's ``-start`` and
+``-done`` hides it. That is exactly right on TPU, where XLA's async
+collective creator splits every collective into a start/done pair and
+the latency-hiding scheduler moves independent compute into the span.
+The CPU backend this repo audits on does neither: it emits only
+synchronous collectives and places each one directly before its first
+consumer, so every mesh family's overlap golden pinned 0.0 — not
+because the *program* lacks schedulable independence, but because the
+auditing backend never exercises it (arXiv:2301.13062 documents why the
+fusion-era compiler won't restructure this for you; arXiv:2004.13336 is
+the sharded-weight-update schedule being modeled).
+
+This pass closes that gap honestly, from the dependency structure
+alone. For each computation it list-schedules the ValueDef def/use DAG
+the same way XLA's latency-hiding scheduler does:
+
+  - an eligible collective is *issued* as soon as its operands are
+    available (its original position — operand order is preserved);
+  - its consumers are held back behind a synthetic ``*_done`` node, so
+    every node that does NOT depend on the collective's result keeps
+    emitting between start and done — that is precisely the compute a
+    real async backend can run during the transfer;
+  - a done is emitted only when nothing independent is left to emit
+    (oldest in-flight collective first), or at computation end for
+    results nothing consumes before the return.
+
+The output is a derived :class:`ProgramReport` whose values lists
+contain literal start→done pairs — the downstream scheduler needs no
+new math: its existing span accounting prices the rescheduled text and
+``hidden + exposed == total`` holds per span by construction. Only the
+schedule model consumes the derived report; the memory/contract/comm
+passes keep auditing the real backend text.
+
+Gating: :meth:`TrainStep.audit` applies the pass when its
+:class:`~mxnet_tpu.parallel.layout.Layout` declares ``overlap=True``
+(the default for mesh layouts — TPU collectives are async by default),
+and ``tools/schedcheck.py`` pins the resulting overlap fraction per
+golden family so the win can never silently regress.
+"""
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from typing import Dict, List, Sequence, Tuple
+
+from .hlo_audit import ProgramReport, ValueDef
+
+__all__ = ["ASYNCABLE_OPS", "OverlapStats", "asyncify"]
+
+#: collective kinds with an async ``*_done`` spelling in the audited
+#: dialects — the ops the pass may split into start/done pairs.
+#: (``reduce_scatter`` is absent: the CPU partitioner lowers ZeRO grad
+#: reductions to all_reduce + dynamic-slice, and real TPU text arrives
+#: with XLA's own pairs already split.)
+_DONE_OP = {
+    "all_reduce": "all_reduce_done",
+    "all_gather": "all_gather_done",
+    "collective_permute": "collective_permute_done",
+    "all_to_all": "all_to_all_done",
+}
+ASYNCABLE_OPS = frozenset(_DONE_OP)
+
+#: suffix appended to a collective's SSA id to name its synthetic done
+#: value (plain vids never contain ``;``, so the pair can't collide)
+_DONE_SUFFIX = ";done"
+
+
+@dataclasses.dataclass
+class OverlapStats:
+    """What the pass did: start→done pairs created, and how many of them
+    actually gained schedulable compute inside the span (a pair whose
+    done lands directly after its start models a collective with no
+    independent work available — it stays effectively exposed)."""
+
+    async_pairs: int = 0
+    deferred: int = 0
+    per_computation: Dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def describe(self) -> str:
+        return (f"{self.async_pairs} async pair(s), "
+                f"{self.deferred} with compute scheduled inside the span")
+
+
+def _done_value(start: ValueDef) -> ValueDef:
+    """The synthetic ``*_done`` half: same allocation (its result IS the
+    collective's result — consumers read it), priced by the scheduler's
+    pass-1 rebind off the start's line, never as compute."""
+    return ValueDef(vid=start.vid + _DONE_SUFFIX,
+                    op=_DONE_OP[start.op],
+                    bytes=start.bytes,
+                    results=start.results,
+                    uses=(start.vid,),
+                    line=start.line)
+
+
+def _asyncify_values(values: Sequence[ValueDef]
+                     ) -> Tuple[List[ValueDef], int, int]:
+    """List-schedule one computation: returns (new values, pairs,
+    deferred-pairs). Emission order is a topological order of the
+    original def/use DAG with original text position as the priority, so
+    a program with no eligible collectives round-trips unchanged."""
+    n = len(values)
+    by_vid: Dict[str, int] = {}
+    for i, v in enumerate(values):
+        if v.vid and v.vid not in by_vid:
+            by_vid[v.vid] = i
+    deps: List[set] = [set() for _ in range(n)]
+    cons: List[List[int]] = [[] for _ in range(n)]
+    for i, v in enumerate(values):
+        for u in v.uses:
+            p = by_vid.get(u)
+            if p is not None and p < i:
+                if p not in deps[i]:
+                    deps[i].add(p)
+                    cons[p].append(i)
+    eligible = {i for i, v in enumerate(values)
+                if v.op in _DONE_OP and v.vid}
+    if not eligible:
+        return list(values), 0, 0
+
+    done_vid = {values[i].vid: values[i].vid + _DONE_SUFFIX
+                for i in eligible}
+    indeg = [len(deps[i]) for i in range(n)]
+    ready = [i for i in range(n) if indeg[i] == 0]
+    heapq.heapify(ready)
+    out: List[ValueDef] = []
+    in_flight: List[int] = []       # emitted starts, done still pending
+    start_pos: Dict[int, int] = {}  # start idx -> position in `out`
+    pairs = deferred = 0
+
+    def release(p: int) -> None:
+        for c in cons[p]:
+            indeg[c] -= 1
+            if indeg[c] == 0:
+                heapq.heappush(ready, c)
+
+    def emit_done(p: int) -> None:
+        nonlocal deferred
+        # any non-free emission between start and done is hidden compute
+        if len(out) > start_pos[p] + 1:
+            deferred += 1
+        out.append(_done_value(values[p]))
+        release(p)
+
+    emitted = 0
+    while emitted < n:
+        if not ready:
+            # everything unemitted waits on an in-flight done (original
+            # order is a valid topological order, so no other stall is
+            # possible): complete the oldest issue first, FIFO
+            emit_done(in_flight.pop(0))
+            continue
+        i = heapq.heappop(ready)
+        v = values[i]
+        if any(u in done_vid for u in v.uses):
+            v = dataclasses.replace(
+                v, uses=tuple(done_vid.get(u, u) for u in v.uses))
+        out.append(v)
+        emitted += 1
+        if i in eligible:
+            in_flight.append(i)
+            start_pos[i] = len(out) - 1
+            pairs += 1
+        else:
+            release(i)
+    while in_flight:  # results consumed only by the return line, if at all
+        emit_done(in_flight.pop(0))
+    return out, pairs, deferred
+
+
+def asyncify(report: ProgramReport) -> Tuple[ProgramReport, OverlapStats]:
+    """Derive the async-modeled view of ``report``: every eligible
+    collective in the entry computation and in every control-flow
+    subcomputation (``while`` bodies carry the window's collectives)
+    becomes a start→done pair with independent compute rescheduled into
+    the span. The input report is not mutated; hand the derived one to
+    :func:`~mxnet_tpu.analysis.schedule.schedule_report` (its ``comm=``
+    pricing is line-keyed and applies to both views unchanged)."""
+    stats = OverlapStats()
+    entry, pairs, deferred = _asyncify_values(report.values)
+    if pairs:
+        stats.per_computation["<entry>"] = pairs
+    stats.async_pairs += pairs
+    stats.deferred += deferred
+    subs = dict(report.subcomputations)
+    for name, values in subs.items():
+        if not any(v.op in _DONE_OP and v.vid for v in values):
+            continue  # fusion bodies and collective-free callees
+        new_values, pairs, deferred = _asyncify_values(values)
+        subs[name] = new_values
+        stats.async_pairs += pairs
+        stats.deferred += deferred
+        stats.per_computation[name] = pairs
+    if not stats.async_pairs:
+        return report, stats
+    return dataclasses.replace(report, values=entry,
+                               subcomputations=subs), stats

@@ -54,7 +54,8 @@ from .resilience import faults, integrity, retry
 from .resilience.integrity import CheckpointCorruptError  # noqa: F401  (re-export)
 
 __all__ = ["save_train_state", "load_train_state", "latest_checkpoint",
-           "validate_checkpoint", "CheckpointCorruptError"]
+           "validate_checkpoint", "checkpoint_layout",
+           "CheckpointCorruptError"]
 
 logger = logging.getLogger("mxnet_tpu.checkpoint")
 
@@ -133,13 +134,21 @@ def _local_shards(a, leader: bool, nproc: int):
 def save_train_state(directory: str, step: int, params, opt_state,
                      extra: Optional[dict] = None,
                      keep_last: Optional[int] = None,
-                     sharded: Optional[bool] = None) -> str:
+                     sharded: Optional[bool] = None,
+                     layout: Optional[dict] = None) -> str:
     """Write checkpoint ``directory/ckpt-{step}``; returns the path.
 
     The write is crash-safe: all payload lands in ``ckpt-{step}.tmp`` and
     one ``os.replace`` publishes it. ``keep_last`` (default: the
     ``ckpt_keep_last`` config knob; 0 = keep all) prunes older committed
     checkpoints after a successful commit.
+
+    ``layout`` (a :meth:`Layout.to_dict` record) is stored in the
+    manifest's ``layout`` key: the checkpoint *declares* the parallelism
+    spec that produced it, and the restore side validates the declared
+    layout against the current one (model axes + rules must match; data
+    axes are free — that is the elastic contract) instead of inferring
+    compatibility from shard shapes.
 
     Format selection: orbax when opted in; else the world-size-agnostic
     ``npz-shards`` layout when this is a multi-process run, any leaf is
@@ -165,10 +174,10 @@ def save_train_state(directory: str, step: int, params, opt_state,
 
     t0 = time.perf_counter()
     if ocp is None and (nproc > 1 or sharded or not hashable):
-        _save_sharded(path, tmp, step, flat, treedef, extra, nproc)
+        _save_sharded(path, tmp, step, flat, treedef, extra, nproc, layout)
     else:
         _save_flat(path, tmp, step, state, flat, treedef, extra, ocp,
-                   hashable)
+                   hashable, layout)
     dt = time.perf_counter() - t0
     # checkpoint IO is rare — record telemetry unconditionally so retention
     # and duration trends exist even when full telemetry is off
@@ -189,7 +198,8 @@ def save_train_state(directory: str, step: int, params, opt_state,
     return path
 
 
-def _save_flat(path, tmp, step, state, flat, treedef, extra, ocp, hashable):
+def _save_flat(path, tmp, step, state, flat, treedef, extra, ocp, hashable,
+               layout=None):
     """Single-controller formats: orbax, or whole-array flat npz."""
     import jax
 
@@ -223,6 +233,8 @@ def _save_flat(path, tmp, step, state, flat, treedef, extra, ocp, hashable):
         faults.fire("ckpt.save")
         manifest = integrity.build_manifest(host_flat, fmt, tmp,
                                             payload_files, specs=specs)
+        if layout is not None:
+            manifest["layout"] = layout
         integrity.write_manifest(tmp, manifest)
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump({"step": step, "world_size": jax.process_count(),
@@ -234,7 +246,7 @@ def _save_flat(path, tmp, step, state, flat, treedef, extra, ocp, hashable):
     retry.retry_call(_write, site="ckpt.save")
 
 
-def _save_sharded(path, tmp, step, flat, treedef, extra, nproc):
+def _save_sharded(path, tmp, step, flat, treedef, extra, nproc, layout=None):
     """World-size-agnostic ``npz-shards`` save (collective when nproc>1).
 
     Every host stages ``shards-h{pid}.npz`` (its ``replica_id==0`` shards)
@@ -289,6 +301,8 @@ def _save_sharded(path, tmp, step, flat, treedef, extra, nproc):
         _barrier("ckpt.save.shards")  # every host's shards have landed
         if leader:
             manifest = _merge_shard_sidecars(tmp)
+            if layout is not None:
+                manifest["layout"] = layout
             integrity.write_manifest(tmp, manifest)
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump({"step": step, "world_size": nproc,
@@ -499,6 +513,17 @@ def load_train_state(path: str, like=None):
     _obs.emit("checkpoint_restore", path=path, ckpt_step=meta["step"],
               seconds=round(dt, 6), verify_seconds=round(verify_dt, 6))
     return state["params"], state["opt_state"], meta["step"]
+
+
+def checkpoint_layout(path: str) -> Optional[dict]:
+    """The parallelism-layout record a checkpoint declared at save time
+    (``Layout.to_dict`` form), or None for layout-less/legacy checkpoints.
+    Cheap: reads the manifest only, no array payload."""
+    try:
+        mf = integrity.read_manifest(path)
+    except (OSError, ValueError):
+        return None
+    return (mf or {}).get("layout")
 
 
 def validate_checkpoint(path: str) -> bool:

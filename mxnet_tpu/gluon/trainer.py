@@ -178,7 +178,8 @@ class Trainer:
 
     # -- fused multi-step training (docs/PERFORMANCE.md) ---------------------
     def run(self, net, loss_fn, data_iter, steps=None, window=None,
-            accum=None, mesh=None, rules=None, n_model_inputs=1, amp="auto"):
+            accum=None, mesh=None, rules=None, layout=None, n_model_inputs=1,
+            amp="auto"):
         """Compiled k-step training windows over this trainer's optimizer.
 
         Builds (and caches) a :class:`~mxnet_tpu.parallel.TrainStep` for
@@ -190,28 +191,48 @@ class Trainer:
         and this trainer's per-parameter states are refreshed, so
         imperative ``step()`` and fused ``run()`` can be interleaved.
 
+        Parallelism comes in either as a declarative ``layout=``
+        (:class:`~mxnet_tpu.parallel.Layout`, preferred) or as the legacy
+        ``mesh=``/``rules=`` pair; the cache key for the fused TrainStep
+        is the layout's *canonical serialization*, so two equivalent specs
+        (however constructed) share one compiled program instead of
+        recompiling.
+
         Returns the stacked per-step losses (device future).
         """
         import jax
         import jax.numpy as jnp
 
+        from ..parallel.layout import Layout
         from ..parallel.train_step import TrainStep
 
         from ..contrib.amp import resolve_policy
 
+        if layout is not None and (mesh is not None or rules is not None):
+            raise ValueError("pass layout= or mesh=/rules=, not both")
         ts = None
         # resolve the amp policy up front so the cache key distinguishes
         # "auto" resolved under different global amp.init states
         policy = resolve_policy(amp)
-        sig = (net, loss_fn, mesh, rules, n_model_inputs, policy)
+        # key on the canonical layout string where one exists: equivalent
+        # specs — the same Layout rebuilt, or a mesh/rules pair that
+        # bridges to it — must hit the same cached TrainStep. Meshes
+        # outside the layout vocabulary fall back to identity keying.
+        par_key = layout.canonical() if layout is not None else None
+        if par_key is None and mesh is not None:
+            try:
+                par_key = Layout.from_mesh(mesh, rules).canonical()
+            except ValueError:
+                par_key = (mesh, rules)
+        sig = (net, loss_fn, par_key, n_model_inputs, policy)
         if self._fused is not None and len(self._fused[0]) == len(sig) and all(
                 a is b or a == b for a, b in zip(self._fused[0], sig)):
             ts = self._fused[1]
         if ts is None:
             self._ensure_states()
             ts = TrainStep(net, loss_fn, self._optimizer, mesh=mesh,
-                           rules=rules, n_model_inputs=n_model_inputs,
-                           amp=policy)
+                           rules=rules, layout=layout,
+                           n_model_inputs=n_model_inputs, amp=policy)
             self._fused = (sig, ts)
         # re-seed the fused side from the imperative state EVERY call:
         # interleaved step()s replace p._nd._data and self._states, and a

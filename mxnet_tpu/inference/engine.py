@@ -148,6 +148,12 @@ class GenerationEngine:
     prefix_cache : index computed prefixes in a radix tree so later
         prompts sharing them skip recompute (requires ``paged=True``;
         docs/INFERENCE.md "Prefix sharing").
+    layout : optional :class:`~mxnet_tpu.parallel.Layout` — the same
+        declarative spec that drives training places the serving weights:
+        each parameter is laid out per the layout's rules on the layout's
+        mesh before any program compiles. Serving programs themselves stay
+        single-program (no pp/ep dispatch loop yet); a layout whose total
+        is 1 (or None) keeps today's replicated placement.
     """
 
     def __init__(self, net, batch_size: int = 4, max_length: Optional[int] = None,
@@ -157,7 +163,7 @@ class GenerationEngine:
                  paged: bool = False, page_size: int = 16,
                  num_pages: Optional[int] = None,
                  draft_net=None, speculate_k: int = 0,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False, layout=None):
         self.net = net
         self.batch_size = int(batch_size)
         self.max_length = int(max_length or net._max_length)
@@ -180,6 +186,21 @@ class GenerationEngine:
             if p._nd is None:
                 raise ValueError(f"parameter {p.name} not initialized; run "
                                  "one forward pass first")
+
+        #: declarative parallelism spec (docs/PARALLELISM.md). Weight
+        #: placement only: the layout's rules decide each parameter's
+        #: sharding on the layout's mesh, so the spec that trained a model
+        #: is the spec that serves it — no separate serving placement code.
+        self.layout = layout
+        if layout is not None and layout.total > 1:
+            from jax.sharding import NamedSharding
+
+            mesh = layout.mesh()
+            for p in self._plist:
+                d = p._nd._data
+                p._nd._data = jax.device_put(
+                    d, NamedSharding(mesh,
+                                     layout.spec_for(p.name, d.shape, mesh)))
 
         # -- paged / speculative configuration --------------------------------
         self.paged = bool(paged)

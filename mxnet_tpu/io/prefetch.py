@@ -106,6 +106,9 @@ class DevicePrefetcher:
         self._thread.start()
 
     # -- device placement ----------------------------------------------------
+    # Batch shardings are read OFF the attached TrainStep, which derives
+    # them from its declarative Layout (layout.batch_spec()/batch_sharding)
+    # when one is in play — the prefetcher never re-derives data axes.
     def _place_single(self, host_tuple):
         import jax
 

@@ -7,10 +7,13 @@ adds the parallelism the reference never had (TP, SP/CP ring attention,
 GPipe-style PP, expert-parallel MoE) as first-class capabilities, per the
 build contract.
 """
-from .mesh import MeshConfig, make_mesh, local_mesh  # noqa: F401
-from .sharding import ShardingRules, named_sharding, shard_params  # noqa: F401
+from .layout import AXES, Layout  # noqa: F401
+from .mesh import MeshConfig, make_mesh, local_mesh, refit_config  # noqa: F401
+from .sharding import (ShardingRules, named_sharding, reshard_tree,  # noqa: F401
+                       shard_params)
 from .train_step import TrainStep  # noqa: F401
 from .distributed_trainer import DistributedTrainer, init as dist_init  # noqa: F401
 from . import ring_attention  # noqa: F401
 from .pipeline import pipeline_apply, stack_stage_params, stage_sharding  # noqa: F401
 from .moe import moe_ffn, init_moe_params, moe_param_specs  # noqa: F401
+from .blocks import PipelineStages, MoEFFN  # noqa: F401

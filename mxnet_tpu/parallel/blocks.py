@@ -1,0 +1,153 @@
+"""Gluon-facing pipeline / MoE layers over the declarative layout axes.
+
+The shard_map machinery in :mod:`~mxnet_tpu.parallel.pipeline` and
+:mod:`~mxnet_tpu.parallel.moe` is functional (params in, acts out); these
+blocks wrap it in the Gluon parameter/registration idiom so a pipelined or
+expert-parallel model trains through the unchanged ``TrainStep`` path:
+
+  - parameters register under names the :class:`~mxnet_tpu.parallel.Layout`
+    rules target (``stages_weight`` -> ``P('pp', ...)``; ``expert_w1/2`` ->
+    ``P('ep', 'fsdp', None)`` storage, the ep x fsdp ZeRO composition);
+  - the forward reads the *active mesh* (the one ``TrainStep`` stages the
+    loss under, from its layout) and dispatches to the sharded formulation
+    when the relevant axis is actually there; eager single-device runs
+    (init forwards, tests) fall back to the mathematically equivalent
+    dense loop, so block construction needs no mesh at all.
+
+docs/PARALLELISM.md walks the composed layouts these enable.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .._mesh_state import current_mesh
+from ..gluon.block import HybridBlock
+from ..ndarray import NDArray
+from .moe import _route, moe_ffn
+from .pipeline import pipeline_apply
+
+__all__ = ["PipelineStages", "MoEFFN"]
+
+_ACTS = {
+    None: lambda a: a,
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+    "tanh": jnp.tanh,
+}
+
+
+def _raw(a):
+    return a._data if isinstance(a, NDArray) else a
+
+
+class PipelineStages(HybridBlock):
+    """S homogeneous Dense stages, GPipe-pipelined over the ``pp`` axis.
+
+    The stage weights are ONE stacked parameter pair (``stages_weight``
+    [S, units, units], ``stages_bias`` [S, units]) so the layout rule
+    ``(r"stages_weight$", ("pp", None, None))`` shards stage dispatch as
+    data movement GSPMD can see. With an active mesh whose ``pp`` size
+    equals S the forward runs :func:`pipeline_apply` (microbatched scan +
+    ppermute ring); otherwise the same stages run as a sequential loop —
+    identical math, so eager init/eval parity holds.
+    """
+
+    def __init__(self, num_stages, units, activation="relu", microbatches=0,
+                 dtype="float32", weight_initializer=None, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        if num_stages < 1:
+            raise ValueError("num_stages must be >= 1")
+        if activation not in _ACTS:
+            raise ValueError(f"unknown activation {activation!r}")
+        self._S = int(num_stages)
+        self._units = int(units)
+        self._act = activation
+        self._M = int(microbatches)
+        with self.name_scope():
+            self.stages_weight = self.params.get(
+                "stages_weight", shape=(self._S, self._units, self._units),
+                dtype=dtype, init=weight_initializer)
+            self.stages_bias = self.params.get(
+                "stages_bias", shape=(self._S, self._units), dtype=dtype,
+                init="zeros")
+
+    def _stage(self, p, act):
+        return _ACTS[self._act](act @ p["w"].T + p["b"])
+
+    def hybrid_forward(self, F, x, stages_weight, stages_bias):
+        xr, w, b = _raw(x), _raw(stages_weight), _raw(stages_bias)
+        mesh = current_mesh()
+        if mesh is not None and dict(mesh.shape).get("pp", 1) == self._S \
+                and self._S > 1:
+            out = pipeline_apply(self._stage, {"w": w, "b": b}, xr, mesh,
+                                 axis="pp",
+                                 num_microbatches=self._M or None)
+        else:
+            out = xr
+            for s in range(self._S):
+                out = self._stage({"w": w[s], "b": b[s]}, out)
+        return NDArray(out)
+
+
+class MoEFFN(HybridBlock):
+    """Switch-style top-1 MoE FFN, expert-parallel over the ``ep`` axis.
+
+    Parameters register as ``gate_weight`` [d, E] (replicated compute),
+    ``expert_w1`` [E, d, h] and ``expert_w2`` [E, h, d]. The intended
+    layout composes ep with ZeRO storage: rule ``(r"expert_w[12]$",
+    ("ep", "fsdp", None))`` stores each expert shard fsdp-sliced and
+    gathers the fsdp axis for compute, while tokens ride the ``ep`` axis
+    (``batch_axes=("ep",)``, the fused dp==ep layout) into
+    :func:`moe_ffn`'s all_to_all dispatch/return pair. Without an active
+    ep axis the same routing runs dense on one device.
+
+    The Switch load-balance aux loss is available from :func:`moe_ffn`
+    for custom training loops; this block returns activations only (the
+    gate still trains through the combine weights).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, capacity_factor=1.25,
+                 dtype="float32", weight_initializer=None, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        if num_experts < 1:
+            raise ValueError("num_experts must be >= 1")
+        self._E = int(num_experts)
+        self._cf = float(capacity_factor)
+        with self.name_scope():
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(d_model, self._E), dtype=dtype,
+                init=weight_initializer)
+            self.expert_w1 = self.params.get(
+                "expert_w1", shape=(self._E, d_model, d_hidden), dtype=dtype,
+                init=weight_initializer)
+            self.expert_w2 = self.params.get(
+                "expert_w2", shape=(self._E, d_hidden, d_model), dtype=dtype,
+                init=weight_initializer)
+
+    def _dense(self, x, gate, w1, w2):
+        d = x.shape[-1]
+        xt = x.reshape(-1, d)
+        capacity = int(math.ceil(xt.shape[0] / self._E * self._cf))
+        dispatch, combine, _aux = _route(xt, gate, self._E, capacity)
+        packed = jnp.einsum("nec,nd->ecd", dispatch, xt.astype(jnp.float32))
+        h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", packed,
+                                   w1.astype(jnp.float32)))
+        y = jnp.einsum("ech,ehd->ecd", h, w2.astype(jnp.float32))
+        out = jnp.einsum("nec,ecd->nd", combine, y)
+        return out.reshape(x.shape).astype(x.dtype)
+
+    def hybrid_forward(self, F, x, gate_weight, expert_w1, expert_w2):
+        xr, g, w1, w2 = (_raw(x), _raw(gate_weight), _raw(expert_w1),
+                         _raw(expert_w2))
+        mesh = current_mesh()
+        if mesh is not None and dict(mesh.shape).get("ep", 1) > 1:
+            out, _aux = moe_ffn(xr, {"gate": g, "w1": w1, "w2": w2}, mesh,
+                                axis="ep", capacity_factor=self._cf)
+        else:
+            out = self._dense(xr, g, w1, w2)
+        return NDArray(out)

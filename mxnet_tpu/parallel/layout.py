@@ -1,0 +1,357 @@
+"""The declarative parallelism layout (docs/PARALLELISM.md).
+
+One :class:`Layout` names everything the parallel stack used to wire ad
+hoc: the mesh axis sizes over the ``dp/fsdp/tp/sp/pp/ep`` vocabulary,
+the ordered per-parameter/per-activation ``PartitionSpec`` rules, the
+batch placement, and the schedule policies layered on top (async
+gradient-collective overlap, pipeline microbatching). TrainStep, the
+k-step scan window, the :class:`~mxnet_tpu.io.prefetch.DevicePrefetcher`,
+checkpoint save/reshard-on-restore and the
+:class:`~mxnet_tpu.inference.GenerationEngine` all consume THIS object —
+and it serializes into the checkpoint manifest so a restore can validate
+the declared layout against what the checkpoint recorded.
+
+``AXES`` here is the single mesh-axis vocabulary: ``parallel.mesh``
+re-exports it and the astlint JH006 rule pins its literal copy against
+this tuple (tests/test_analysis.py keeps them in sync).
+
+  - ``dp``   data parallel (batch split, gradient all-reduce)
+  - ``fsdp`` ZeRO param/optimizer sharding on the data axis
+  - ``tp``   tensor (megatron) parallel
+  - ``sp``   sequence/context parallel (ring attention)
+  - ``pp``   pipeline stages (microbatched inside the scan window)
+  - ``ep``   expert parallel (MoE all-to-all dispatch)
+
+A ``Layout`` is immutable and hashable; :meth:`canonical` is its
+serialized identity — two equivalent specs (however constructed)
+produce the same canonical string, which is exactly what the TrainStep/
+Trainer jit-cache keys use so equivalent layouts share one compiled
+program.
+"""
+from __future__ import annotations
+
+import json
+import math
+import re
+import threading
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["AXES", "DATA_AXES", "MODEL_AXES", "Layout"]
+
+#: THE mesh-axis vocabulary (scaling-book convention). parallel.mesh
+#: re-exports this; astlint JH006 lints PartitionSpec literals against it.
+AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+
+#: axes an elastic re-formation may resize (state resharded from the
+#: checkpoint manifest) vs axes that encode how the network is cut up
+#: (must survive a world-size change unchanged).
+DATA_AXES = ("dp", "fsdp")
+MODEL_AXES = ("tp", "sp", "pp", "ep")
+
+_LAYOUT_VERSION = 1
+
+# mesh cache: canonical layout + device count -> Mesh (a Mesh is
+# immutable; equivalent layouts share one, like they share jit entries)
+_MESH_CACHE: Dict[Tuple[str, int], Mesh] = {}
+_MESH_CACHE_LOCK = threading.Lock()
+
+
+def _norm_entry(entry):
+    """One PartitionSpec entry -> canonical form (None | str | tuple)."""
+    if entry is None or isinstance(entry, str):
+        return entry
+    return tuple(entry)
+
+
+def _entry_axes(entry) -> Tuple[str, ...]:
+    if entry is None:
+        return ()
+    return entry if isinstance(entry, tuple) else (entry,)
+
+
+class Layout:
+    """Declarative parallelism spec: mesh axis sizes + ordered sharding
+    rules + batch/overlap/pipeline policy. Construct with axis sizes as
+    keyword args (unused axes default to 1 and cost nothing)::
+
+        Layout(dp=2, fsdp=4, rules=[(r"dense\\d*_weight$", ("fsdp", None))],
+               fsdp_axis="fsdp")
+
+    ``rules`` is an ordered ``(pattern, spec)`` list — first match wins,
+    exactly :class:`~mxnet_tpu.parallel.sharding.ShardingRules` — kept
+    in plain-data form so the whole object serializes.
+    """
+
+    def __init__(self, dp: int = 1, fsdp: int = 1, tp: int = 1,
+                 sp: int = 1, pp: int = 1, ep: int = 1, *,
+                 rules: Optional[Iterable[Tuple[str, Sequence]]] = None,
+                 fsdp_axis: Optional[str] = None,
+                 min_fsdp_size: int = 2 ** 16,
+                 batch_axes: Optional[Sequence[str]] = None,
+                 overlap: bool = True,
+                 overlap_buckets: int = 2,
+                 microbatches: int = 0):
+        sizes = dict(dp=dp, fsdp=fsdp, tp=tp, sp=sp, pp=pp, ep=ep)
+        for a, s in sizes.items():
+            if not isinstance(s, int) or s < 1:
+                raise ValueError(f"axis {a!r}: size must be a positive "
+                                 f"int, got {s!r}")
+        self.axes: Dict[str, int] = {a: sizes[a] for a in AXES}
+        self.rules: Tuple[Tuple[str, Tuple], ...] = tuple(
+            (str(pat), tuple(_norm_entry(e) for e in spec))
+            for pat, spec in (rules or ()))
+        for pat, spec in self.rules:
+            re.compile(pat)  # fail fast on a bad pattern
+            for entry in spec:
+                for ax in _entry_axes(entry):
+                    if ax not in AXES:
+                        raise ValueError(
+                            f"rule {pat!r}: unknown mesh axis {ax!r} "
+                            f"(vocabulary: {AXES})")
+        if fsdp_axis is not None and fsdp_axis not in AXES:
+            raise ValueError(f"unknown fsdp_axis {fsdp_axis!r}")
+        self.fsdp_axis = fsdp_axis
+        self.min_fsdp_size = int(min_fsdp_size)
+        if batch_axes is None:
+            batch_axes = tuple(a for a in DATA_AXES if self.axes[a] > 1)
+        self.batch_axes = tuple(batch_axes)
+        for ax in self.batch_axes:
+            if ax not in AXES:
+                raise ValueError(f"unknown batch axis {ax!r}")
+        self.overlap = bool(overlap)
+        self.overlap_buckets = max(1, int(overlap_buckets))
+        self.microbatches = int(microbatches)
+        self._rules_obj = None
+        self._canonical: Optional[str] = None
+
+    # -- identity ------------------------------------------------------------
+    def to_dict(self) -> dict:
+        """Plain-data form (checkpoint manifests store exactly this)."""
+        return {
+            "version": _LAYOUT_VERSION,
+            "axes": {a: s for a, s in self.axes.items() if s > 1},
+            "rules": [[pat, [list(e) if isinstance(e, tuple) else e
+                             for e in spec]]
+                      for pat, spec in self.rules],
+            "fsdp_axis": self.fsdp_axis,
+            "min_fsdp_size": self.min_fsdp_size,
+            "batch_axes": list(self.batch_axes),
+            "overlap": self.overlap,
+            "overlap_buckets": self.overlap_buckets,
+            "microbatches": self.microbatches,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    def canonical(self) -> str:
+        """The serialized identity: equivalent specs -> equal strings.
+        This is the jit-cache key material (one compiled program per
+        canonical layout, not per spec *object*)."""
+        if self._canonical is None:
+            self._canonical = json.dumps(self.to_dict(), sort_keys=True,
+                                         separators=(",", ":"))
+        return self._canonical
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Layout":
+        axes = {str(a): int(s) for a, s in (d.get("axes") or {}).items()}
+        unknown = set(axes) - set(AXES)
+        if unknown:
+            raise ValueError(f"layout names unknown axes {sorted(unknown)} "
+                             f"(vocabulary: {AXES})")
+        rules = [(pat, tuple(tuple(e) if isinstance(e, list) else e
+                             for e in spec))
+                 for pat, spec in (d.get("rules") or [])]
+        return cls(rules=rules,
+                   fsdp_axis=d.get("fsdp_axis"),
+                   min_fsdp_size=int(d.get("min_fsdp_size", 2 ** 16)),
+                   batch_axes=d.get("batch_axes"),
+                   overlap=bool(d.get("overlap", True)),
+                   overlap_buckets=int(d.get("overlap_buckets", 2)),
+                   microbatches=int(d.get("microbatches", 0)),
+                   **axes)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Layout":
+        return cls.from_dict(json.loads(s))
+
+    def __eq__(self, other):
+        return isinstance(other, Layout) \
+            and self.canonical() == other.canonical()
+
+    def __hash__(self):
+        return hash(self.canonical())
+
+    def __repr__(self):
+        used = ", ".join(f"{a}={s}" for a, s in self.axes.items() if s > 1)
+        return (f"Layout({used or 'single-device'}, "
+                f"{len(self.rules)} rule(s), overlap={self.overlap})")
+
+    # -- mesh ----------------------------------------------------------------
+    def sizes(self) -> Tuple[int, ...]:
+        return tuple(self.axes[a] for a in AXES)
+
+    @property
+    def total(self) -> int:
+        return math.prod(self.sizes())
+
+    def mesh_config(self):
+        from .mesh import MeshConfig
+
+        return MeshConfig(**self.axes)
+
+    def mesh(self, devices=None) -> Mesh:
+        """The device mesh this layout describes. With default devices
+        the mesh is cached per canonical layout, so every consumer of an
+        equivalent spec shares ONE Mesh object (and therefore one jit
+        cache entry for programs closed over it)."""
+        from .mesh import make_mesh
+
+        if devices is not None:
+            return make_mesh(self.mesh_config(), devices)
+        import jax
+
+        key = (self.canonical(), len(jax.devices()))
+        with _MESH_CACHE_LOCK:
+            mesh = _MESH_CACHE.get(key)
+            if mesh is None:
+                mesh = make_mesh(self.mesh_config())
+                _MESH_CACHE[key] = mesh
+        return mesh
+
+    # -- sharding ------------------------------------------------------------
+    def sharding_rules(self):
+        """The rule engine view (:class:`~mxnet_tpu.parallel.sharding.
+        ShardingRules`) over this layout's ordered rules."""
+        if self._rules_obj is None:
+            from .sharding import ShardingRules
+
+            self._rules_obj = ShardingRules(
+                rules=[(pat, spec) for pat, spec in self.rules],
+                fsdp_axis=self.fsdp_axis,
+                min_fsdp_size=self.min_fsdp_size)
+        return self._rules_obj
+
+    def spec_for(self, name: str, shape, mesh: Optional[Mesh] = None) -> P:
+        return self.sharding_rules().spec_for(name, shape,
+                                              mesh or self.mesh())
+
+    def tree_specs(self, params, mesh: Optional[Mesh] = None):
+        return self.sharding_rules().tree_specs(params, mesh or self.mesh())
+
+    def batch_spec(self, extra_leading: int = 0) -> P:
+        """The batch-array PartitionSpec: leading (batch) dim split over
+        ``batch_axes``; ``extra_leading`` inserts unsharded dims in front
+        (the k-step window stacks ``(window[, accum], *batch)``)."""
+        lead: tuple = (None,) * extra_leading
+        if not self.batch_axes:
+            return P(*lead) if lead else P()
+        ax = self.batch_axes[0] if len(self.batch_axes) == 1 \
+            else tuple(self.batch_axes)
+        return P(*lead, ax)
+
+    def batch_sharding(self, mesh: Optional[Mesh] = None,
+                       extra_leading: int = 0) -> Optional[NamedSharding]:
+        if self.total == 1 and mesh is None:
+            return None
+        return NamedSharding(mesh or self.mesh(),
+                             self.batch_spec(extra_leading))
+
+    # -- elastic re-formation ------------------------------------------------
+    def refit(self, n_devices: int) -> "Layout":
+        """Scale to a new device count: the model axes (``tp/sp/pp/ep``)
+        encode how the network is cut and must survive unchanged; the
+        data axes absorb the change — ``fsdp`` keeps its width when the
+        old layout sharded state there (ZeRO layout preserved), else all
+        data capacity goes to ``dp``. Mirrors (and now backs)
+        :func:`~mxnet_tpu.parallel.mesh.refit_config`."""
+        model = math.prod(self.axes[a] for a in MODEL_AXES)
+        if n_devices % model != 0:
+            raise ValueError(
+                f"cannot re-form: model axes need multiples of {model} "
+                f"devices ({', '.join(f'{a}={self.axes[a]}' for a in MODEL_AXES)}), "
+                f"got {n_devices}")
+        data = n_devices // model
+        d = self.to_dict()
+        axes = {a: s for a, s in self.axes.items() if a in MODEL_AXES}
+        if self.axes["fsdp"] > 1:
+            if self.axes["dp"] > 1 and data % self.axes["fsdp"] == 0:
+                axes["fsdp"], axes["dp"] = self.axes["fsdp"], \
+                    data // self.axes["fsdp"]
+            else:
+                axes["fsdp"], axes["dp"] = data, 1
+        else:
+            axes["dp"], axes["fsdp"] = data, 1
+        d["axes"] = axes
+        d["batch_axes"] = [a for a in DATA_AXES if axes.get(a, 1) > 1] \
+            if list(self.batch_axes) == \
+            [a for a in DATA_AXES if self.axes[a] > 1] else d["batch_axes"]
+        return Layout.from_dict(d)
+
+    def compatible_restore(self, recorded: dict) -> Optional[str]:
+        """Declared-vs-restored validation (checkpoint restore): a
+        checkpoint written under ``recorded`` (a :meth:`to_dict` payload)
+        may be restored into this layout iff every MODEL axis size and
+        the sharding rules match — data axes may differ (that is exactly
+        elastic re-formation, handled by reshard-on-restore). Returns
+        ``None`` when compatible, else a human-readable reason."""
+        try:
+            other = Layout.from_dict(recorded)
+        except Exception as e:  # unreadable record: surface, don't guess
+            return f"unreadable layout record: {e}"
+        for a in MODEL_AXES:
+            if self.axes[a] != other.axes[a]:
+                return (f"model axis {a!r}: checkpoint recorded "
+                        f"{other.axes[a]}, this layout declares "
+                        f"{self.axes[a]}")
+        if self.rules != other.rules:
+            return ("sharding rules differ from the checkpoint's "
+                    f"({len(other.rules)} recorded vs "
+                    f"{len(self.rules)} declared)")
+        return None
+
+    # -- back-compat bridges -------------------------------------------------
+    @classmethod
+    def from_mesh(cls, mesh: Mesh, rules=None, batch_spec: Optional[P] = None,
+                  overlap: bool = True) -> "Layout":
+        """Bridge from the pre-layout calling convention (``mesh=`` +
+        ``rules=``): captures the mesh's axis sizes and the rule set's
+        plain-data form. The mesh must speak the :data:`AXES` vocabulary
+        (everything :func:`~mxnet_tpu.parallel.mesh.make_mesh` builds
+        does)."""
+        sizes = dict(mesh.shape)
+        unknown = set(sizes) - set(AXES)
+        if unknown:
+            raise ValueError(
+                f"mesh axes {sorted(unknown)} are outside the layout "
+                f"vocabulary {AXES}; construct a Layout explicitly")
+        kw: dict = {a: int(s) for a, s in sizes.items() if a in AXES}
+        rule_list, fsdp_axis, min_fsdp = [], None, 2 ** 16
+        if rules is not None:
+            rule_list = [(pat.pattern, tuple(spec))
+                         for pat, spec in rules.rules]
+            fsdp_axis = rules.fsdp_axis
+            min_fsdp = rules.min_fsdp_size
+        batch_axes = None
+        if batch_spec is not None:
+            batch_axes = _entry_axes(_norm_entry(
+                batch_spec[0] if len(batch_spec) else None))
+        return cls(rules=rule_list, fsdp_axis=fsdp_axis,
+                   min_fsdp_size=min_fsdp, batch_axes=batch_axes,
+                   overlap=overlap, **kw)
+
+    def describe(self) -> str:
+        lines = [repr(self)]
+        for pat, spec in self.rules:
+            lines.append(f"  {pat!r} -> P{spec!r}")
+        if self.fsdp_axis:
+            lines.append(f"  fsdp fallback: {self.fsdp_axis!r} "
+                         f"(min {self.min_fsdp_size} elems)")
+        lines.append(f"  batch over {self.batch_axes!r}, "
+                     f"overlap={self.overlap} "
+                     f"(buckets={self.overlap_buckets}), "
+                     f"microbatches={self.microbatches}")
+        return "\n".join(lines)

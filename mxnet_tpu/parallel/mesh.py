@@ -15,9 +15,12 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["MeshConfig", "make_mesh", "local_mesh", "refit_config"]
+__all__ = ["AXES", "MeshConfig", "make_mesh", "local_mesh", "refit_config"]
 
-AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+# the axis vocabulary is owned by the declarative layout spec
+# (parallel.layout.AXES — docs/PARALLELISM.md); re-exported here for the
+# existing mesh-level callers
+from .layout import AXES  # noqa: E402
 
 
 @dataclasses.dataclass
@@ -73,20 +76,15 @@ def refit_config(config: MeshConfig, n_devices: int) -> MeshConfig:
 
     The data capacity goes to ``fsdp`` when the old config sharded state
     there (keeping the ZeRO layout, at the new width), else to ``dp``.
+
+    The re-formation rule itself lives on the declarative spec
+    (:meth:`~mxnet_tpu.parallel.layout.Layout.refit`) — this wrapper
+    keeps the mesh-level calling convention and delegates, so elastic
+    code and layout-first code can never disagree about what survives a
+    world-size change.
     """
-    model = config.tp * config.sp * config.pp * config.ep
-    if n_devices % model != 0:
-        raise ValueError(
-            f"cannot re-form: model axes need multiples of {model} devices "
-            f"(tp={config.tp} sp={config.sp} pp={config.pp} ep={config.ep}), "
-            f"got {n_devices}")
-    data = n_devices // model
-    new = dataclasses.replace(config)
-    if config.fsdp > 1:
-        if config.dp > 1 and data % config.fsdp == 0:
-            new.fsdp, new.dp = config.fsdp, data // config.fsdp
-        else:
-            new.fsdp, new.dp = data, 1
-    else:
-        new.dp, new.fsdp = data, 1
-    return new
+    from .layout import Layout
+
+    refitted = Layout(**{a: getattr(config, a) for a in AXES}) \
+        .refit(n_devices)
+    return MeshConfig(**refitted.axes)

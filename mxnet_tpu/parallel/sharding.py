@@ -114,7 +114,7 @@ def shard_params(params: Dict[str, jax.Array], mesh: Mesh,
             for k, v in params.items()}
 
 
-def reshard_tree(tree, shardings):
+def reshard_tree(tree, shardings=None, *, layout=None, mesh=None):
     """Re-lay-out a restored state tree onto (possibly re-formed) meshes.
 
     ``shardings`` is a per-top-level-key map (param name ->
@@ -125,7 +125,22 @@ def reshard_tree(tree, shardings):
     restore left them. This is the restore half of reshard-on-restore:
     checkpoints reassemble to host-global arrays at *any* world size, and
     this puts them back into the current mesh's fsdp layout.
+
+    Alternatively pass ``layout=`` (a :class:`~mxnet_tpu.parallel.layout.
+    Layout`, the declarative spec): the per-key shardings are derived
+    from ITS rules over the tree's own leaf shapes — no caller re-derives
+    axes ad hoc — on ``layout.mesh()`` (or an explicit ``mesh=``).
     """
+    if layout is not None:
+        if shardings is not None:
+            raise ValueError("pass shardings= or layout=, not both")
+        mesh = mesh if mesh is not None else layout.mesh()
+        shardings = {}
+        for k, v in tree.items():
+            leaf = jax.tree_util.tree_leaves(v)
+            if leaf:
+                shardings[k] = NamedSharding(
+                    mesh, layout.spec_for(k, leaf[0].shape, mesh))
     if shardings is None:
         return tree
     return {k: jax.tree_util.tree_map(

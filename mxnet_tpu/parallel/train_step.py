@@ -59,16 +59,43 @@ class TrainStep:
 
     def __init__(self, net, loss_fn, optimizer, mesh: Optional[Mesh] = None,
                  rules: Optional[ShardingRules] = None, batch_spec=None,
-                 donate: bool = True, n_model_inputs: int = 1, amp="auto"):
+                 donate: bool = True, n_model_inputs: int = 1, amp="auto",
+                 layout: Optional["Layout"] = None):
         from ..contrib.amp import resolve_policy
+        from .layout import Layout
 
         self.amp_policy = resolve_policy(amp)
         self.net = net
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.n_model_inputs = n_model_inputs
+        # the declarative layout (docs/PARALLELISM.md) is the ONE source
+        # of truth: mesh, rules and batch placement all derive from it.
+        # The legacy (mesh=, rules=) convention still works and is
+        # bridged INTO a Layout, so cache keys, checkpoint manifests and
+        # the audit pipeline see one spec either way.
+        if layout is not None:
+            if mesh is not None or rules is not None:
+                raise ValueError("pass layout= OR (mesh=, rules=), "
+                                 "not both")
+            if layout.total > 1:
+                mesh = layout.mesh()
+            rules = layout.sharding_rules()
+            if batch_spec is None and layout.batch_axes:
+                batch_spec = layout.batch_spec()
         self.mesh = mesh
         self.rules = rules or ShardingRules()
+        if layout is None:
+            try:
+                layout = (Layout.from_mesh(mesh, self.rules, batch_spec)
+                          if mesh is not None else Layout())
+            except ValueError:
+                layout = None  # mesh outside the AXES vocabulary
+        self.layout = layout
+        # async gradient-collective overlap (layout policy): bucketed
+        # barrier hints in the program + the asyncify schedule model
+        self._overlap_on = bool(layout is not None and layout.overlap
+                                and mesh is not None)
         self.donate = donate
         self._plist = [p for _, p in sorted(net.collect_params().items())]
         for p in self._plist:
@@ -247,9 +274,40 @@ class TrainStep:
 
         return jax.value_and_grad(lossf)
 
+    def _overlap_grads(self, grads):
+        """Bucketed async-collective hint (layout ``overlap`` policy,
+        arXiv:2004.13336): group the gradient dict into
+        ``layout.overlap_buckets`` buckets and chain each bucket's grads
+        behind a representative of the NEXT bucket with
+        ``lax.optimization_barrier``. The barrier is the identity on
+        values but adds a scheduling edge: a bucket's optimizer update
+        cannot be hoisted before the next bucket's gradients exist, so a
+        latency-hiding backend keeps each bucket's reduce-scatter/
+        all-reduce in flight while later backprop still computes —
+        exactly the start→done deferral the schedule auditor's asyncify
+        pass models. (XLA's CPU backend expands the barrier away after
+        SPMD partitioning; on TPU it constrains the scheduler.)"""
+        if not self._overlap_on or len(grads) < 2:
+            return grads
+        names = sorted(grads)
+        k = min(self.layout.overlap_buckets, len(names))
+        if k < 2:
+            return grads
+        size = -(-len(names) // k)
+        buckets = [names[i:i + size] for i in range(0, len(names), size)]
+        out = dict(grads)
+        for i in range(len(buckets) - 1):
+            rep = grads[buckets[i + 1][0]]  # pre-barrier: no chain cycles
+            tied = jax.lax.optimization_barrier(
+                tuple(out[n] for n in buckets[i]) + (rep,))
+            for n, v in zip(buckets[i], tied[:-1]):
+                out[n] = v
+        return out
+
     def _apply_update(self, params, opt_state, t, grads, lr, wd,
                       lr_mult, wd_mult):
         """One optimizer application over the whole param dict (traced)."""
+        grads = self._overlap_grads(grads)
         opt = self.optimizer
         new_params, new_state = dict(params), {}
         for name in params:
@@ -1017,17 +1075,32 @@ class TrainStep:
                                   "good": int(a["good"]),
                                   "skipped": int(a["skipped"])}
         return save_train_state(directory, int(self.optimizer.num_update),
-                                self.params, self.opt_state, extra=extra)
+                                self.params, self.opt_state, extra=extra,
+                                layout=self.layout.to_dict()
+                                if self.layout is not None else None)
 
     def restore(self, directory):
         import json
         import os
 
-        from ..checkpoint import latest_checkpoint, load_train_state
+        from ..checkpoint import (checkpoint_layout, latest_checkpoint,
+                                  load_train_state)
 
         path = latest_checkpoint(directory)
         if path is None:
             return False
+        # declared-vs-restored layout validation: the manifest records the
+        # Layout that wrote the checkpoint; model axes (tp/sp/pp/ep) and
+        # rules must match the current spec — resharding across those is
+        # not a data relayout but a different program. Data axes (dp/fsdp)
+        # are free: that IS the elastic contract.
+        recorded = checkpoint_layout(path)
+        if recorded is not None and self.layout is not None:
+            why = self.layout.compatible_restore(recorded)
+            if why is not None:
+                raise ValueError(
+                    f"checkpoint {path} layout incompatible with the "
+                    f"current layout: {why}")
         params, opt_state, step = load_train_state(
             path, like=(self.params, self.opt_state))
         import jax.numpy as jnp
@@ -1057,8 +1130,17 @@ class TrainStep:
             # where the fsdp layout changes width
             from .sharding import reshard_tree
 
-            self.params = reshard_tree(self.params, self.param_sharding)
-            self.opt_state = reshard_tree(self.opt_state, self.param_sharding)
+            if self.layout is not None and self.layout.total > 1:
+                # one source of truth: the declarative Layout derives the
+                # storage shardings, same spec the manifest recorded
+                self.params = reshard_tree(
+                    self.params, layout=self.layout, mesh=self.mesh)
+                self.opt_state = reshard_tree(
+                    self.opt_state, layout=self.layout, mesh=self.mesh)
+            else:
+                self.params = reshard_tree(self.params, self.param_sharding)
+                self.opt_state = reshard_tree(self.opt_state,
+                                              self.param_sharding)
         self.sync()
         return True
 
@@ -1209,14 +1291,22 @@ class TrainStep:
                 compiled_rep if compiled_rep is not None else lowered_rep)
         # schedule truth follows the same precedence as memory: the
         # compiled executable is scheduled text (async pairs, fusions);
-        # comm= reuses the pricing just computed over the same report
-        schedule = _analysis.schedule_report(mem_rep, self.mesh, comm=comm)
+        # comm= reuses the pricing just computed over the same report.
+        # Under the layout's overlap policy the asyncify pass first
+        # derives the async view — literal start→done pairs with
+        # independent compute list-scheduled into each span — modeling
+        # the TPU latency-hiding scheduler the CPU audit backend lacks
+        # (docs/PARALLELISM.md "Hiding collective time")
+        sched_src, overlap_info = mem_rep, None
+        if self._overlap_on:
+            sched_src, overlap_info = _analysis.asyncify(mem_rep)
+        schedule = _analysis.schedule_report(sched_src, self.mesh, comm=comm)
         self._record_schedule_bound(schedule)
         return _analysis.ProgramAudit(
             lowered=lowered_rep, compiled=compiled_rep,
             carry_indices=tuple(range(n_carry)),
             contract=contract, comm=comm, memory=memory,
-            schedule=schedule)
+            schedule=schedule, overlap=overlap_info)
 
     def profile(self, *batch, steps: int = 2, warmup: int = 1,
                 window: Optional[int] = None, accum: int = 1,

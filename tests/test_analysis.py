@@ -883,8 +883,9 @@ def test_lint_unknown_mesh_axis_jh006():
     # inline-suppressible like JH001-JH005
     sup = 'P("fsdq")  # lint: disable=JH006\n'
     assert astlint.lint_source(sup, "mxnet_tpu/x.py") == []
-    # the vocabulary pins to parallel.mesh.AXES — update both together
-    from mxnet_tpu.parallel.mesh import AXES
+    # the vocabulary pins to parallel.layout.AXES (the declarative spec
+    # owns it; parallel.mesh re-exports) — update both together
+    from mxnet_tpu.parallel.layout import AXES
 
     assert astlint._MESH_AXES == frozenset(AXES)
 

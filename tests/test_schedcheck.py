@@ -47,9 +47,12 @@ def test_gate_matches_committed_goldens(schedcheck, capsys):
     fam = row["families"]["step_fsdp"]
     assert fam["critical_path_seconds"] > 0
     assert fam["comm_seconds"] > 0
-    # CPU compiles sync collectives: the fsdp baseline is fully exposed
-    # — exactly what the async-overlap work will be diffed against
-    assert fam["overlap_fraction"] == 0.0
+    # the audit schedules the asyncified view (the layout overlap
+    # policy), so part of the collective time hides behind compute —
+    # the gate pins that gain against ever dropping back toward the
+    # sync-CPU 0.0 baseline
+    assert 0.0 < fam["overlap_fraction"] < 1.0
+    assert fam["hidden_comm_seconds"] > 0
     assert fam["exposed_collectives"].get("all_reduce", 0) > 0
     assert set(fam["exposed_by_axis_bytes"]) == {"fsdp", "dp×fsdp"}
     assert fam["carry_donation"] == 1.0

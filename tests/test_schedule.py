@@ -10,7 +10,7 @@ import json
 import numpy as np
 import pytest
 
-from mxnet_tpu.analysis import audit_text, schedule_report
+from mxnet_tpu.analysis import asyncify, audit_text, schedule_report
 
 # fixed roofline constants for every hand-computed case: 1 GB/s HBM and
 # ICI make seconds == bytes/1e9, peak 1e12 FLOP/s
@@ -294,6 +294,109 @@ def _invariants(s):
     json.dumps(s.summary())
 
 
+# ---------------------------------------------------------------------------
+# the asyncify pass (analysis.overlap): sync collectives rewritten into
+# start→done spans the scheduler prices as hidden, hand-computed
+# ---------------------------------------------------------------------------
+
+_SYNC_HIDEABLE = """\
+HloModule t, is_scheduled=true
+
+ENTRY %main.9 (p0.1: f32[1024], p1.2: f32[1024,1024]) -> f32[1024] {
+  %p0.1 = f32[1024]{0} parameter(0)
+  %p1.2 = f32[1024,1024]{1,0} parameter(1)
+  %ar.3 = f32[1024]{0} all-reduce(f32[1024]{0} %p0.1), replica_groups={{0,1,2,3}}, to_apply=%add
+  %big.4 = f32[1024]{0} dot(f32[1024,1024]{1,0} %p1.2, f32[1024]{0} %p0.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %e.5 = f32[1024]{0} add(f32[1024]{0} %ar.3, f32[1024]{0} %big.4)
+}
+"""
+
+
+def test_asyncify_fully_hides_hand_computed():
+    """The sync 8192 B all-reduce is fully exposed as written; asyncify
+    splits it into a start→done pair with the 4.2 ms dot scheduled inside
+    the span, so the derived schedule hides ALL of it — the exact before/
+    after the schedcheck overlap goldens lock in."""
+    rep = audit_text(_SYNC_HIDEABLE)
+    before = schedule_report(rep, **_K)
+    assert before.overlap_fraction == 0.0
+    assert before.exposed_comm_seconds == pytest.approx(8192 / 1e9)
+
+    rep2, stats = asyncify(rep)
+    assert stats.async_pairs == 1 and stats.deferred == 1
+    assert sum(stats.per_computation.values()) == 1
+    # the input report is untouched — asyncify derives, never mutates
+    assert [v.op for v in rep.values].count("all_reduce_done") == 0
+    # emission order: start … compute … done … consumer
+    ops = [v.op for v in rep2.values]
+    i_start = ops.index("all_reduce")
+    i_done = ops.index("all_reduce_done")
+    i_dot = ops.index("dot")
+    i_root = len(ops) - 1
+    assert i_start < i_dot < i_done < i_root
+    # the consumer's use is rewritten onto the done value
+    done_vid = rep2.values[i_done].vid
+    assert done_vid in rep2.values[i_root].uses
+
+    after = schedule_report(rep2, **_K)
+    assert after.comm_seconds == pytest.approx(before.comm_seconds)
+    assert after.hidden_comm_seconds == pytest.approx(after.comm_seconds)
+    assert after.exposed_comm_seconds == 0.0
+    assert after.overlap_fraction == 1.0
+    assert after.exposed_collectives() == {}
+    # comm off the critical path: the compute chain alone remains
+    assert after.critical_path_seconds == \
+        pytest.approx(after.compute_seconds)
+    assert after.critical_path_seconds < before.critical_path_seconds
+    span = after.spans[0]
+    assert span.is_async and not span.is_exposed
+
+
+_SYNC_PARTIAL = """\
+HloModule t, is_scheduled=true
+
+ENTRY %main.9 (p0.1: f32[1024,1024], p1.2: f32[1024]) -> f32[1024] {
+  %p0.1 = f32[1024,1024]{1,0} parameter(0)
+  %p1.2 = f32[1024]{0} parameter(1)
+  %ar.3 = f32[1024,1024]{1,0} all-reduce(f32[1024,1024]{1,0} %p0.1), replica_groups={{0,1,2,3}}, to_apply=%add
+  %sm.4 = f32[1024]{0} add(f32[1024]{0} %p1.2, f32[1024]{0} %p1.2)
+  ROOT %e.5 = f32[1024]{0} dot(f32[1024,1024]{1,0} %ar.3, f32[1024]{0} %sm.4), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+
+
+def test_asyncify_partial_hiding_hand_computed():
+    """Only the 12.288 µs add fits inside the 8.39 ms all-reduce span:
+    hidden == the add's seconds exactly, the rest stays exposed, and
+    hidden + exposed == total still holds on the derived schedule."""
+    rep2, stats = asyncify(audit_text(_SYNC_PARTIAL))
+    assert stats.async_pairs == 1
+    s = schedule_report(rep2, **_K)
+    comm = 2 * 1024 * 1024 * 4 / 1e9
+    add_s = 3 * 4096 / 1e9
+    assert s.comm_seconds == pytest.approx(comm)
+    assert s.hidden_comm_seconds == pytest.approx(add_s)
+    assert s.exposed_comm_seconds == pytest.approx(comm - add_s)
+    assert s.overlap_fraction == pytest.approx(add_s / comm)
+    assert s.exposed_collectives() == {"all_reduce": 1}  # mostly exposed
+    _invariants(s)
+
+
+def test_asyncify_no_collectives_is_identity():
+    prog = """\
+HloModule t, is_scheduled=true
+
+ENTRY %main.3 (p0.1: f32[8]) -> f32[8] {
+  %p0.1 = f32[8]{0} parameter(0)
+  ROOT %m.2 = f32[8]{0} multiply(f32[8]{0} %p0.1, f32[8]{0} %p0.1)
+}
+"""
+    rep = audit_text(prog)
+    rep2, stats = asyncify(rep)
+    assert stats.async_pairs == 0
+    assert [v.vid for v in rep2.values] == [v.vid for v in rep.values]
+
+
 def test_step_audit_schedule_and_gauges():
     """ISSUE 13 acceptance: TrainStep.audit(...).schedule returns a
     populated ScheduleReport on CPU, and exports the train_mfu_bound /
@@ -316,20 +419,28 @@ def test_step_audit_schedule_and_gauges():
 
 def test_fsdp_step_and_window_schedule():
     """The fsdp mesh step: collective time attributed to the fsdp /
-    dp×fsdp axes, fully exposed on CPU (sync collectives — the baseline
-    the async-overlap work will improve); the fused window recurses its
-    scan body and sees the same collectives once."""
+    dp×fsdp axes. The audit schedules the asyncified view, so part of
+    the collective time is hidden behind independent compute (XLA:CPU
+    emits sync collectives, which score 0.0 overlap raw — the asyncify
+    pass models what the TPU async runtime achieves); the fused window
+    recurses its scan body and sees the same collectives once."""
     from mxnet_tpu.parallel import MeshConfig, ShardingRules, make_mesh
 
     mesh = make_mesh(MeshConfig(dp=2, fsdp=4))
     ts, batch = _mlp_step(mesh, ShardingRules(fsdp_axis="fsdp",
                                               min_fsdp_size=1))
-    s = ts.audit(*batch).schedule
+    a = ts.audit(*batch)
+    s = a.schedule
     _invariants(s)
     assert s.comm_seconds > 0
     assert set(s.by_axis()) == {"fsdp", "dp×fsdp"}
-    assert s.exposed_comm_seconds == pytest.approx(s.comm_seconds)
-    assert s.overlap_fraction == 0.0
+    assert a.overlap is not None and a.overlap.async_pairs > 0
+    assert 0.0 < s.overlap_fraction < 1.0
+    assert s.hidden_comm_seconds > 0
+    assert s.exposed_comm_seconds < s.comm_seconds
+    # the raw (sync) compiled program still scores fully exposed
+    raw = schedule_report(a.compiled, mesh, **_K)
+    assert raw.overlap_fraction == 0.0
     assert obs_share_exposed(s) > 0
     # dcn pricing: routing the fsdp axis over a 100x slower link must
     # grow that axis's time proportionally

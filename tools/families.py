@@ -3,7 +3,7 @@
 
 ``make shardcheck`` (sharding + comm), ``make memcheck`` (buffer
 liveness) and ``make schedcheck`` (critical path + overlap) all audit the
-same eight representative programs; this module owns their constructors
+same ten representative programs; this module owns their constructors
 so a family change can never drift between gates (ISSUE 13). Builders are
 memoized where two families audit the SAME object (the two fsdp families
 share one TrainStep — step vs window program — and the serving families
@@ -27,8 +27,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
 #: gate-facing family order (memcheck/schedcheck default ordering)
-FAMILY_NAMES = ("step_dp8", "step_fsdp", "window_fsdp", "prefill",
-                "decode", "decode_paged", "verify_spec", "decode_prefix")
+FAMILY_NAMES = ("step_dp8", "step_fsdp", "window_fsdp", "step_pp",
+                "step_moe_fsdp", "prefill", "decode", "decode_paged",
+                "verify_spec", "decode_prefix")
 
 
 def load():
@@ -92,6 +93,63 @@ def family_window_fsdp():
     """The fused 2-step scan window over the same ZeRO layout."""
     ts, batch = _fsdp_step()
     return ts.audit(*batch, window=2)
+
+
+@functools.lru_cache(maxsize=None)
+def _pp_step():
+    """GPipe pipeline over pp=8, declared through ONE Layout."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, optimizer
+    from mxnet_tpu.parallel import Layout, TrainStep
+    from mxnet_tpu.parallel.blocks import PipelineStages
+
+    mx.random.seed(0)
+    net = PipelineStages(8, 16)
+    net.initialize()
+    x = nd.ones((8, 16))
+    _ = net(x)
+    layout = Layout(pp=8, rules=[
+        (r"stages_weight$", ("pp", None, None)),
+        (r"stages_bias$", ("pp", None)),
+    ])
+    ts = TrainStep(net, lambda out, *l: ((out - l[0]) ** 2).mean(),
+                   optimizer.Adam(learning_rate=1e-3), layout=layout)
+    return ts, (x, nd.zeros((8, 16)))
+
+
+def family_step_pp():
+    """Pipeline parallelism: stage ring ppermutes inside the GPipe scan."""
+    ts, batch = _pp_step()
+    return ts.audit(*batch)
+
+
+@functools.lru_cache(maxsize=None)
+def _moe_step():
+    """Expert-parallel MoE composed with ZeRO storage: ep=4 x fsdp=2,
+    expert weights stored ('ep','fsdp',None) and fsdp-gathered for
+    compute, tokens riding the ep axis (the fused dp==ep layout)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, optimizer
+    from mxnet_tpu.parallel import Layout, TrainStep
+    from mxnet_tpu.parallel.blocks import MoEFFN
+
+    mx.random.seed(0)
+    net = MoEFFN(16, 32, 8)
+    net.initialize()
+    x = nd.ones((8, 4, 16))
+    _ = net(x)
+    layout = Layout(ep=4, fsdp=2,
+                    rules=[(r"expert_w[12]$", ("ep", "fsdp", None))],
+                    fsdp_axis="fsdp", min_fsdp_size=1, batch_axes=("ep",))
+    ts = TrainStep(net, lambda out, *l: ((out - l[0]) ** 2).mean(),
+                   optimizer.Adam(learning_rate=1e-3), layout=layout)
+    return ts, (x, nd.zeros((8, 4, 16)))
+
+
+def family_step_moe_fsdp():
+    """MoE all_to_all dispatch/return composed with fsdp gathers."""
+    ts, batch = _moe_step()
+    return ts.audit(*batch)
 
 
 @functools.lru_cache(maxsize=None)
@@ -188,6 +246,8 @@ FAMILIES = {
     "step_dp8": family_step_dp8,
     "step_fsdp": family_step_fsdp,
     "window_fsdp": family_window_fsdp,
+    "step_pp": family_step_pp,
+    "step_moe_fsdp": family_step_moe_fsdp,
     "decode": family_decode,
     "prefill": family_prefill,
     "decode_paged": family_decode_paged,
